@@ -1,0 +1,241 @@
+"""The op model and history functions.
+
+An operation is a plain dict — the universal currency of the framework
+(reference: jepsen/src/jepsen/core.clj:220-254, generator/pure.clj:327-336):
+
+    {"type":    "invoke" | "ok" | "fail" | "info",
+     "process": int | "nemesis",
+     "f":       str,                  # e.g. "read", "write", "cas", "txn"
+     "value":   anything,
+     "time":    int,                  # nanoseconds, relative to test start
+     "index":   int,                  # position in the history
+     "error":   optional}
+
+A history is a list of op dicts ordered by real time: each client invocation
+(:invoke) is later completed by an :ok (definitely happened), :fail
+(definitely did not happen), or :info (indeterminate) op from the same
+process. Nemesis ops are always :info and never complete.
+
+This module provides the history functions the reference pulls from
+knossos.history (index, pairs, complete, processes) plus tensor-encoding
+hooks used by the TPU checkers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator
+
+from .edn import Keyword, dumps, loads_all
+
+Op = dict  # documentation alias
+
+INVOKE, OK, FAIL, INFO = "invoke", "ok", "fail", "info"
+NEMESIS = "nemesis"
+
+TYPES = (INVOKE, OK, FAIL, INFO)
+
+
+def op(type: str, process: Any, f: Any, value: Any = None, **kw: Any) -> Op:
+    """Construct an op map."""
+    o = {"type": type, "process": process, "f": f, "value": value}
+    o.update(kw)
+    return o
+
+
+def invoke_op(process: Any, f: Any, value: Any = None, **kw: Any) -> Op:
+    return op(INVOKE, process, f, value, **kw)
+
+
+def is_invoke(o: Op) -> bool:
+    return o.get("type") == INVOKE
+
+
+def is_ok(o: Op) -> bool:
+    return o.get("type") == OK
+
+
+def is_fail(o: Op) -> bool:
+    return o.get("type") == FAIL
+
+
+def is_info(o: Op) -> bool:
+    return o.get("type") == INFO
+
+
+def is_client_op(o: Op) -> bool:
+    """Client ops have integer processes; the nemesis and other internal
+    actors use named processes (reference: jepsen/src/jepsen/util.clj)."""
+    return isinstance(o.get("process"), int)
+
+
+def index(history: list[Op]) -> list[Op]:
+    """Return a history whose ops all carry an :index equal to their
+    position. Ops that already have the right index are reused."""
+    out = []
+    for i, o in enumerate(history):
+        if o.get("index") != i:
+            o = {**o, "index": i}
+        out.append(o)
+    return out
+
+
+def processes(history: Iterable[Op]) -> set:
+    return {o["process"] for o in history if "process" in o}
+
+
+def pairs(history: Iterable[Op]) -> Iterator[tuple[Op, Op | None]]:
+    """Yield (invocation, completion|None) pairs, in invocation order.
+
+    A completion is the next op by the same process after its invocation.
+    Invocations with no completion (still pending at history end) yield
+    (invoke, None). Non-invoke ops without a prior invocation (e.g. nemesis
+    :info ops) yield (op, None) as well.
+    """
+    pending: dict[Any, Op] = {}
+    order: list[Op] = []
+    completion: dict[int, Op] = {}
+    for i, o in enumerate(history):
+        p = o.get("process")
+        if is_invoke(o):
+            pending[p] = o
+            order.append(o)
+        elif p in pending:
+            completion[id(pending.pop(p))] = o
+        else:
+            order.append(o)
+    for o in order:
+        yield o, completion.get(id(o))
+
+
+def complete(history: list[Op]) -> list[Op]:
+    """Rewrite a history so (a) every invocation completed by an :ok op
+    carries the completion's :value (reads know what they returned), and
+    (b) every :info completion with a nil value inherits its invocation's
+    value (an indeterminate write still says *what* it may have written) —
+    matching knossos.history/complete semantics used before
+    linearizability checking."""
+    out: list[Op] = [dict(o) for o in history]
+    pending: dict[Any, Op] = {}  # process -> invocation (from out)
+    for o in out:
+        p = o.get("process")
+        if is_invoke(o):
+            pending[p] = o
+        elif p in pending:
+            inv = pending.pop(p)
+            if is_ok(o):
+                inv["value"] = o.get("value")
+            elif is_info(o) and o.get("value") is None:
+                o["value"] = inv.get("value")
+    return out
+
+
+def invocations(history: Iterable[Op]) -> list[Op]:
+    return [o for o in history if is_invoke(o)]
+
+
+def completions(history: Iterable[Op]) -> list[Op]:
+    return [o for o in history if not is_invoke(o) and is_client_op(o)]
+
+
+def oks(history: Iterable[Op]) -> list[Op]:
+    return [o for o in history if is_ok(o)]
+
+
+def filter_f(f: Any, history: Iterable[Op]) -> list[Op]:
+    return [o for o in history if o.get("f") == f]
+
+
+def client_ops(history: Iterable[Op]) -> list[Op]:
+    return [o for o in history if is_client_op(o)]
+
+
+def remove_failures(history: list[Op]) -> list[Op]:
+    """Drop invocations that definitely failed, plus their :fail completions.
+    :info (indeterminate) ops are preserved — they may have happened."""
+    failed: set[int] = set()
+    for inv, comp in pairs(history):
+        if comp is not None and is_fail(comp):
+            failed.add(id(inv))
+            failed.add(id(comp))
+    return [o for o in history if id(o) not in failed and not is_fail(o)]
+
+
+# ---------------------------------------------------------------------------
+# EDN interop (store compatibility with the reference layout)
+# ---------------------------------------------------------------------------
+
+_KEYWORD_FIELDS = ("type", "f")
+
+
+def op_to_edn(o: Op) -> str:
+    """Render one op as an EDN map line compatible with the reference's
+    history.edn (keyword keys; :type/:f as keywords)."""
+    m: dict = {}
+    for k, v in o.items():
+        key = Keyword(k)
+        if k in _KEYWORD_FIELDS and isinstance(v, str):
+            v = Keyword(v)
+        elif k == "process" and isinstance(v, str):
+            v = Keyword(v)
+        m[key] = v
+    return dumps(m)
+
+
+def history_to_edn(history: Iterable[Op]) -> str:
+    return "\n".join(op_to_edn(o) for o in history) + "\n"
+
+
+def op_from_edn_map(m: dict) -> Op:
+    """Convert a parsed EDN op map (Keyword keys) into a plain-string op."""
+    o: Op = {}
+    for k, v in m.items():
+        o[str(k)] = v
+    return o
+
+
+def history_from_edn(text: str) -> list[Op]:
+    """Parse a history.edn file (one op map per top-level form)."""
+    return [op_from_edn_map(m) for m in loads_all(text)]
+
+
+# ---------------------------------------------------------------------------
+# Latency / interval analytics (reference: jepsen/src/jepsen/util.clj:619-700)
+# ---------------------------------------------------------------------------
+
+def history_latencies(history: list[Op]) -> list[Op]:
+    """Annotate invocations with :latency (completion time - invoke time, ns)
+    and :completion-type. Pending ops get no latency."""
+    out = []
+    for inv, comp in pairs(history):
+        if not is_invoke(inv):
+            continue
+        o = dict(inv)
+        if comp is not None:
+            o["latency"] = comp.get("time", 0) - inv.get("time", 0)
+            o["completion-type"] = comp["type"]
+        out.append(o)
+    return out
+
+
+def nemesis_intervals(history: list[Op], start_fs: set | None = None,
+                      stop_fs: set | None = None) -> list[tuple[Op, Op | None]]:
+    """Pair up nemesis activation/deactivation ops into [start, stop] spans,
+    for shading fault windows on performance plots."""
+    start_fs = start_fs or {"start", "start-partition", "start-kill",
+                            "start-pause", "kill", "pause"}
+    stop_fs = stop_fs or {"stop", "stop-partition", "stop-kill", "stop-pause",
+                          "resume", "heal", "start!", "stop!"}
+    spans: list[tuple[Op, Op | None]] = []
+    current: Op | None = None
+    for o in history:
+        if o.get("process") != NEMESIS or is_invoke(o):
+            continue
+        f = o.get("f")
+        if f in start_fs and current is None:
+            current = o
+        elif f in stop_fs and current is not None:
+            spans.append((current, o))
+            current = None
+    if current is not None:
+        spans.append((current, None))
+    return spans

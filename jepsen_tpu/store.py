@@ -1,0 +1,228 @@
+"""Persistence: the on-disk store of test runs.
+
+Layout mirrors the reference (jepsen/src/jepsen/store.clj:29,118-140):
+
+    store/<test-name>/<start-time>/
+        history.edn     one op map per line (reference-compatible)
+        history.jsonl   same ops as JSON lines (fast native load path)
+        test.json       the serializable test map
+        results.edn     checker verdict (reference-compatible)
+        results.json    same verdict as JSON
+        jepsen.log      run log
+        ...             checker artifacts (plots, timelines)
+
+plus `current`/`latest` symlinks at both the store root and the test dir
+(store.clj:307-333). `save_1` persists the test+history before analysis so a
+crash during checking never loses data (core.clj:630); `save_2` adds results
+(store.clj:385-397).
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import shutil
+from pathlib import Path
+from typing import Any, Iterable
+
+from . import edn, history as h
+from .util import chunk_vec, real_pmap
+
+# Keys that never serialize (functions, live connections...).
+# Reference: store.clj:160-168.
+NONSERIALIZABLE_KEYS = (
+    "db", "os", "net", "client", "checker", "nemesis", "generator", "model",
+    "remote", "store", "logging", "barrier", "sessions", "args",
+)
+
+DEFAULT_BASE = "store"
+
+# History chunks are written in parallel above this size
+# (reference util.clj:208: threshold 16,384 ops).
+PARALLEL_WRITE_THRESHOLD = 16384
+
+
+def _stringify(v: Any) -> Any:
+    """Best-effort conversion of a test-map value to JSON-compatible data."""
+    if isinstance(v, dict):
+        return {str(k): _stringify(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_stringify(x) for x in v]
+    if isinstance(v, (set, frozenset)):
+        return sorted((_stringify(x) for x in v), key=repr)
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, datetime.datetime):
+        return v.isoformat()
+    return repr(v)
+
+
+class Store:
+    """A store rooted at `base` (default ./store)."""
+
+    def __init__(self, base: str | os.PathLike = DEFAULT_BASE):
+        self.base = Path(base)
+
+    # -- paths ------------------------------------------------------------
+
+    def test_dir(self, test: dict) -> Path:
+        name = test.get("name", "noname")
+        start = test.get("start-time")
+        if start is None:
+            start = datetime.datetime.now().strftime("%Y%m%dT%H%M%S.%f")[:-3]
+            test["start-time"] = start
+        return self.base / name / str(start)
+
+    def path(self, test: dict, *parts: str) -> Path:
+        p = self.test_dir(test).joinpath(*parts)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        return p
+
+    # -- symlinks (store.clj:307-333) --------------------------------------
+
+    def _relink(self, link: Path, target: Path) -> None:
+        link.parent.mkdir(parents=True, exist_ok=True)
+        if link.is_symlink() or link.exists():
+            link.unlink()
+        link.symlink_to(os.path.relpath(target, link.parent))
+
+    def update_symlinks(self, test: dict) -> None:
+        d = self.test_dir(test)
+        self._relink(d.parent / "latest", d)
+        self._relink(self.base / "latest", d)
+        self._relink(self.base / "current", d)
+
+    # -- writes -----------------------------------------------------------
+
+    def write_history(self, test: dict) -> None:
+        hist = test.get("history", [])
+        d = self.test_dir(test)
+        d.mkdir(parents=True, exist_ok=True)
+        if len(hist) > PARALLEL_WRITE_THRESHOLD:
+            chunks = chunk_vec(PARALLEL_WRITE_THRESHOLD, hist)
+            parts = real_pmap(
+                lambda c: (h.history_to_edn(c),
+                           "".join(json.dumps(_stringify(o)) + "\n" for o in c)),
+                chunks)
+            with open(d / "history.edn", "w") as fe, \
+                 open(d / "history.jsonl", "w") as fj:
+                for e_part, j_part in parts:
+                    fe.write(e_part)
+                    fj.write(j_part)
+        else:
+            (d / "history.edn").write_text(h.history_to_edn(hist) if hist else "")
+            (d / "history.jsonl").write_text(
+                "".join(json.dumps(_stringify(o)) + "\n" for o in hist))
+
+    def write_test(self, test: dict) -> None:
+        t = {k: _stringify(v) for k, v in test.items()
+             if k not in NONSERIALIZABLE_KEYS and k not in ("history", "results")}
+        p = self.path(test, "test.json")
+        p.write_text(json.dumps(t, indent=2, default=repr))
+
+    def write_results(self, test: dict) -> None:
+        res = test.get("results", {})
+        self.path(test, "results.json").write_text(
+            json.dumps(_stringify(res), indent=2, default=repr))
+        self.path(test, "results.edn").write_text(
+            edn.dumps(_results_to_edn(res)) + "\n")
+
+    def save_1(self, test: dict) -> dict:
+        """Persist test + history (before analysis)."""
+        self.write_test(test)
+        self.write_history(test)
+        self.update_symlinks(test)
+        return test
+
+    def save_2(self, test: dict) -> dict:
+        """Persist results (after analysis)."""
+        self.write_test(test)
+        self.write_results(test)
+        self.update_symlinks(test)
+        return test
+
+    # -- reads ------------------------------------------------------------
+
+    def tests(self) -> dict[str, dict[str, Path]]:
+        """Map of test-name -> {start-time -> dir} (store.clj:275)."""
+        out: dict[str, dict[str, Path]] = {}
+        if not self.base.exists():
+            return out
+        for name_dir in sorted(self.base.iterdir()):
+            if not name_dir.is_dir() or name_dir.name in ("latest", "current"):
+                continue
+            runs = {d.name: d for d in sorted(name_dir.iterdir())
+                    if d.is_dir() and d.name != "latest"}
+            if runs:
+                out[name_dir.name] = runs
+        return out
+
+    def all_run_dirs(self) -> list[Path]:
+        return [d for runs in self.tests().values() for d in runs.values()]
+
+    def latest(self) -> Path | None:
+        link = self.base / "latest"
+        if link.exists():
+            return link.resolve()
+        dirs = self.all_run_dirs()
+        # Most recent start-time across all test names.
+        return max(dirs, key=lambda d: d.name) if dirs else None
+
+    def load_history(self, run_dir: str | os.PathLike) -> list[h.Op]:
+        """Load a history from a run dir: prefers history.jsonl, falls back
+        to reference-format history.edn."""
+        d = Path(run_dir)
+        jl = d / "history.jsonl"
+        if jl.exists():
+            return [json.loads(line) for line in jl.read_text().splitlines()
+                    if line.strip()]
+        ed = d / "history.edn"
+        if ed.exists():
+            return h.history_from_edn(ed.read_text())
+        raise FileNotFoundError(f"no history in {d}")
+
+    def load_test(self, run_dir: str | os.PathLike) -> dict:
+        d = Path(run_dir)
+        test: dict = {}
+        tj = d / "test.json"
+        if tj.exists():
+            test = json.loads(tj.read_text())
+        test["history"] = self.load_history(d)
+        rj = d / "results.json"
+        if rj.exists():
+            test["results"] = json.loads(rj.read_text())
+        return test
+
+    def load_results(self, run_dir: str | os.PathLike) -> dict | None:
+        d = Path(run_dir)
+        rj = d / "results.json"
+        if rj.exists():
+            return json.loads(rj.read_text())
+        re_ = d / "results.edn"
+        if re_.exists():
+            v = edn.loads(re_.read_text())
+            return v if isinstance(v, dict) else None
+        return None
+
+    def delete(self, name: str | None = None) -> None:
+        """Delete a test's runs (or the whole store)."""
+        target = self.base / name if name else self.base
+        if target.exists():
+            shutil.rmtree(target)
+
+
+def _results_to_edn(v: Any) -> Any:
+    """Convert a results dict (string keys) to EDN with keyword keys."""
+    if isinstance(v, dict):
+        return {edn.Keyword(str(k)) if isinstance(k, str) else k:
+                _results_to_edn(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_results_to_edn(x) for x in v]
+    if isinstance(v, bool) or v is None or isinstance(v, (int, float)):
+        return v
+    if isinstance(v, (set, frozenset)):
+        return frozenset(_results_to_edn(x) for x in v)
+    if isinstance(v, str):
+        return edn.Keyword(v) if v in ("unknown", "valid", "invalid") else v
+    return repr(v)

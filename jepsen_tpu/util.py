@@ -1,0 +1,166 @@
+"""Small shared utilities.
+
+Counterparts of the reference's jepsen.util (jepsen/src/jepsen/util.clj):
+real_pmap (thread-per-element map with exception propagation, util.clj:59),
+majority (util.clj:78), relative-time plumbing (util.clj:290-330), retry
+loops (util.clj:359), and interval-set rendering (util.clj:548).
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Iterable, Sequence, TypeVar
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+
+def majority(n: int) -> int:
+    """Smallest majority of n nodes: majority(5) == 3; majority(0) == 1."""
+    return n // 2 + 1
+
+
+def minority(n: int) -> int:
+    """Largest strict minority: minority(5) == 2."""
+    return max((n - 1) // 2, 0) if n > 0 else 0
+
+
+def real_pmap(f: Callable[[T], R], coll: Sequence[T]) -> list[R]:
+    """Map f over coll with one thread per element, preserving order.
+    The first exception raised by any element propagates to the caller
+    (all threads are still joined first)."""
+    coll = list(coll)
+    if not coll:
+        return []
+    if len(coll) == 1:
+        return [f(coll[0])]
+    with ThreadPoolExecutor(max_workers=len(coll)) as ex:
+        return list(ex.map(f, coll))
+
+
+def bounded_pmap(f: Callable[[T], R], coll: Sequence[T],
+                 max_workers: int | None = None) -> list[R]:
+    """Parallel map with a bounded pool (used by the independent checker to
+    throttle per-key sub-checks; reference independent.clj:472-492)."""
+    import os
+    coll = list(coll)
+    if not coll:
+        return []
+    workers = min(len(coll), max_workers or (os.cpu_count() or 4))
+    with ThreadPoolExecutor(max_workers=workers) as ex:
+        return list(ex.map(f, coll))
+
+
+# ---------------------------------------------------------------------------
+# Relative time: histories are timestamped in nanoseconds from test start.
+# ---------------------------------------------------------------------------
+
+_relative_origin = threading.local()
+
+
+def linear_time_nanos() -> int:
+    return _time.monotonic_ns()
+
+
+class relative_time:
+    """Context manager establishing t=0 for the current test run."""
+
+    def __enter__(self):
+        _relative_origin.t0 = _time.monotonic_ns()
+        return self
+
+    def __exit__(self, *exc):
+        _relative_origin.t0 = None
+        return False
+
+
+def relative_time_nanos() -> int:
+    t0 = getattr(_relative_origin, "t0", None)
+    if t0 is None:
+        raise RuntimeError("relative_time_nanos called outside relative_time")
+    return _time.monotonic_ns() - t0
+
+
+def sleep_nanos(dt: int) -> None:
+    if dt > 0:
+        _time.sleep(dt / 1e9)
+
+
+class RetryFailed(Exception):
+    pass
+
+
+def with_retry(f: Callable[[], R], retries: int = 3, backoff: float = 0.0,
+               exceptions: tuple = (Exception,)) -> R:
+    """Call f, retrying up to `retries` times on the given exceptions."""
+    attempt = 0
+    while True:
+        try:
+            return f()
+        except exceptions:
+            attempt += 1
+            if attempt > retries:
+                raise
+            if backoff:
+                _time.sleep(backoff)
+
+
+def timeout_call(seconds: float, f: Callable[[], R], default: Any = None) -> Any:
+    """Run f in a worker thread; return `default` if it takes longer than
+    `seconds`. (The thread is abandoned, mirroring the reference's
+    util/timeout which interrupts; Python threads can't be killed, so
+    callers should make f cooperative where it matters.)"""
+    result: list = []
+
+    def run():
+        result.append(f())
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(seconds)
+    if t.is_alive():
+        return default
+    return result[0] if result else default
+
+
+def integer_interval_set_str(xs: Iterable[int]) -> str:
+    """Render a set of ints as compact intervals: '#{1..3 5 7..9}'
+    (reference util.clj:548 — used in set-full and counter results)."""
+    xs = sorted(set(xs))
+    if not xs:
+        return "#{}"
+    parts = []
+    lo = hi = xs[0]
+    for x in xs[1:]:
+        if x == hi + 1:
+            hi = x
+        else:
+            parts.append(f"{lo}" if lo == hi else f"{lo}..{hi}")
+            lo = hi = x
+    parts.append(f"{lo}" if lo == hi else f"{lo}..{hi}")
+    return "#{" + " ".join(parts) + "}"
+
+
+def longest_common_prefix(seqs: Sequence[Sequence]) -> list:
+    """Longest common prefix of several sequences (reference util.clj:703;
+    used by set-full's duplicate detection and version-order inference)."""
+    if not seqs:
+        return []
+    shortest = min(seqs, key=len)
+    for i, v in enumerate(shortest):
+        for s in seqs:
+            if s[i] != v:
+                return list(shortest[:i])
+    return list(shortest)
+
+
+def chunk_vec(n: int, xs: Sequence[T]) -> list[list[T]]:
+    """Split xs into chunks of at most n elements."""
+    return [list(xs[i : i + n]) for i in range(0, len(xs), n)]
+
+
+def name_of(x: Any) -> str:
+    """Human-readable name for fs/processes in results."""
+    return x if isinstance(x, str) else str(x)

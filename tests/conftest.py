@@ -1,0 +1,24 @@
+"""Test configuration.
+
+Runs JAX on a virtual 8-device CPU mesh so multi-chip sharding paths are
+exercised without TPU hardware (the driver separately dry-runs the multichip
+path; real-chip benchmarks happen in bench.py).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import random
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    random.seed(42)

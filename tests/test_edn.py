@@ -1,0 +1,76 @@
+"""EDN codec tests, including round-trips of reference-shaped op maps."""
+
+from jepsen_tpu import edn
+from jepsen_tpu.edn import Keyword, Symbol, Tagged
+
+
+def test_scalars():
+    assert edn.loads("nil") is None
+    assert edn.loads("true") is True
+    assert edn.loads("false") is False
+    assert edn.loads("42") == 42
+    assert edn.loads("-17") == -17
+    assert edn.loads("3.14") == 3.14
+    assert edn.loads("1e3") == 1000.0
+    assert edn.loads("42N") == 42
+    assert edn.loads('"hi\\nthere"') == "hi\nthere"
+    assert edn.loads("\\a") == "a"
+    assert edn.loads("\\newline") == "\n"
+
+
+def test_keywords_and_symbols():
+    k = edn.loads(":ok")
+    assert isinstance(k, Keyword)
+    assert k == "ok"  # str-subclass equality
+    assert edn.loads(":jepsen.history/op") == "jepsen.history/op"
+    s = edn.loads("foo/bar")
+    assert isinstance(s, Symbol)
+    assert s == "foo/bar"
+
+
+def test_collections():
+    assert edn.loads("[1 2 3]") == [1, 2, 3]
+    assert edn.loads("(1 2 3)") == (1, 2, 3)
+    assert edn.loads("{:a 1, :b 2}") == {"a": 1, "b": 2}
+    assert edn.loads("#{1 2 3}") == frozenset({1, 2, 3})
+    assert edn.loads("[[:r 5 [1 2]] [:append 5 3]]") == [
+        ["r", 5, [1, 2]], ["append", 5, 3]]
+
+
+def test_nested_and_comments():
+    text = """
+    ; a comment
+    {:type :invoke, :f :txn, :value [[:append 1 2]], #_:ignored #_:me
+     :process 0, :time 12345}
+    """
+    v = edn.loads(text)
+    assert v == {"type": "invoke", "f": "txn",
+                 "value": [["append", 1, 2]], "process": 0, "time": 12345}
+
+
+def test_tagged_and_records():
+    t = edn.loads("#foo [1 2]")
+    assert t == Tagged("foo", [1, 2])
+    rec = edn.loads("#knossos.model.CASRegister{:value 3}")
+    assert rec["value"] == 3
+    assert rec["edn/tag"] == "knossos.model.CASRegister"
+    inst = edn.loads('#inst "2020-01-01T00:00:00Z"')
+    assert inst.year == 2020
+
+
+def test_loads_all():
+    vs = edn.loads_all("{:a 1}\n{:b 2}\n; trailing comment\n")
+    assert vs == [{"a": 1}, {"b": 2}]
+
+
+def test_dumps_roundtrip():
+    v = {Keyword("type"): Keyword("ok"), Keyword("value"): [1, None, True,
+         "s"], Keyword("nested"): {Keyword("x"): frozenset({1, 2})}}
+    s = edn.dumps(v)
+    assert edn.loads(s) == {"type": "ok", "value": [1, None, True, "s"],
+                            "nested": {"x": frozenset({1, 2})}}
+
+
+def test_map_with_composite_keys():
+    v = edn.loads("{[1 :x] :a}")
+    assert v == {(1, "x"): "a"}

@@ -1,0 +1,86 @@
+"""History model tests (pairs/complete/index semantics)."""
+
+from jepsen_tpu import history as h
+
+
+def mk(type, process, f, value=None, **kw):
+    return h.op(type, process, f, value, **kw)
+
+
+def test_index():
+    hist = [mk("invoke", 0, "read"), mk("ok", 0, "read", 5)]
+    idx = h.index(hist)
+    assert [o["index"] for o in idx] == [0, 1]
+
+
+def test_pairs_basic():
+    hist = [
+        mk("invoke", 0, "read"),
+        mk("invoke", 1, "write", 3),
+        mk("ok", 1, "write", 3),
+        mk("ok", 0, "read", 3),
+    ]
+    ps = list(h.pairs(hist))
+    assert len(ps) == 2
+    assert ps[0][0]["process"] == 0 and ps[0][1]["type"] == "ok"
+    assert ps[1][0]["process"] == 1 and ps[1][1]["value"] == 3
+
+
+def test_pairs_pending_and_nemesis():
+    hist = [
+        mk("invoke", 0, "read"),
+        mk("info", "nemesis", "start-partition", "majority"),
+    ]
+    ps = list(h.pairs(hist))
+    assert ps[0][1] is None  # pending read
+    assert ps[1][0]["process"] == "nemesis" and ps[1][1] is None
+
+
+def test_complete_fills_read_values():
+    hist = [
+        mk("invoke", 0, "read"),
+        mk("ok", 0, "read", 7),
+    ]
+    c = h.complete(hist)
+    assert c[0]["value"] == 7
+
+
+def test_remove_failures():
+    hist = [
+        mk("invoke", 0, "write", 1),
+        mk("fail", 0, "write", 1),
+        mk("invoke", 1, "write", 2),
+        mk("info", 1, "write", 2),
+    ]
+    r = h.remove_failures(hist)
+    assert len(r) == 2
+    assert all(o["process"] == 1 for o in r)
+
+
+def test_edn_roundtrip():
+    hist = [
+        mk("invoke", 0, "txn", [["append", 1, 2], ["r", 1, None]], time=10),
+        mk("ok", 0, "txn", [["append", 1, 2], ["r", 1, [2]]], time=20),
+        mk("info", "nemesis", "start-partition", "majority", time=30),
+    ]
+    text = h.history_to_edn(hist)
+    back = h.history_from_edn(text)
+    assert back[0]["type"] == "invoke"
+    assert back[0]["f"] == "txn"
+    assert back[0]["value"] == [["append", 1, 2], ["r", 1, None]]
+    assert back[1]["value"][1] == ["r", 1, [2]]
+    assert back[2]["process"] == "nemesis"
+
+
+def test_latencies_and_intervals():
+    hist = [
+        mk("invoke", 0, "read", time=100),
+        mk("ok", 0, "read", 1, time=350),
+        mk("info", "nemesis", "start-partition", None, time=400),
+        mk("info", "nemesis", "stop-partition", None, time=900),
+    ]
+    lats = h.history_latencies(hist)
+    assert lats[0]["latency"] == 250
+    spans = h.nemesis_intervals(hist)
+    assert len(spans) == 1
+    assert spans[0][0]["time"] == 400 and spans[0][1]["time"] == 900

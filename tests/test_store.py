@@ -1,0 +1,63 @@
+"""Store layout and round-trip tests."""
+
+from jepsen_tpu import history as h
+from jepsen_tpu.store import Store
+
+
+def sample_test():
+    return {
+        "name": "store-test",
+        "start-time": "20260101T000000.000",
+        "nodes": ["n1", "n2"],
+        "checker": object(),  # nonserializable, must be dropped
+        "history": [
+            h.op("invoke", 0, "read", None, time=1),
+            h.op("ok", 0, "read", 5, time=2),
+        ],
+    }
+
+
+def test_save_and_load(tmp_path):
+    store = Store(tmp_path / "store")
+    t = sample_test()
+    store.save_1(t)
+    t["results"] = {"valid?": True, "count": 2}
+    store.save_2(t)
+
+    d = store.test_dir(t)
+    assert (d / "history.edn").exists()
+    assert (d / "history.jsonl").exists()
+    assert (d / "results.edn").exists()
+
+    loaded = store.load_test(d)
+    assert loaded["name"] == "store-test"
+    assert loaded["history"][1]["value"] == 5
+    assert loaded["results"]["valid?"] is True
+    # nonserializable key dropped
+    assert "checker" not in loaded
+
+    # symlinks
+    assert (tmp_path / "store" / "latest").resolve() == d.resolve()
+    assert store.latest().resolve() == d.resolve()
+
+
+def test_load_reference_edn_history(tmp_path):
+    """We can load a history written in the reference's EDN format alone."""
+    d = tmp_path / "run"
+    d.mkdir()
+    (d / "history.edn").write_text(
+        "{:type :invoke, :f :txn, :value [[:append 5 1]], :process 0, :time 10}\n"
+        "{:type :ok, :f :txn, :value [[:append 5 1]], :process 0, :time 20}\n")
+    store = Store(tmp_path)
+    hist = store.load_history(d)
+    assert hist[0]["f"] == "txn"
+    assert hist[0]["value"] == [["append", 5, 1]]
+
+
+def test_tests_registry(tmp_path):
+    store = Store(tmp_path / "store")
+    t = sample_test()
+    store.save_1(t)
+    reg = store.tests()
+    assert "store-test" in reg
+    assert "20260101T000000.000" in reg["store-test"]
