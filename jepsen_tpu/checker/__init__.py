@@ -1,0 +1,516 @@
+"""Checkers: analysis of histories.
+
+The `Checker` interface mirrors the reference protocol
+(jepsen/src/jepsen/checker.clj:49-69): `check(test, history, opts) ->
+{"valid?": True | False | "unknown", ...}`. `compose` runs a map of named
+checkers in parallel and merges validity with invalid < unknown < valid
+precedence (checker.clj:20-47,90-102).
+
+The built-in checkers here are the CPU oracles: pure data-in/data-out
+functions, golden-tested, that also serve as the differential references for
+the TPU kernel checkers in `checker.elle` and `checker.knossos`.
+"""
+
+from __future__ import annotations
+
+import traceback
+from collections import Counter
+from typing import Any, Callable
+
+from .. import history as h
+from ..util import integer_interval_set_str, real_pmap
+from . import models as model
+
+VALID_PRIORITIES = {True: 2, "unknown": 1, False: 0}
+
+
+def merge_valid(valids: list) -> Any:
+    """Merge validity values: false wins over unknown wins over true."""
+    out: Any = True
+    for v in valids:
+        if v not in VALID_PRIORITIES:
+            raise ValueError(f"{v!r} is not a known valid? value")
+        if VALID_PRIORITIES[v] < VALID_PRIORITIES[out]:
+            out = v
+    return out
+
+
+class Checker:
+    def check(self, test: dict, history: list, opts: dict) -> dict | None:
+        raise NotImplementedError
+
+
+class FnChecker(Checker):
+    """Wrap a function (test, history, opts) -> result as a Checker."""
+
+    def __init__(self, f: Callable[[dict, list, dict], dict | None]):
+        self.f = f
+
+    def check(self, test, history, opts):
+        return self.f(test, history, opts)
+
+
+def check_safe(checker: Checker, test: dict, history: list,
+               opts: dict | None = None) -> dict:
+    """Like check, but returns exceptions as {"valid?": "unknown"} results
+    (checker.clj:77-88)."""
+    try:
+        r = checker.check(test, history, opts or {})
+        return r if r is not None else {"valid?": True}
+    except Exception:
+        return {"valid?": "unknown", "error": traceback.format_exc()}
+
+
+class Noop(Checker):
+    def check(self, test, history, opts):
+        return None
+
+
+def noop() -> Checker:
+    return Noop()
+
+
+class UnbridledOptimism(Checker):
+    """Everything is awesome."""
+
+    def check(self, test, history, opts):
+        return {"valid?": True}
+
+
+def unbridled_optimism() -> Checker:
+    return UnbridledOptimism()
+
+
+class Compose(Checker):
+    def __init__(self, checker_map: dict[str, Checker]):
+        self.checker_map = checker_map
+
+    def check(self, test, history, opts):
+        items = list(self.checker_map.items())
+        results = real_pmap(
+            lambda kv: (kv[0], check_safe(kv[1], test, history, opts)), items)
+        out: dict = dict(results)
+        out["valid?"] = merge_valid([r.get("valid?", True) for _, r in results])
+        return out
+
+
+def compose(checker_map: dict[str, Checker]) -> Checker:
+    return Compose(checker_map)
+
+
+class ConcurrencyLimit(Checker):
+    """Bound concurrent executions of a memory-hungry checker
+    (checker.clj:104-119)."""
+
+    def __init__(self, limit: int, checker: Checker):
+        import threading
+        self.sem = threading.Semaphore(limit)
+        self.checker = checker
+
+    def check(self, test, history, opts):
+        with self.sem:
+            return self.checker.check(test, history, opts)
+
+
+def concurrency_limit(limit: int, checker: Checker) -> Checker:
+    return ConcurrencyLimit(limit, checker)
+
+
+class UnhandledExceptions(Checker):
+    """Aggregate crashed (:info) ops carrying errors, by error class,
+    in descending frequency (checker.clj:127-154)."""
+
+    def check(self, test, history, opts):
+        crashed = [o for o in history
+                   if h.is_info(o) and (o.get("exception") or o.get("error"))]
+        groups: dict[Any, list] = {}
+        for o in crashed:
+            exc = o.get("exception")
+            cls = (exc.get("class") if isinstance(exc, dict)
+                   else type(exc).__name__ if isinstance(exc, BaseException)
+                   else str(o.get("error", exc)))
+            groups.setdefault(cls, []).append(o)
+        exes = sorted(groups.items(), key=lambda kv: len(kv[1]), reverse=True)
+        if not exes:
+            return {"valid?": True}
+        return {"valid?": True,
+                "exceptions": [{"class": cls, "count": len(ops),
+                                "example": ops[0]} for cls, ops in exes]}
+
+
+def unhandled_exceptions() -> Checker:
+    return UnhandledExceptions()
+
+
+def _stats_of(ops: list) -> dict:
+    ok = sum(1 for o in ops if h.is_ok(o))
+    fail = sum(1 for o in ops if h.is_fail(o))
+    info = sum(1 for o in ops if h.is_info(o))
+    return {"valid?": ok > 0, "count": ok + fail + info,
+            "ok-count": ok, "fail-count": fail, "info-count": info}
+
+
+class Stats(Checker):
+    """Success/failure counts, overall and by :f. Valid only when every :f
+    saw at least one :ok (checker.clj:169-186)."""
+
+    def check(self, test, history, opts):
+        hist = [o for o in history
+                if not h.is_invoke(o) and o.get("process") != h.NEMESIS]
+        by_f: dict = {}
+        for o in hist:
+            by_f.setdefault(o.get("f"), []).append(o)
+        groups = {f: _stats_of(ops) for f, ops in sorted(
+            by_f.items(), key=lambda kv: str(kv[0]))}
+        out = _stats_of(hist)
+        out["by-f"] = groups
+        out["valid?"] = merge_valid([g["valid?"] for g in groups.values()])
+        return out
+
+
+def stats() -> Checker:
+    return Stats()
+
+
+class QueueChecker(Checker):
+    """Every dequeue must come from somewhere: assume every non-failing
+    enqueue succeeded and only ok dequeues happened, then fold through the
+    model (checker.clj:221-240). O(n); use an unordered queue model."""
+
+    def __init__(self, m: model.Model):
+        self.model = m
+
+    def check(self, test, history, opts):
+        state = self.model
+        for o in history:
+            f = o.get("f")
+            take = (h.is_invoke(o) if f == "enqueue"
+                    else h.is_ok(o) if f == "dequeue" else False)
+            if not take:
+                continue
+            state = state.step(o)
+            if model.is_inconsistent(state):
+                return {"valid?": False, "error": state.msg}
+        return {"valid?": True, "final-queue": repr(state)}
+
+
+def queue(m: model.Model | None = None) -> Checker:
+    return QueueChecker(m or model.unordered_queue())
+
+
+class SetChecker(Checker):
+    """:add ops followed by a final :read of the whole set
+    (checker.clj:243-302): every acknowledged add must be present; nothing
+    unexpected may appear."""
+
+    def check(self, test, history, opts):
+        attempts = {o.get("value") for o in history
+                    if h.is_invoke(o) and o.get("f") == "add"}
+        adds = {o.get("value") for o in history
+                if h.is_ok(o) and o.get("f") == "add"}
+        final_read = None
+        for o in history:
+            if h.is_ok(o) and o.get("f") == "read":
+                final_read = o.get("value")
+        if final_read is None:
+            return {"valid?": "unknown", "error": "Set was never read"}
+        final = {v for v in final_read} if not isinstance(final_read, (set, frozenset)) else set(final_read)
+        ok = final & attempts
+        unexpected = final - attempts
+        lost = adds - final
+        recovered = ok - adds
+        return {
+            "valid?": not lost and not unexpected,
+            "attempt-count": len(attempts),
+            "acknowledged-count": len(adds),
+            "ok-count": len(ok),
+            "lost-count": len(lost),
+            "recovered-count": len(recovered),
+            "unexpected-count": len(unexpected),
+            "ok": integer_interval_set_str(ok),
+            "lost": integer_interval_set_str(lost),
+            "unexpected": integer_interval_set_str(unexpected),
+            "recovered": integer_interval_set_str(recovered),
+        }
+
+
+def set_checker() -> Checker:
+    return SetChecker()
+
+
+class _SetFullElement:
+    """Per-element timeline state for set-full (checker.clj:305-341)."""
+
+    __slots__ = ("element", "known", "last_present", "last_absent")
+
+    def __init__(self, element):
+        self.element = element
+        self.known = None          # completion op that proved existence
+        self.last_present = None   # latest read invocation that observed it
+        self.last_absent = None    # latest read invocation that missed it
+
+    def add_ok(self, op):
+        if self.known is None:
+            self.known = op
+
+    def read_present(self, iop, op):
+        if self.known is None:
+            self.known = op
+        if self.last_present is None or \
+                self.last_present["index"] < iop["index"]:
+            self.last_present = iop
+
+    def read_absent(self, iop, op):
+        if self.last_absent is None or \
+                self.last_absent["index"] < iop["index"]:
+            self.last_absent = iop
+
+
+def _idx(op, default=-1):
+    return op["index"] if op is not None else default
+
+
+def _set_full_element_results(e: _SetFullElement) -> dict:
+    known_time = e.known.get("time") if e.known else None
+    stable = bool(e.last_present is not None and
+                  _idx(e.last_absent) < _idx(e.last_present))
+    # An absent read concurrent with the add could have linearized before it;
+    # require the miss to begin after the add was known complete
+    # (checker.clj:368-383).
+    lost = bool(e.known is not None and e.last_absent is not None and
+                _idx(e.last_present) < _idx(e.last_absent) and
+                _idx(e.known) < _idx(e.last_absent))
+    stable_time = ((e.last_absent["time"] + 1 if e.last_absent else 0)
+                   if stable else None)
+    lost_time = ((e.last_present["time"] + 1 if e.last_present else 0)
+                 if lost else None)
+    stable_latency = (max(0, stable_time - known_time) // 1_000_000
+                      if stable else None)
+    lost_latency = (max(0, lost_time - known_time) // 1_000_000
+                    if lost else None)
+    return {"element": e.element,
+            "outcome": ("stable" if stable else
+                        "lost" if lost else "never-read"),
+            "stable-latency": stable_latency,
+            "lost-latency": lost_latency,
+            "known": e.known,
+            "last-absent": e.last_absent}
+
+
+def frequency_distribution(points: list[float], xs: list) -> dict | None:
+    xs = sorted(xs)
+    if not xs:
+        return None
+    n = len(xs)
+    return {p: xs[min(n - 1, int(n * p))] for p in points}
+
+
+class SetFull(Checker):
+    """Rigorous set analysis: per-element stable/lost/never-read outcomes
+    with latency distributions (checker.clj:464-595). With
+    linearizable=True, stale reads (nonzero stable latency) are invalid.
+
+    Note: the reference's duplicate filter compares multiplicity < 1
+    (checker.clj:571), which can never fire; we implement the evident
+    intent — elements appearing more than once in a single read."""
+
+    def __init__(self, linearizable: bool = False):
+        self.linearizable = linearizable
+
+    def check(self, test, history, opts):
+        elements: dict[Any, _SetFullElement] = {}
+        reads: dict[Any, dict] = {}   # process -> read invocation
+        dups: dict[Any, int] = {}     # element -> max multiplicity > 1
+        for o in history:
+            if not h.is_client_op(o):
+                continue
+            f, p = o.get("f"), o.get("process")
+            if f == "add":
+                v = o.get("value")
+                if h.is_invoke(o):
+                    elements.setdefault(v, _SetFullElement(v))
+                elif h.is_ok(o) and v in elements:
+                    elements[v].add_ok(o)
+            elif f == "read":
+                if h.is_invoke(o):
+                    reads[p] = o
+                elif h.is_fail(o):
+                    reads.pop(p, None)
+                elif h.is_ok(o):
+                    iop = reads.pop(p, o)
+                    vals = o.get("value") or []
+                    for el, n in Counter(vals).items():
+                        if n > 1:
+                            dups[el] = max(dups.get(el, 0), n)
+                    vset = set(vals)
+                    for el, state in elements.items():
+                        if el in vset:
+                            state.read_present(iop, o)
+                        else:
+                            state.read_absent(iop, o)
+        rs = [_set_full_element_results(e) for _, e in sorted(
+            elements.items(), key=lambda kv: repr(kv[0]))]
+        outcomes: dict[str, list] = {}
+        for r in rs:
+            outcomes.setdefault(r["outcome"], []).append(r)
+        stable = outcomes.get("stable", [])
+        lost = outcomes.get("lost", [])
+        never_read = outcomes.get("never-read", [])
+        stale = [r for r in stable if r["stable-latency"] > 0]
+        stable_lat = [r["stable-latency"] for r in rs
+                      if r["stable-latency"] is not None]
+        lost_lat = [r["lost-latency"] for r in rs
+                    if r["lost-latency"] is not None]
+        valid: Any = (False if lost else
+                      "unknown" if not stable else
+                      False if self.linearizable and stale else
+                      True)
+        out = {
+            "valid?": False if dups else valid,
+            "attempt-count": len(rs),
+            "stable-count": len(stable),
+            "lost-count": len(lost),
+            "lost": sorted((r["element"] for r in lost), key=repr),
+            "never-read-count": len(never_read),
+            "never-read": sorted((r["element"] for r in never_read), key=repr),
+            "stale-count": len(stale),
+            "stale": sorted((r["element"] for r in stale), key=repr),
+            "worst-stale": sorted(stale, key=lambda r: r["stable-latency"],
+                                  reverse=True)[:8],
+            "duplicated-count": len(dups),
+            "duplicated": dict(sorted(dups.items(), key=lambda kv: repr(kv[0]))),
+        }
+        points = [0, 0.5, 0.95, 0.99, 1]
+        fd = frequency_distribution(points, stable_lat)
+        if fd:
+            out["stable-latencies"] = fd
+        fd = frequency_distribution(points, lost_lat)
+        if fd:
+            out["lost-latencies"] = fd
+        return out
+
+
+def set_full(linearizable: bool = False) -> Checker:
+    return SetFull(linearizable)
+
+
+def expand_queue_drain_ops(history: list) -> list:
+    """Expand ok :drain ops (value = list of elements) into dequeue
+    invoke/ok pairs (checker.clj:598-628)."""
+    out = []
+    for o in history:
+        if o.get("f") != "drain":
+            out.append(o)
+        elif h.is_invoke(o) or h.is_fail(o):
+            continue
+        elif h.is_ok(o):
+            for el in o.get("value") or []:
+                out.append({**o, "type": "invoke", "f": "dequeue", "value": None})
+                out.append({**o, "type": "ok", "f": "dequeue", "value": el})
+        else:
+            raise ValueError(f"can't handle a crashed drain operation: {o!r}")
+    return out
+
+
+class TotalQueue(Checker):
+    """What goes in must come out — multiset accounting over enqueues and
+    dequeues, with drains expanded (checker.clj:631-690)."""
+
+    def check(self, test, history, opts):
+        hist = expand_queue_drain_ops(history)
+        attempts = Counter(o.get("value") for o in hist
+                           if h.is_invoke(o) and o.get("f") == "enqueue")
+        enqueues = Counter(o.get("value") for o in hist
+                           if h.is_ok(o) and o.get("f") == "enqueue")
+        dequeues = Counter(o.get("value") for o in hist
+                           if h.is_ok(o) and o.get("f") == "dequeue")
+        ok = dequeues & attempts
+        unexpected = Counter({v: n for v, n in dequeues.items()
+                              if v not in attempts})
+        duplicated = dequeues - attempts - unexpected
+        lost = enqueues - dequeues
+        recovered = ok - enqueues
+        return {
+            "valid?": not lost and not unexpected,
+            "attempt-count": sum(attempts.values()),
+            "acknowledged-count": sum(enqueues.values()),
+            "ok-count": sum(ok.values()),
+            "unexpected-count": sum(unexpected.values()),
+            "duplicated-count": sum(duplicated.values()),
+            "lost-count": sum(lost.values()),
+            "recovered-count": sum(recovered.values()),
+            "lost": dict(lost),
+            "unexpected": dict(unexpected),
+            "duplicated": dict(duplicated),
+            "recovered": dict(recovered),
+        }
+
+
+def total_queue() -> Checker:
+    return TotalQueue()
+
+
+class UniqueIds(Checker):
+    """A unique-id generator must emit distinct values
+    (checker.clj:692-737)."""
+
+    def check(self, test, history, opts):
+        attempted = sum(1 for o in history
+                        if h.is_invoke(o) and o.get("f") == "generate")
+        acks = [o.get("value") for o in history
+                if h.is_ok(o) and o.get("f") == "generate"]
+        counts = Counter(acks)
+        dups = {v: n for v, n in counts.items() if n > 1}
+        rng = [min(acks), max(acks)] if acks else [None, None]
+        worst = dict(sorted(dups.items(), key=lambda kv: kv[1],
+                            reverse=True)[:48])
+        return {"valid?": not dups,
+                "attempted-count": attempted,
+                "acknowledged-count": len(acks),
+                "duplicated-count": len(dups),
+                "duplicated": worst,
+                "range": rng}
+
+
+def unique_ids() -> Checker:
+    return UniqueIds()
+
+
+class CounterChecker(Checker):
+    """A counter incremented by :add ops and observed by :read ops: each read
+    must lie within [sum of ok increments + attempted decrements, sum of
+    attempted increments + ok decrements] at its window (checker.clj:740-795).
+    """
+
+    def check(self, test, history, opts):
+        # Apply completion values to invocations; drop definite failures.
+        hist = h.remove_failures(h.complete(h.index(history)))
+        lower = upper = 0
+        pending_reads: dict = {}
+        reads: list = []
+        for o in hist:
+            key = (o.get("type"), o.get("f"))
+            p = o.get("process")
+            v = o.get("value")
+            if key == ("invoke", "read"):
+                pending_reads[p] = [lower, v]
+            elif key == ("ok", "read"):
+                r = pending_reads.pop(p, [lower, v])
+                reads.append([r[0], r[1], upper])
+            elif key == ("invoke", "add"):
+                if v >= 0:
+                    upper += v
+                else:
+                    lower += v
+            elif key == ("ok", "add"):
+                if v >= 0:
+                    lower += v
+                else:
+                    upper += v
+        errors = [r for r in reads
+                  if r[1] is None or not (r[0] <= r[1] <= r[2])]
+        return {"valid?": not errors, "reads": reads, "errors": errors}
+
+
+def counter() -> Checker:
+    return CounterChecker()

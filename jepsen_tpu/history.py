@@ -25,7 +25,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Iterable, Iterator
 
-from .edn import Keyword, dumps, loads_all
+from .edn import dumps, loads_all
 
 Op = dict  # documentation alias
 
@@ -164,21 +164,20 @@ def remove_failures(history: list[Op]) -> list[Op]:
 # EDN interop (store compatibility with the reference layout)
 # ---------------------------------------------------------------------------
 
-_KEYWORD_FIELDS = ("type", "f")
+# Fields whose string content is free text, not keyword-ish data.
+_TEXT_FIELDS = ("error",)
 
 
 def op_to_edn(o: Op) -> str:
     """Render one op as an EDN map line compatible with the reference's
-    history.edn (keyword keys; :type/:f as keywords)."""
-    m: dict = {}
+    history.edn: keyword keys, and keyword-safe strings (op types, :f names,
+    txn micro-op kinds like :append, nemesis targets like :majority) emitted
+    as keywords — except free-text fields such as :error."""
+    parts = []
     for k, v in o.items():
-        key = Keyword(k)
-        if k in _KEYWORD_FIELDS and isinstance(v, str):
-            v = Keyword(v)
-        elif k == "process" and isinstance(v, str):
-            v = Keyword(v)
-        m[key] = v
-    return dumps(m)
+        keywordize = k not in _TEXT_FIELDS
+        parts.append(f":{k} {dumps(v, keywordize=keywordize)}")
+    return "{" + ", ".join(parts) + "}"
 
 
 def history_to_edn(history: Iterable[Op]) -> str:
@@ -221,10 +220,12 @@ def nemesis_intervals(history: list[Op], start_fs: set | None = None,
                       stop_fs: set | None = None) -> list[tuple[Op, Op | None]]:
     """Pair up nemesis activation/deactivation ops into [start, stop] spans,
     for shading fault windows on performance plots."""
-    start_fs = start_fs or {"start", "start-partition", "start-kill",
-                            "start-pause", "kill", "pause"}
-    stop_fs = stop_fs or {"stop", "stop-partition", "stop-kill", "stop-pause",
-                          "resume", "heal", "start!", "stop!"}
+    if start_fs is None:
+        start_fs = {"start", "start-partition", "start-kill",
+                    "start-pause", "kill", "pause"}
+    if stop_fs is None:
+        stop_fs = {"stop", "stop-partition", "stop-kill", "stop-pause",
+                   "resume", "heal", "start!", "stop!"}
     spans: list[tuple[Op, Op | None]] = []
     current: Op | None = None
     for o in history:

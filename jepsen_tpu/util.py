@@ -109,17 +109,24 @@ def with_retry(f: Callable[[], R], retries: int = 3, backoff: float = 0.0,
 
 def timeout_call(seconds: float, f: Callable[[], R], default: Any = None) -> Any:
     """Run f in a worker thread; return `default` if it takes longer than
-    `seconds`. (The thread is abandoned, mirroring the reference's
-    util/timeout which interrupts; Python threads can't be killed, so
-    callers should make f cooperative where it matters.)"""
+    `seconds`; exceptions from f propagate to the caller. (On timeout the
+    thread is abandoned, mirroring the reference's util/timeout which
+    interrupts; Python threads can't be killed, so callers should make f
+    cooperative where it matters.)"""
     result: list = []
+    error: list = []
 
     def run():
-        result.append(f())
+        try:
+            result.append(f())
+        except BaseException as e:  # noqa: BLE001 - relayed to caller
+            error.append(e)
 
     t = threading.Thread(target=run, daemon=True)
     t.start()
     t.join(seconds)
+    if error:
+        raise error[0]
     if t.is_alive():
         return default
     return result[0] if result else default

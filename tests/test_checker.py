@@ -1,0 +1,361 @@
+"""Checker golden tests.
+
+Scenarios and expected verdicts transcribed from the reference's behavior
+(jepsen/test/jepsen/checker_test.clj) — these are the oracles the TPU
+kernels must also match.
+"""
+
+import pytest
+
+from jepsen_tpu import checker as c
+from jepsen_tpu.checker import models as model
+
+
+def invoke_op(process, f, value=None):
+    return {"type": "invoke", "process": process, "f": f, "value": value}
+
+
+def ok_op(process, f, value=None):
+    return {"type": "ok", "process": process, "f": f, "value": value}
+
+
+def fail_op(process, f, value=None):
+    return {"type": "fail", "process": process, "f": f, "value": value}
+
+
+def info_op(process, f, value=None):
+    return {"type": "info", "process": process, "f": f, "value": value}
+
+
+def check(ch, history, test=None, opts=None):
+    return ch.check(test or {}, history, opts or {})
+
+
+def with_times(history):
+    """Add 1ms-spaced times and indexes (checker_test.clj history helper)."""
+    out = []
+    for i, o in enumerate(history):
+        out.append({**o, "index": i, "time": i * 1_000_000})
+    return out
+
+
+# -- merge-valid / compose -------------------------------------------------
+
+def test_merge_valid():
+    assert c.merge_valid([]) is True
+    assert c.merge_valid([True, True]) is True
+    assert c.merge_valid([True, "unknown"]) == "unknown"
+    assert c.merge_valid([True, "unknown", False]) is False
+    with pytest.raises(ValueError):
+        c.merge_valid([None])
+
+
+def test_compose():
+    r = check(c.compose({"a": c.unbridled_optimism(),
+                         "b": c.unbridled_optimism()}), [])
+    assert r == {"a": {"valid?": True}, "b": {"valid?": True}, "valid?": True}
+
+
+def test_compose_propagates_invalid_and_errors():
+    class Boom(c.Checker):
+        def check(self, test, history, opts):
+            raise RuntimeError("boom")
+
+    r = check(c.compose({"good": c.unbridled_optimism(), "bad": Boom()}), [])
+    assert r["valid?"] == "unknown"
+    assert r["bad"]["valid?"] == "unknown"
+    assert "boom" in r["bad"]["error"]
+
+
+def test_check_safe():
+    r = c.check_safe(c.noop(), {}, [])
+    assert r == {"valid?": True}
+
+
+# -- stats ----------------------------------------------------------------
+
+def test_stats():
+    r = check(c.stats(), [
+        ok_op(0, "foo"), ok_op(0, "foo"),
+        ok_op(0, "bar"), info_op(0, "bar"), fail_op(0, "bar"),
+    ])
+    assert r["valid?"] is True
+    assert r["count"] == 5
+    assert r["by-f"]["bar"] == {"valid?": True, "count": 3, "ok-count": 1,
+                                "fail-count": 1, "info-count": 1}
+
+
+def test_stats_invalid_when_f_has_no_oks():
+    r = check(c.stats(), [ok_op(0, "foo"), fail_op(0, "bar")])
+    assert r["valid?"] is False
+    assert r["by-f"]["bar"]["valid?"] is False
+
+
+def test_stats_ignores_nemesis_and_invokes():
+    r = check(c.stats(), [
+        invoke_op(0, "foo"), ok_op(0, "foo"),
+        info_op("nemesis", "start-partition"),
+    ])
+    assert r["count"] == 1
+
+
+# -- queue ----------------------------------------------------------------
+
+def test_queue():
+    q = lambda: c.queue(model.unordered_queue())
+    assert check(q(), [])["valid?"] is True
+    # possible enqueue, no dequeue
+    assert check(q(), [invoke_op(1, "enqueue", 1)])["valid?"] is True
+    # definite enqueue, no dequeue
+    assert check(q(), [ok_op(1, "enqueue", 1)])["valid?"] is True
+    # concurrent enqueue/dequeue
+    assert check(q(), [invoke_op(2, "dequeue"),
+                       invoke_op(1, "enqueue", 1),
+                       ok_op(2, "dequeue", 1)])["valid?"] is True
+    # dequeue but no enqueue
+    assert check(q(), [ok_op(1, "dequeue", 1)])["valid?"] is False
+
+
+# -- total-queue ----------------------------------------------------------
+
+def test_total_queue_sane():
+    r = check(c.total_queue(), [
+        invoke_op(1, "enqueue", 1),
+        invoke_op(2, "enqueue", 2), ok_op(2, "enqueue", 2),
+        invoke_op(3, "dequeue", 1), ok_op(3, "dequeue", 1),
+        invoke_op(3, "dequeue", 2), ok_op(3, "dequeue", 2),
+    ])
+    assert r["valid?"] is True
+    assert r["attempt-count"] == 2
+    assert r["acknowledged-count"] == 1
+    assert r["ok-count"] == 2
+    assert r["recovered-count"] == 1
+    assert r["lost-count"] == 0
+
+
+def test_total_queue_pathological():
+    r = check(c.total_queue(), [
+        invoke_op(1, "enqueue", "hung"),
+        invoke_op(2, "enqueue", "enqueued"), ok_op(2, "enqueue", "enqueued"),
+        invoke_op(3, "enqueue", "dup"), ok_op(3, "enqueue", "dup"),
+        invoke_op(4, "dequeue"),
+        invoke_op(5, "dequeue"), ok_op(5, "dequeue", "wtf"),
+        invoke_op(6, "dequeue"), ok_op(6, "dequeue", "dup"),
+        invoke_op(7, "dequeue"), ok_op(7, "dequeue", "dup"),
+    ])
+    assert r["valid?"] is False
+    assert r["lost"] == {"enqueued": 1}
+    assert r["unexpected"] == {"wtf": 1}
+    assert r["duplicated"] == {"dup": 1}
+    assert r["attempt-count"] == 3
+    assert r["acknowledged-count"] == 2
+    assert r["ok-count"] == 1
+    assert r["recovered-count"] == 0
+
+
+def test_total_queue_drain():
+    r = check(c.total_queue(), [
+        invoke_op(1, "enqueue", 1), ok_op(1, "enqueue", 1),
+        invoke_op(2, "enqueue", 2), ok_op(2, "enqueue", 2),
+        invoke_op(3, "drain"), ok_op(3, "drain", [1, 2]),
+    ])
+    assert r["valid?"] is True
+    assert r["ok-count"] == 2
+
+
+# -- set ------------------------------------------------------------------
+
+def test_set_never_read():
+    r = check(c.set_checker(), [invoke_op(0, "add", 0), ok_op(0, "add", 0)])
+    assert r["valid?"] == "unknown"
+
+
+def test_set_valid_and_lost():
+    base = [invoke_op(0, "add", 0), ok_op(0, "add", 0),
+            invoke_op(1, "add", 1),  # indeterminate
+            invoke_op(2, "add", 2), fail_op(2, "add", 2)]
+    ok_read = base + [invoke_op(3, "read"), ok_op(3, "read", [0, 1])]
+    r = check(c.set_checker(), ok_read)
+    assert r["valid?"] is True
+    assert r["ok-count"] == 2
+    assert r["recovered-count"] == 1  # 1 recovered, never acked
+
+    lost_read = base + [invoke_op(3, "read"), ok_op(3, "read", [1])]
+    r = check(c.set_checker(), lost_read)
+    assert r["valid?"] is False
+    assert r["lost-count"] == 1
+    assert r["lost"] == "#{0}"
+
+    unexpected = base + [invoke_op(3, "read"), ok_op(3, "read", [0, 99])]
+    r = check(c.set_checker(), unexpected)
+    assert r["valid?"] is False
+    assert r["unexpected"] == "#{99}"
+
+
+# -- set-full -------------------------------------------------------------
+
+def sf(history, linearizable=False):
+    return check(c.set_full(linearizable), with_times(history))
+
+
+def test_set_full_never_read():
+    r = sf([invoke_op(0, "add", 0), ok_op(0, "add", 0)])
+    assert r["valid?"] == "unknown"
+    assert r["never-read"] == [0]
+    assert r["never-read-count"] == 1
+    assert r["stable-count"] == 0
+
+
+def test_set_full_never_confirmed_never_read():
+    a, r_, rm = invoke_op(0, "add", 0), invoke_op(1, "read"), ok_op(1, "read", [])
+    res = sf([a, r_, rm])
+    assert res["valid?"] == "unknown"
+    assert res["never-read"] == [0]
+
+
+def test_set_full_stable_all_windows():
+    a, a2 = invoke_op(0, "add", 0), ok_op(0, "add", 0)
+    r_, rp = invoke_op(1, "read"), ok_op(1, "read", [0])
+    for hist in ([r_, a, rp, a2], [r_, a, a2, rp], [a, r_, rp, a2],
+                 [a, r_, a2, rp], [a, a2, r_, rp]):
+        res = sf(hist)
+        assert res["valid?"] is True, hist
+        assert res["stable-count"] == 1
+        assert res["stable-latencies"] == {0: 0, 0.5: 0, 0.95: 0, 0.99: 0, 1: 0}
+
+
+def test_set_full_lost_after():
+    a, a2 = invoke_op(0, "add", 0), ok_op(0, "add", 0)
+    r_, rm = invoke_op(1, "read"), ok_op(1, "read", [])
+    res = sf([a, a2, r_, rm])
+    assert res["valid?"] is False
+    assert res["lost"] == [0]
+    assert res["lost-latencies"] == {0: 0, 0.5: 0, 0.95: 0, 0.99: 0, 1: 0}
+
+
+def test_set_full_absent_concurrent_read_is_never_read():
+    a, a2 = invoke_op(0, "add", 0), ok_op(0, "add", 0)
+    r_, rm = invoke_op(1, "read"), ok_op(1, "read", [])
+    res = sf([a, r_, rm, a2])
+    assert res["valid?"] == "unknown"
+    assert res["never-read"] == [0]
+
+
+def test_set_full_stale_linearizable():
+    # Add completes; a later read misses it; a still-later read sees it.
+    hist = [invoke_op(0, "add", 0), ok_op(0, "add", 0),
+            invoke_op(1, "read"), ok_op(1, "read", []),
+            invoke_op(1, "read"), ok_op(1, "read", [0])]
+    res = sf(hist)
+    assert res["valid?"] is True
+    assert res["stale"] == [0]
+    res = sf(hist, linearizable=True)
+    assert res["valid?"] is False
+
+
+def test_set_full_duplicates():
+    hist = [invoke_op(0, "add", 0), ok_op(0, "add", 0),
+            invoke_op(1, "read"), ok_op(1, "read", [0, 0])]
+    res = sf(hist)
+    assert res["valid?"] is False
+    assert res["duplicated"] == {0: 2}
+
+
+# -- unique-ids -----------------------------------------------------------
+
+def test_unique_ids():
+    r = check(c.unique_ids(), [
+        invoke_op(0, "generate"), ok_op(0, "generate", 1),
+        invoke_op(0, "generate"), ok_op(0, "generate", 2),
+    ])
+    assert r["valid?"] is True
+    assert r["range"] == [1, 2]
+
+    r = check(c.unique_ids(), [
+        invoke_op(0, "generate"), ok_op(0, "generate", 1),
+        invoke_op(0, "generate"), ok_op(0, "generate", 1),
+    ])
+    assert r["valid?"] is False
+    assert r["duplicated"] == {1: 2}
+
+
+# -- counter --------------------------------------------------------------
+
+def test_counter_empty():
+    assert check(c.counter(), []) == {"valid?": True, "reads": [], "errors": []}
+
+
+def test_counter_initial_read():
+    r = check(c.counter(), with_times([invoke_op(0, "read"), ok_op(0, "read", 0)]))
+    assert r == {"valid?": True, "reads": [[0, 0, 0]], "errors": []}
+
+
+def test_counter_ignores_failed_ops():
+    r = check(c.counter(), with_times([
+        invoke_op(0, "add", 1), fail_op(0, "add", 1),
+        invoke_op(0, "read"), ok_op(0, "read", 0)]))
+    assert r == {"valid?": True, "reads": [[0, 0, 0]], "errors": []}
+
+
+def test_counter_initial_invalid_read():
+    r = check(c.counter(), with_times([invoke_op(0, "read"), ok_op(0, "read", 1)]))
+    assert r == {"valid?": False, "reads": [[0, 1, 0]], "errors": [[0, 1, 0]]}
+
+
+def test_counter_interleaved():
+    r = check(c.counter(), with_times([
+        invoke_op(0, "read"),
+        invoke_op(1, "add", 1),
+        invoke_op(2, "read"),
+        invoke_op(3, "add", 2),
+        invoke_op(4, "read"),
+        invoke_op(5, "add", 4),
+        invoke_op(6, "read"),
+        invoke_op(7, "add", 8),
+        invoke_op(8, "read"),
+        ok_op(0, "read", 6),
+        ok_op(1, "add", 1),
+        ok_op(2, "read", 0),
+        ok_op(3, "add", 2),
+        ok_op(4, "read", 3),
+        ok_op(5, "add", 4),
+        ok_op(6, "read", 100),
+        ok_op(7, "add", 8),
+        ok_op(8, "read", 15),
+    ]))
+    assert r["valid?"] is False
+    assert r["reads"] == [[0, 6, 15], [0, 0, 15], [0, 3, 15],
+                          [0, 100, 15], [0, 15, 15]]
+    assert r["errors"] == [[0, 100, 15]]
+
+
+def test_counter_rolling():
+    r = check(c.counter(), with_times([
+        invoke_op(0, "read"),
+        invoke_op(1, "add", 1),
+        ok_op(0, "read", 0),
+        invoke_op(0, "read"),
+        ok_op(1, "add", 1),
+        invoke_op(1, "add", 2),
+        ok_op(0, "read", 3),
+        invoke_op(0, "read"),
+        ok_op(1, "add", 2),
+        ok_op(0, "read", 5),
+    ]))
+    assert r["valid?"] is False
+    assert r["reads"] == [[0, 0, 1], [0, 3, 3], [1, 5, 3]]
+    assert r["errors"] == [[1, 5, 3]]
+
+
+# -- unhandled exceptions --------------------------------------------------
+
+def test_unhandled_exceptions():
+    r = check(c.unhandled_exceptions(), [
+        info_op(0, "read"),
+        {**info_op(1, "read"), "error": "timeout"},
+        {**info_op(2, "read"), "error": "timeout"},
+        {**info_op(3, "read"), "error": "conn-refused"},
+    ])
+    assert r["valid?"] is True
+    assert r["exceptions"][0]["class"] == "timeout"
+    assert r["exceptions"][0]["count"] == 2
