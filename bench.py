@@ -1,0 +1,71 @@
+"""Benchmark: Elle list-append cycle checking throughput on device.
+
+Measures the north-star metric (BASELINE.json): histories checked per
+second for 10k-op (≈5k-txn) list-append histories. The device phase under
+test is the full dependency-edge build + transitive-closure cycle
+detection (detect mode: one closure per history — the common all-valid
+path; classification of cyclic histories is a second pass over the rare
+positives).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "histories/sec", "vs_baseline": N}
+
+vs_baseline is measured against the north-star rate of 10,000 histories /
+60 s = 166.7 hist/s on a v5e-8; on a single chip the fair share is 1/8 of
+that (20.8 hist/s). Scale via BENCH_B / BENCH_T / BENCH_K env vars.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> int:
+    import jax
+    import numpy as np
+
+    from jepsen_tpu import parallel
+    from jepsen_tpu.checker.elle import synth
+    from jepsen_tpu.devices import default_devices
+
+    devices = default_devices()
+    n_dev = len(devices)
+    # Default shape: 10k-op histories (5k txns) like the north-star config;
+    # batch sized to amortize dispatch while fitting one chip's HBM.
+    B = int(os.environ.get("BENCH_B", 8 * max(1, n_dev)))
+    T = int(os.environ.get("BENCH_T", 5000))
+    K = int(os.environ.get("BENCH_K", 64))
+    reps = int(os.environ.get("BENCH_REPS", 3))
+
+    batch = synth.synth_valid_batch(B=B, T=T, K=K, seed=0)
+    shape = batch["shape"]
+    mesh = parallel.make_mesh(devices) if n_dev > 1 else None
+    fn = parallel.sharded_check_fn(mesh, shape, classify=False)
+    args = parallel.shard_batch(mesh, batch)
+
+    # Compile + warmup.
+    flags = np.asarray(jax.block_until_ready(fn(*args)))
+    assert (flags == 0).all(), "valid histories flagged cyclic"
+
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+
+    rate = B / best
+    target = 10_000 / 60.0 * (n_dev / 8.0)  # north-star scaled to chip count
+    print(json.dumps({
+        "metric": f"elle-append histories/sec ({T}-txn, {n_dev} dev)",
+        "value": round(rate, 2),
+        "unit": "histories/sec",
+        "vs_baseline": round(rate / target, 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,127 @@
+"""Elle-style transactional anomaly checking (list-append and friends).
+
+Public API mirrors the reference's jepsen.tests.cycle.append checker
+(jepsen/src/jepsen/tests/cycle/append.clj:11-22, backed by the external
+elle 0.1.0 dependency): a Checker over histories whose op values are
+transactions of [f k v] micro-ops.
+
+Two interchangeable backends produce cycle verdicts:
+
+  backend="cpu"  hash-join edges + Tarjan SCC + BFS witnesses (the oracle)
+  backend="tpu"  dense scatter + MXU transitive closure, batched on device
+
+Verdict parity between them is the acceptance criterion (SURVEY.md §4.3);
+`checker.elle.kernels.check_encoded_batch` is the batch entry point the
+CLI's analyze-store path uses to sweep thousands of stored histories.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+from .. import Checker
+from . import graph as g
+from . import kernels
+from .encode import EncodedHistory, encode_history
+
+# Anomalies that invalidate a history regardless of requested level —
+# they indicate corrupted data structures, not isolation-level choices.
+ALWAYS_INVALID = frozenset({
+    "internal", "incompatible-order", "duplicate-elements", "dirty-update",
+    "phantom-read", "duplicate-appends", "G0",
+})
+
+ANOMALY_EXPANSION = {
+    "G0": {"G0"},
+    "G1": {"G0", "G1a", "G1b", "G1c"},
+    "G1a": {"G1a"},
+    "G1b": {"G1b"},
+    "G1c": {"G1c"},
+    "G2": {"G-single", "G2-item"},
+    "G-single": {"G-single"},
+    "G2-item": {"G2-item"},
+}
+
+
+def expand_anomalies(wanted: Iterable[str]) -> frozenset:
+    out: set = set()
+    for a in wanted:
+        out |= ANOMALY_EXPANSION.get(a, {a})
+    return frozenset(out)
+
+
+def cycle_anomalies_cpu(enc: EncodedHistory, realtime: bool = False,
+                        process_order: bool = False) -> dict:
+    edges = g.build_edges(enc, process_order=process_order, realtime=realtime)
+    return g.classify_cycles(enc.n, edges)
+
+
+def cycle_anomalies_tpu(enc: EncodedHistory, realtime: bool = False,
+                        process_order: bool = False) -> dict:
+    return kernels.check_encoded_batch(
+        [enc], realtime=realtime, process_order=process_order)[0]
+
+
+def render_verdict(enc: EncodedHistory, cycles: dict,
+                   prohibited: frozenset) -> dict:
+    """Combine host-detected and cycle anomalies into a checker verdict."""
+    anomalies: dict = dict(enc.anomalies)
+    for name, witness in cycles.items():
+        if witness is True:
+            anomalies[name] = True
+        else:
+            anomalies[name] = [
+                {"cycle-txns": [_witness_op(enc, r) for r in witness]}]
+    bad = {a for a in anomalies
+           if a in prohibited or a in ALWAYS_INVALID}
+    if enc.n == 0:
+        return {"valid?": "unknown",
+                "anomaly-types": ["empty-transaction-graph"],
+                "anomalies": {}, "txn-count": 0}
+    return {
+        "valid?": not bad,
+        "anomaly-types": sorted(anomalies),
+        "anomalies": anomalies,
+        "txn-count": enc.n,
+        "key-count": enc.n_keys,
+    }
+
+
+def _witness_op(enc: EncodedHistory, row: int) -> Any:
+    if 0 <= row < len(enc.txn_ops):
+        return enc.txn_ops[row]
+    return row
+
+
+class AppendChecker(Checker):
+    """Checker for list-append histories.
+
+    Options:
+      anomalies:      which anomaly classes to prohibit (default G1+G2,
+                      like the reference wrapper append.clj:14-16)
+      backend:        "cpu" | "tpu"
+      realtime:       add realtime (strict-serializability) edges
+      process_order:  add per-process order edges
+    """
+
+    def __init__(self, anomalies: Iterable[str] = ("G1", "G2"),
+                 backend: str = "cpu", realtime: bool = False,
+                 process_order: bool = False):
+        self.prohibited = expand_anomalies(anomalies)
+        self.backend = backend
+        self.realtime = realtime
+        self.process_order = process_order
+
+    def check(self, test, history, opts):
+        enc = encode_history(history)
+        find = (cycle_anomalies_tpu if self.backend == "tpu"
+                else cycle_anomalies_cpu)
+        cycles = find(enc, realtime=self.realtime,
+                      process_order=self.process_order)
+        return render_verdict(enc, cycles, self.prohibited)
+
+
+def append_checker(anomalies: Iterable[str] = ("G1", "G2"),
+                   backend: str = "cpu", realtime: bool = False,
+                   process_order: bool = False) -> Checker:
+    return AppendChecker(anomalies, backend, realtime, process_order)

@@ -1,0 +1,218 @@
+"""Dependency-graph construction and CPU cycle analysis.
+
+Builds ww/wr/rw (and optional process/realtime) edges from an
+EncodedHistory, then classifies cycles the way Elle does (reference dep:
+elle 0.1.0, used at jepsen/src/jepsen/tests/cycle/append.clj:17-22; paper
+arXiv:2003.10554):
+
+  G0        cycle of only ww edges
+  G1c       cycle of ww∪wr edges containing at least one wr
+  G-single  cycle with exactly one rw (anti-dependency) edge
+  G2-item   cycle with two or more rw edges
+
+This CPU implementation (hash joins + iterative Tarjan + per-edge BFS) is
+deliberately algorithm-independent from the TPU kernel (dense scatter +
+MXU transitive closure) so the two serve as differential oracles for each
+other. It also extracts witness cycles, which the device path does not.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+import numpy as np
+
+from .encode import INFO, NEVER_COMPLETED, EncodedHistory
+
+WW, WR, RW, PROC, RT = 0, 1, 2, 3, 4
+EDGE_NAMES = {WW: "ww", WR: "wr", RW: "rw", PROC: "process", RT: "realtime"}
+
+
+def build_edges(enc: EncodedHistory, process_order: bool = False,
+                realtime: bool = False) -> list[tuple[int, int, int]]:
+    """(src, dst, type) dependency edges between txn rows.
+
+    ww: t1 appended version p, t2 appended p+1 (same key)
+    wr: t1 appended version p, t2's external read observed p last
+    rw: t1's external read ended at p, t2 appended p+1 (t1 "missed" t2)
+    """
+    edges: list[tuple[int, int, int]] = []
+    writer: dict = {}  # (key, pos) -> row
+    for r, k, p in enc.appends:
+        if p > 0:
+            writer[(int(k), int(p))] = int(r)
+    for (k, p), r in writer.items():
+        prev = writer.get((k, p - 1))
+        if p > 1 and prev is not None and prev != r:
+            edges.append((prev, r, WW))
+    for r, k, p in enc.reads:
+        r, k, p = int(r), int(k), int(p)
+        if p < 0:
+            continue  # incompatible read; no edge facts
+        if p > 0:
+            w = writer.get((k, p))
+            if w is not None and w != r:
+                edges.append((w, r, WR))
+        nxt = writer.get((k, p + 1))
+        if nxt is not None and nxt != r:
+            edges.append((r, nxt, RW))
+    # Indeterminate txns never completed: nothing is realtime-after them,
+    # and they sort last in their process's order.
+    complete = np.where(enc.status == INFO, NEVER_COMPLETED,
+                        enc.complete_index)
+    if process_order:
+        last_by_proc: dict = {}
+        for row in np.argsort(complete, kind="stable"):
+            row = int(row)
+            p = int(enc.process[row])
+            if p < 0:
+                continue
+            if p in last_by_proc:
+                edges.append((last_by_proc[p], row, PROC))
+            last_by_proc[p] = row
+    if realtime:
+        # t1 completed before t2 invoked. Already transitively closed, so
+        # emit the full relation (CPU oracle scale only; the device builds
+        # this densely via a broadcast compare).
+        for i in range(enc.n):
+            for j in range(enc.n):
+                if j != i and complete[j] < enc.invoke_index[i]:
+                    edges.append((j, i, RT))
+    return edges
+
+
+def adjacency(n: int, edges: Iterable[tuple[int, int, int]],
+              types: set[int] | None = None) -> list[list[int]]:
+    adj: list[list[int]] = [[] for _ in range(n)]
+    for s, d, ty in edges:
+        if types is None or ty in types:
+            adj[s].append(d)
+    return adj
+
+
+def tarjan_scc(n: int, adj: list[list[int]]) -> list[int]:
+    """Iterative Tarjan: returns scc id per node (ids arbitrary)."""
+    index = [-1] * n
+    low = [0] * n
+    on_stack = [False] * n
+    stack: list[int] = []
+    scc = [-1] * n
+    counter = [0]
+    scc_count = [0]
+    for root in range(n):
+        if index[root] != -1:
+            continue
+        work = [(root, 0)]
+        while work:
+            v, pi = work[-1]
+            if pi == 0:
+                index[v] = low[v] = counter[0]
+                counter[0] += 1
+                stack.append(v)
+                on_stack[v] = True
+            recurse = False
+            for i in range(pi, len(adj[v])):
+                w = adj[v][i]
+                if index[w] == -1:
+                    work[-1] = (v, i + 1)
+                    work.append((w, 0))
+                    recurse = True
+                    break
+                elif on_stack[w]:
+                    low[v] = min(low[v], index[w])
+            if recurse:
+                continue
+            if low[v] == index[v]:
+                while True:
+                    w = stack.pop()
+                    on_stack[w] = False
+                    scc[w] = scc_count[0]
+                    if w == v:
+                        break
+                scc_count[0] += 1
+            work.pop()
+            if work:
+                u, _ = work[-1]
+                low[u] = min(low[u], low[v])
+    return scc
+
+
+def _bfs_path(adj: list[list[int]], src: int, dst: int) -> list[int] | None:
+    """Shortest path src..dst (inclusive) or None."""
+    if src == dst:
+        return [src]
+    prev = {src: None}
+    frontier = [src]
+    while frontier:
+        nxt = []
+        for v in frontier:
+            for w in adj[v]:
+                if w not in prev:
+                    prev[w] = v
+                    if w == dst:
+                        path = [w]
+                        while path[-1] != src:
+                            path.append(prev[path[-1]])
+                        return path[::-1]
+                    nxt.append(w)
+        frontier = nxt
+    return None
+
+
+def classify_cycles(n: int, edges: list[tuple[int, int, int]],
+                    want_witnesses: bool = True) -> dict:
+    """Find which cycle anomalies exist. Returns
+    {name: witness-cycle-node-list | True} for each anomaly present.
+    Realtime/process edges, when present, participate like ww edges do in
+    the "no-antidependency" classes (they strengthen cycles)."""
+    out: dict = {}
+    base = {WW, PROC, RT}
+    ww_adj = adjacency(n, edges, base)
+    wwr_adj = adjacency(n, edges, base | {WR})
+    full_adj = adjacency(n, edges, None)
+
+    # G0: nontrivial SCC in the write-order graph.
+    scc = tarjan_scc(n, ww_adj)
+    counts = np.bincount(np.asarray(scc, np.int64), minlength=0) \
+        if n else np.zeros(0, np.int64)
+    g0_scc = {i for i, c in enumerate(counts) if c > 1}
+    if g0_scc:
+        if want_witnesses:
+            s, d = next((s, d) for s, d, ty in edges
+                        if ty in base and scc[s] == scc[d] and s != d
+                        and scc[s] in g0_scc)
+            path = _bfs_path(ww_adj, d, s)
+            out["G0"] = path + [d] if path else True
+        else:
+            out["G0"] = True
+
+    # G1c: wr edge inside an SCC of the ww∪wr graph.
+    scc2 = tarjan_scc(n, wwr_adj)
+    for s, d, ty in edges:
+        if ty == WR and scc2[s] == scc2[d]:
+            if want_witnesses:
+                path = _bfs_path(wwr_adj, d, s)
+                out["G1c"] = (path + [d]) if path else True
+            else:
+                out["G1c"] = True
+            break
+
+    # G-single / G2-item: per rw edge, can we get back without / only-with
+    # further rw edges?
+    for s, d, ty in edges:
+        if ty != RW:
+            continue
+        if "G-single" not in out:
+            path = _bfs_path(wwr_adj, d, s)
+            if path is not None:
+                out["G-single"] = path + [d] if want_witnesses else True
+                continue
+        if "G2-item" not in out:
+            path = _bfs_path(wwr_adj, d, s)
+            if path is None:
+                path = _bfs_path(full_adj, d, s)
+                if path is not None:
+                    out["G2-item"] = path + [d] if want_witnesses else True
+        if "G-single" in out and "G2-item" in out:
+            break
+    return out

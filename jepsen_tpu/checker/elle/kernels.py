@@ -1,0 +1,278 @@
+"""TPU device kernels for Elle-style cycle detection.
+
+The north-star compute path (SURVEY.md §3.3, BASELINE.json): encoded
+histories live in HBM as padded int32 tensors; dependency edges are built
+with dense scatters; cycle detection runs as boolean transitive closure by
+repeated matrix squaring — log2(T) bfloat16 matmuls that map straight onto
+the MXU — and anomaly classes fall out of closure/edge intersections:
+
+  G0        some ww edge (u,v) with v→u in closure(ww)
+  G1c       some wr edge (u,v) with v→u in closure(ww|wr)
+  G-single  some rw edge (u,v) with v→u in closure(ww|wr)
+  G2-item   some rw edge (u,v) with v→u only in closure(ww|wr|rw)
+
+There is exactly one implementation of the math, written batched over
+[B,T,T] tensors with a `constrain` hook: `jepsen_tpu.parallel` passes a
+sharding constraint (dp over histories × mp over closure-matmul columns)
+and jit shardings; the single-device path passes identity. Realtime and
+process-order edges fold into the ww class (they strengthen cycles without
+adding anti-dependencies), masked to each history's live rows.
+
+All matmuls accumulate in float32 (`preferred_element_type`) from bf16
+operands: entries are 0/1 so any nonzero dot-product term keeps the
+closure sound; magnitudes are re-thresholded to booleans every step.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...devices import default_devices
+from .encode import INFO, NEVER_COMPLETED, EncodedHistory
+
+# Flag bit positions in the kernel's output word.
+G0, G1C, G_SINGLE, G2_ITEM, CYCLE = 0, 1, 2, 3, 4
+FLAG_NAMES = {G0: "G0", G1C: "G1c", G_SINGLE: "G-single", G2_ITEM: "G2-item"}
+
+
+def pad_to(x: int, multiple: int) -> int:
+    """Round x up to a positive multiple."""
+    return max(multiple, ((x + multiple - 1) // multiple) * multiple)
+
+
+@dataclass(frozen=True)
+class BatchShape:
+    """Static padding plan for a batch of encoded histories."""
+
+    n_txns: int      # T: txn rows per history (padded)
+    n_appends: int   # A: append triples per history
+    n_reads: int     # R: read triples per history
+    n_keys: int      # K: interned keys per history
+    max_pos: int     # P: longest version chain
+
+    @staticmethod
+    def plan(encs: list[EncodedHistory], multiple: int = 128) -> "BatchShape":
+        return BatchShape(
+            n_txns=pad_to(max((e.n for e in encs), default=1), multiple),
+            n_appends=pad_to(max((len(e.appends) for e in encs), default=1), 8),
+            n_reads=pad_to(max((len(e.reads) for e in encs), default=1), 8),
+            n_keys=pad_to(max((e.n_keys for e in encs), default=1), 8),
+            max_pos=pad_to(max((e.max_pos for e in encs), default=1), 8),
+        )
+
+
+def pack_batch(encs: list[EncodedHistory],
+               shape: BatchShape | None = None) -> dict:
+    """Pack EncodedHistories into padded stacked arrays (host-side).
+
+    Padding convention: append/read triples beyond their count have
+    txn = -1; txn rows beyond a history's n are dead (no triples reference
+    them, and the kernel masks them out of realtime edges via n_txns)."""
+    shape = shape or BatchShape.plan(encs)
+    B = len(encs)
+    appends = np.full((B, shape.n_appends, 3), -1, np.int32)
+    reads = np.full((B, shape.n_reads, 3), -1, np.int32)
+    invoke_idx = np.zeros((B, shape.n_txns), np.int64)
+    complete_idx = np.zeros((B, shape.n_txns), np.int64)
+    process = np.full((B, shape.n_txns), -1, np.int32)
+    n_txns = np.zeros((B,), np.int32)
+    for i, e in enumerate(encs):
+        a = np.asarray(e.appends, np.int32)
+        r = np.asarray(e.reads, np.int32)
+        if len(a) > shape.n_appends or len(r) > shape.n_reads or \
+                e.n > shape.n_txns:
+            raise ValueError(f"history {i} exceeds batch shape {shape}")
+        appends[i, : len(a)] = a
+        reads[i, : len(r)] = r
+        invoke_idx[i, : e.n] = e.invoke_index
+        complete_idx[i, : e.n] = np.where(
+            e.status == INFO, NEVER_COMPLETED, e.complete_index)
+        process[i, : e.n] = e.process
+        n_txns[i] = e.n
+    return {"appends": appends, "reads": reads, "n_txns": n_txns,
+            "invoke_index": invoke_idx, "complete_index": complete_idx,
+            "process": process, "shape": shape}
+
+
+def closure_steps(n_txns: int) -> int:
+    """Squaring rounds needed for a T-node graph: path lengths double each
+    round; (A|I)^(2^s) covers all simple paths once 2^s >= T."""
+    return max(1, int(np.ceil(np.log2(max(2, n_txns)))))
+
+
+def _edges_one(appends: jnp.ndarray, reads: jnp.ndarray, n_keys: int,
+               max_pos: int, n_txns: int):
+    """Build [T,T] boolean adjacency matrices for ww/wr/rw from triples.
+
+    appends: [A,3] (txn,key,pos), pos>=1 observed, -1 unobserved/dead.
+    reads:   [R,3] (txn,key,pos-of-last), 0 empty read, -1 dead.
+    """
+    T = n_txns
+    a_txn, a_key, a_pos = appends[:, 0], appends[:, 1], appends[:, 2]
+    r_txn, r_key, r_pos = reads[:, 0], reads[:, 1], reads[:, 2]
+    a_live = (a_txn >= 0) & (a_pos >= 1)
+    r_live = (r_txn >= 0) & (r_pos >= 0)
+
+    # Writer lookup table W[key, pos] -> txn row (or -1). pos axis is
+    # 1-based; slot 0 unused; dead triples scatter to a trash slot that is
+    # re-nulled afterwards.
+    W = jnp.full((n_keys, max_pos + 2), -1, jnp.int32)
+    k_idx = jnp.where(a_live, a_key, n_keys - 1)
+    p_idx = jnp.where(a_live, a_pos, max_pos + 1)
+    W = W.at[k_idx, p_idx].set(jnp.where(a_live, a_txn, -1), mode="drop")
+    W = W.at[:, max_pos + 1].set(-1)
+
+    def scatter_edges(src, dst, live):
+        live = live & (src >= 0) & (dst >= 0) & (src != dst)
+        s = jnp.where(live, src, 0)
+        d = jnp.where(live, dst, 0)
+        adj = jnp.zeros((T, T), bool)
+        return adj.at[s, d].max(live, mode="drop")
+
+    # ww: writer of pos-1 -> writer of pos
+    prev_w = W[k_idx, jnp.maximum(p_idx - 1, 0)]
+    ww = scatter_edges(prev_w, a_txn, a_live & (a_pos >= 2))
+
+    # wr: writer of pos -> reader (pos >= 1)
+    rk = jnp.where(r_live, r_key, n_keys - 1)
+    rp = jnp.where(r_live & (r_pos >= 1), r_pos, max_pos + 1)
+    wr = scatter_edges(W[rk, rp], r_txn, r_live & (r_pos >= 1))
+
+    # rw: reader -> writer of pos+1
+    rp1 = jnp.where(r_live, jnp.minimum(r_pos + 1, max_pos + 1), max_pos + 1)
+    rw = scatter_edges(r_txn, W[rk, rp1], r_live)
+    return ww, wr, rw
+
+
+def _closure_batched(m: jnp.ndarray, steps: int, constrain) -> jnp.ndarray:
+    """Transitive closure of [B,T,T] boolean adjacencies via repeated
+    squaring; each squaring is one batched bf16 matmul on the MXU."""
+    eye = jnp.eye(m.shape[-1], dtype=bool)
+    m = m | eye
+
+    def body(m, _):
+        mb = constrain(m.astype(jnp.bfloat16))
+        m2 = jax.lax.dot_general(
+            mb, mb, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) > 0
+        return constrain(m2), None
+
+    m, _ = jax.lax.scan(body, m, None, length=steps)
+    return m
+
+
+def check_batched_impl(appends, reads, invoke_index, complete_index, process,
+                       n_live, *, n_keys: int, max_pos: int, n_txns: int,
+                       steps: int, classify: bool, realtime: bool,
+                       process_order: bool, constrain) -> jnp.ndarray:
+    """THE cycle-check kernel: packed [B,...] tensors -> [B] int32 flag
+    words. `n_live` is the per-history real txn count ([B]); rows beyond
+    it are excluded from realtime/process edges."""
+    edges = jax.vmap(functools.partial(
+        _edges_one, n_keys=n_keys, max_pos=max_pos, n_txns=n_txns))
+    ww, wr, rw = edges(appends, reads)
+    T = ww.shape[-1]
+    nI = ~jnp.eye(T, dtype=bool)
+    live = jnp.arange(T)[None, :] < n_live[:, None]          # [B,T]
+    live2 = live[:, :, None] & live[:, None, :]              # [B,T,T]
+
+    if process_order:
+        # Consecutive txns of one process in completion order: link row i
+        # to the same-process row with the smallest completion index
+        # greater than i's.
+        same = (process[:, :, None] == process[:, None, :]) \
+            & (process[:, :, None] >= 0)
+        later = complete_index[:, None, :] > complete_index[:, :, None]
+        cand = same & later & live2
+        big = jnp.where(cand, complete_index[:, None, :],
+                        jnp.iinfo(complete_index.dtype).max)
+        nxt = jnp.min(big, axis=2, keepdims=True)
+        ww = ww | (cand & (big == nxt))
+    if realtime:
+        # j completed before i invoked => j precedes i in real time.
+        # Indeterminate txns carry NEVER_COMPLETED and emit no rt edges.
+        rt = complete_index[:, :, None] < invoke_index[:, None, :]
+        ww = ww | (rt & live2 & nI)
+
+    wwr = ww | wr
+    full = wwr | rw
+    c_full = _closure_batched(full, steps, constrain)
+    cycle = jnp.any(full & jnp.swapaxes(c_full, 1, 2) & nI, axis=(1, 2))
+    if not classify:
+        return cycle.astype(jnp.int32) << CYCLE
+    c_ww = _closure_batched(ww, steps, constrain)
+    c_wwr = _closure_batched(wwr, steps, constrain)
+    cT_wwr = jnp.swapaxes(c_wwr, 1, 2)
+    g0 = jnp.any(ww & jnp.swapaxes(c_ww, 1, 2) & nI, axis=(1, 2))
+    g1c = jnp.any(wr & cT_wwr, axis=(1, 2))
+    g_single = jnp.any(rw & cT_wwr, axis=(1, 2))
+    g2 = jnp.any(rw & jnp.swapaxes(c_full, 1, 2) & ~cT_wwr, axis=(1, 2))
+    cycle = cycle | g0 | g1c | g_single | g2
+    return (g0.astype(jnp.int32) << G0) \
+        | (g1c.astype(jnp.int32) << G1C) \
+        | (g_single.astype(jnp.int32) << G_SINGLE) \
+        | (g2.astype(jnp.int32) << G2_ITEM) \
+        | (cycle.astype(jnp.int32) << CYCLE)
+
+
+def _identity(x):
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "n_keys", "max_pos", "n_txns", "steps", "classify", "realtime",
+    "process_order"))
+def check_batch_device(appends, reads, invoke_index, complete_index, process,
+                       n_live, *, n_keys: int, max_pos: int, n_txns: int,
+                       steps: int, classify: bool = True,
+                       realtime: bool = False,
+                       process_order: bool = False) -> jnp.ndarray:
+    """Single-device jitted entry over a packed batch: [B] int32 flags."""
+    return check_batched_impl(
+        appends, reads, invoke_index, complete_index, process, n_live,
+        n_keys=n_keys, max_pos=max_pos, n_txns=n_txns, steps=steps,
+        classify=classify, realtime=realtime, process_order=process_order,
+        constrain=_identity)
+
+
+def flags_to_names(word: int) -> dict:
+    return {name: True for bit, name in FLAG_NAMES.items()
+            if word & (1 << bit)}
+
+
+def check_encoded_batch(encs: list[EncodedHistory],
+                        realtime: bool = False,
+                        process_order: bool = False,
+                        classify: bool = True,
+                        devices=None) -> list[dict]:
+    """Check a batch of encoded histories on device; returns per-history
+    dicts {anomaly-name: True} for the cycle anomalies.
+
+    When several addressable devices exist and divide the batch, the batch
+    axis is sharded across a 1-D mesh — the analysis data plane
+    (SURVEY.md §5.8)."""
+    if not encs:
+        return []
+    batch = pack_batch(encs)
+    shape: BatchShape = batch["shape"]
+    names = ("appends", "reads", "invoke_index", "complete_index",
+             "process", "n_txns")
+    args = [jnp.asarray(batch[k]) for k in names]
+
+    devices = devices if devices is not None else default_devices()
+    if len(devices) > 1 and len(encs) % len(devices) == 0:
+        mesh = jax.sharding.Mesh(np.asarray(devices), ("dp",))
+        sharding = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec("dp"))
+        args = [jax.device_put(a, sharding) for a in args]
+
+    flags = check_batch_device(
+        *args, n_keys=shape.n_keys, max_pos=shape.max_pos,
+        n_txns=shape.n_txns, steps=closure_steps(shape.n_txns),
+        classify=classify, realtime=realtime, process_order=process_order)
+    return [flags_to_names(int(w)) for w in np.asarray(flags)]

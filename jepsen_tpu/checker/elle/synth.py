@@ -1,0 +1,91 @@
+"""Vectorized synthetic list-append batches for benchmarks and dry runs.
+
+Builds packed, device-ready batches (the same layout `kernels.pack_batch`
+produces) straight from numpy arithmetic — no per-op Python objects — so
+benchmarks can exercise the device checking phase at sizes where building
+50M op dicts on the host would dominate. The generated executions are
+serial (one append + one external read per txn), hence anomaly-free;
+`inject_g1c` corrupts chosen histories with a ww/wr cycle so the classify
+path has positives to find.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .kernels import BatchShape, pad_to
+
+
+def synth_valid_batch(B: int, T: int, K: int, concurrency: int = 5,
+                      seed: int = 0) -> dict:
+    """A packed batch of B serial histories, T txns each over K keys.
+
+    Txn i does [r k_r v][append k_a v]: the read is external (first
+    access), observing exactly the appends committed by earlier txns.
+    """
+    rng = np.random.default_rng(seed)
+    i = np.arange(T)
+    rot = rng.integers(0, K, size=(B, 1))
+
+    a_key = (i[None, :] + rot) % K                # [B,T]
+    a_pos = i[None, :] // K + 1
+    appends = np.stack(
+        [np.broadcast_to(i, (B, T)), a_key, np.broadcast_to(a_pos, (B, T))],
+        axis=-1).astype(np.int32)
+
+    r_key = (i[None, :] * 7 + 3 + rot) % K
+    # First txn appending r_key is row ((r_key - rot) mod K); appends to it
+    # land every K txns. Number committed strictly before txn i:
+    first = (r_key - rot) % K
+    r_pos = np.where(i[None, :] > first, (i[None, :] - 1 - first) // K + 1, 0)
+    reads = np.stack(
+        [np.broadcast_to(i, (B, T)), r_key, r_pos], axis=-1).astype(np.int32)
+
+    invoke_index = np.broadcast_to(2 * i, (B, T)).astype(np.int64)
+    complete_index = np.broadcast_to(2 * i + 1, (B, T)).astype(np.int64)
+    process = np.broadcast_to(i % concurrency, (B, T)).astype(np.int32)
+    shape = BatchShape(n_txns=pad_to(T, 128), n_appends=pad_to(T, 8),
+                       n_reads=pad_to(T, 8), n_keys=pad_to(K, 8),
+                       max_pos=pad_to((T - 1) // K + 1, 8))
+    return {
+        "appends": _pad_triples(appends, shape.n_appends),
+        "reads": _pad_triples(reads, shape.n_reads),
+        "invoke_index": _pad_axis(invoke_index, shape.n_txns),
+        "complete_index": _pad_axis(complete_index, shape.n_txns),
+        "process": _pad_axis(process, shape.n_txns, fill=-1),
+        "n_txns": np.full(B, T, np.int32),
+        "shape": shape,
+    }
+
+
+def inject_g1c(batch: dict, which: np.ndarray, K: int) -> dict:
+    """Corrupt selected histories with a ww+wr cycle: txn a appends (k,p),
+    txn b = a+K appends (k,p+1); rewriting a's read to observe (k,p+1)
+    adds wr b→a against the existing ww a→b."""
+    reads = batch["reads"].copy()
+    appends = batch["appends"]
+    for h in np.atleast_1d(which):
+        T = int(batch["n_txns"][h])
+        a = T // 2
+        b = a + K
+        if b >= T:
+            raise ValueError("history too short to inject a cycle")
+        k = appends[h, a, 1]
+        p = appends[h, a, 2]
+        reads[h, a, 1] = k
+        reads[h, a, 2] = p + 1
+    return {**batch, "reads": reads}
+
+
+def _pad_triples(a: np.ndarray, n: int) -> np.ndarray:
+    B, t, _ = a.shape
+    out = np.full((B, n, 3), -1, np.int32)
+    out[:, :t] = a
+    return out
+
+
+def _pad_axis(a: np.ndarray, n: int, fill: int = 0) -> np.ndarray:
+    B, t = a.shape
+    out = np.full((B, n), fill, a.dtype)
+    out[:, :t] = a
+    return out

@@ -1,0 +1,71 @@
+"""Transaction micro-op (mop) helpers.
+
+Counterpart of the reference's `txn/` subproject (txn/src/jepsen/txn.clj):
+transactions are op :values of the form [[f k v] ...] where f is "append"
+or "r" for list-append workloads, "w"/"r" for rw-register workloads.
+
+This module is the seam the TPU build changes: `encode.py` builds on these
+to translate ragged mop lists into fixed-width integer tensors.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable
+
+
+def mops(op: dict) -> list:
+    """The micro-ops of a txn op (empty list for nil values)."""
+    v = op.get("value")
+    return v if isinstance(v, (list, tuple)) else []
+
+
+def is_txn_op(op: dict) -> bool:
+    """Does this op's value look like a transaction (a list of [f k v]
+    micro-ops)?"""
+    v = op.get("value")
+    if not isinstance(v, (list, tuple)):
+        return False
+    return all(isinstance(m, (list, tuple)) and len(m) == 3 for m in v)
+
+
+def reduce_mops(f: Callable, init: Any, history: Iterable[dict]) -> Any:
+    """Fold f(state, op, [mf, k, v]) over every micro-op of every op
+    (txn.clj:5-17)."""
+    state = init
+    for op in history:
+        for mop in mops(op):
+            state = f(state, op, mop)
+    return state
+
+
+def ext_reads(txn: list) -> dict:
+    """Keys to values for a txn's external reads: values observed that the
+    txn did not itself write first (txn.clj:19-34). Only the first access
+    to a key counts; later reads see the txn's own effects."""
+    ext: dict = {}
+    seen: set = set()
+    for mf, k, v in txn:
+        if mf == "r" and k not in seen:
+            ext[k] = v
+        seen.add(k)
+    return ext
+
+
+def ext_writes(txn: list) -> dict:
+    """Keys to final written values for a txn's external writes
+    (txn.clj:36-47). For append txns the 'write' is the last appended
+    element."""
+    ext: dict = {}
+    for mf, k, v in txn:
+        if mf != "r":
+            ext[k] = v
+    return ext
+
+
+def writes_by_key(txn: list) -> dict:
+    """Key -> list of values written/appended by this txn, in order."""
+    out: dict = {}
+    for mf, k, v in txn:
+        if mf != "r":
+            out.setdefault(k, []).append(v)
+    return out

@@ -1,0 +1,31 @@
+"""Device discovery for the analysis data plane.
+
+The default JAX backend wins (a real TPU slice when present), but:
+  * JEPSEN_TPU_PLATFORM=cpu|tpu|... pins a platform explicitly (tests pin
+    cpu so the 8-device virtual host mesh is used even on machines where
+    a TPU plugin registers itself regardless of JAX_PLATFORMS), and
+  * a minimum device count can be requested — if the preferred backend is
+    smaller, we fall back to the host-platform devices, which honors
+    --xla_force_host_platform_device_count virtual meshes.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def default_devices(min_count: int = 1) -> list:
+    import jax
+
+    plat = os.environ.get("JEPSEN_TPU_PLATFORM")
+    if plat:
+        return jax.devices(plat)
+    devs = jax.devices()
+    if len(devs) < min_count:
+        try:
+            cpu = jax.devices("cpu")
+            if len(cpu) >= len(devs):
+                return cpu
+        except RuntimeError:
+            pass
+    return devs

@@ -1,0 +1,88 @@
+"""The analysis data plane: mesh construction and sharded batch checking.
+
+The reference's only distribution mechanism is SSH fan-out on the control
+plane (SURVEY.md §5.8) — analysis is single-JVM. This module is the
+north-star addition: history batches are sharded over a TPU device mesh
+with named axes
+
+  dp  data parallel over histories (the primary axis, SURVEY.md §2.5)
+  mp  model parallel within one history: the [T,T] adjacency/closure
+      matrices are column-sharded, so each closure matmul runs as a
+      distributed dense matmul with XLA inserting the collectives over
+      ICI (the sequence-parallel analogue for long histories)
+
+The batched formulation here (explicit [B,T,T] einsum instead of vmap)
+exists so sharding constraints can be placed on the matrices themselves.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..checker.elle import kernels as K
+from ..devices import default_devices
+
+
+def factor2(n: int) -> tuple[int, int]:
+    """Split n into (a, b), a*b == n, as square as possible, a >= b."""
+    b = int(math.isqrt(n))
+    while n % b:
+        b -= 1
+    return n // b, b
+
+
+def make_mesh(devices: Sequence | None = None,
+              axes: tuple[str, str] = ("dp", "mp")) -> Mesh:
+    """A 2-D device mesh: data parallel over histories × model parallel
+    within a history's closure matmuls."""
+    devices = list(devices if devices is not None else default_devices())
+    dp, mp = factor2(len(devices))
+    return Mesh(np.asarray(devices).reshape(dp, mp), axes)
+
+
+def sharded_check_fn(mesh: Mesh | None, shape: K.BatchShape, *,
+                     classify: bool = True, realtime: bool = False,
+                     process_order: bool = False):
+    """Build a jitted batched checker around kernels.check_batched_impl.
+    With a mesh, inputs are expected sharded over 'dp' and the closure
+    matrices are constrained to P('dp', None, 'mp'); without one, it's a
+    plain single-device jit."""
+    if mesh is not None:
+        spec = P("dp", None, "mp")
+
+        def constrain(x):
+            return jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, spec))
+    else:
+        def constrain(x):
+            return x
+
+    f = functools.partial(
+        K.check_batched_impl, n_keys=shape.n_keys, max_pos=shape.max_pos,
+        n_txns=shape.n_txns, steps=K.closure_steps(shape.n_txns),
+        classify=classify, realtime=realtime, process_order=process_order,
+        constrain=constrain)
+    if mesh is None:
+        return jax.jit(f)
+    in_shard = NamedSharding(mesh, P("dp"))
+    out_shard = NamedSharding(mesh, P("dp"))
+    return jax.jit(f, in_shardings=(in_shard,) * 6, out_shardings=out_shard)
+
+
+def shard_batch(mesh: Mesh | None, packed: dict) -> tuple:
+    """Device-put packed batch arrays, sharded over dp when a mesh is
+    given. Returns the 6 positional args for the check fn."""
+    names = ("appends", "reads", "invoke_index", "complete_index",
+             "process", "n_txns")
+    args = [jnp.asarray(packed[k]) for k in names]
+    if mesh is not None:
+        s = NamedSharding(mesh, P("dp"))
+        args = [jax.device_put(a, s) for a in args]
+    return tuple(args)

@@ -8,6 +8,9 @@ path; real-chip benchmarks happen in bench.py).
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Some environments register a TPU plugin regardless of JAX_PLATFORMS;
+# this pin makes jepsen_tpu.devices resolve the virtual CPU mesh.
+os.environ.setdefault("JEPSEN_TPU_PLATFORM", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,350 @@
+"""Elle list-append checker tests.
+
+Handcrafted anomaly scenarios (the classic Adya patterns), plus
+property-style differential tests: the CPU oracle (Tarjan+BFS) and the TPU
+kernel (MXU transitive closure) must agree on every cycle flag, and
+serializable executions must check valid.
+"""
+
+import random
+
+import pytest
+
+from jepsen_tpu.checker import elle
+from jepsen_tpu.checker.elle import encode, graph, kernels
+
+
+def txn_pair(process, mops_inv, mops_ok, i0=0):
+    return [
+        {"type": "invoke", "process": process, "f": "txn", "value": mops_inv},
+        {"type": "ok", "process": process, "f": "txn", "value": mops_ok},
+    ]
+
+
+def seq_history(*txns):
+    """Sequential history: each txn is (invoke-mops, ok-mops); process 0."""
+    hist = []
+    for i, (inv, ok) in enumerate(txns):
+        hist.append({"type": "invoke", "process": i % 5, "f": "txn",
+                     "value": inv})
+        hist.append({"type": "ok", "process": i % 5, "f": "txn", "value": ok})
+    return hist
+
+
+def check(history, **kw):
+    return elle.append_checker(**kw).check({}, history, {})
+
+
+# -- encoding -------------------------------------------------------------
+
+def test_encode_versions_and_facts():
+    hist = seq_history(
+        ([["append", "x", 1]], [["append", "x", 1]]),
+        ([["append", "x", 2]], [["append", "x", 2]]),
+        ([["r", "x", None]], [["r", "x", [1, 2]]]),
+    )
+    enc = encode.encode_history(hist)
+    assert enc.n == 3
+    assert enc.max_pos == 2
+    # appends carry positions 1 and 2; read carries pos 2
+    poss = sorted(p for _, _, p in enc.appends)
+    assert poss == [1, 2]
+    assert list(enc.reads[0]) == [2, 0, 2]
+    assert enc.anomalies == {}
+
+
+def test_encode_unobserved_append_has_no_position():
+    hist = seq_history(([["append", "x", 1]], [["append", "x", 1]]))
+    enc = encode.encode_history(hist)
+    assert list(enc.appends[0]) == [0, 0, -1]
+
+
+def test_valid_serializable_history():
+    hist = seq_history(
+        ([["append", 1, 1], ["r", 1, None]],
+         [["append", 1, 1], ["r", 1, [1]]]),
+        ([["append", 1, 2], ["r", 1, None]],
+         [["append", 1, 2], ["r", 1, [1, 2]]]),
+        ([["r", 1, None]], [["r", 1, [1, 2]]]),
+    )
+    r = check(hist)
+    assert r["valid?"] is True
+    assert r["anomaly-types"] == []
+
+
+def test_empty_history_unknown():
+    r = check([])
+    assert r["valid?"] == "unknown"
+    assert r["anomaly-types"] == ["empty-transaction-graph"]
+
+
+# -- host-detected anomalies ----------------------------------------------
+
+def test_G1a_aborted_read():
+    hist = [
+        {"type": "invoke", "process": 0, "f": "txn",
+         "value": [["append", "x", 1]]},
+        {"type": "fail", "process": 0, "f": "txn",
+         "value": [["append", "x", 1]]},
+        {"type": "invoke", "process": 1, "f": "txn", "value": [["r", "x", None]]},
+        {"type": "ok", "process": 1, "f": "txn", "value": [["r", "x", [1]]]},
+    ]
+    r = check(hist)
+    assert r["valid?"] is False
+    assert "G1a" in r["anomaly-types"]
+
+
+def test_G1b_intermediate_read():
+    hist = seq_history(
+        ([["append", "x", 1], ["append", "x", 2]],
+         [["append", "x", 1], ["append", "x", 2]]),
+        ([["r", "x", None]], [["r", "x", [1]]]),
+        ([["r", "x", None]], [["r", "x", [1, 2]]]),
+    )
+    r = check(hist)
+    assert r["valid?"] is False
+    assert "G1b" in r["anomaly-types"]
+
+
+def test_internal_anomaly():
+    hist = seq_history(
+        ([["append", "x", 1], ["r", "x", None]],
+         [["append", "x", 1], ["r", "x", []]]),
+    )
+    r = check(hist)
+    assert r["valid?"] is False
+    assert "internal" in r["anomaly-types"]
+
+
+def test_internal_consistent_read_own_writes():
+    hist = seq_history(
+        ([["append", "x", 5], ["r", "x", None]],
+         [["append", "x", 5], ["r", "x", [5]]]),
+    )
+    r = check(hist)
+    assert "internal" not in r["anomaly-types"]
+
+
+def test_incompatible_order():
+    hist = seq_history(
+        ([["r", "x", None]], [["r", "x", [1, 2]]]),
+        ([["r", "x", None]], [["r", "x", [2]]]),
+        ([["append", "x", 1]], [["append", "x", 1]]),
+        ([["append", "x", 2]], [["append", "x", 2]]),
+    )
+    r = check(hist)
+    assert r["valid?"] is False
+    assert "incompatible-order" in r["anomaly-types"]
+
+
+def test_duplicate_elements():
+    hist = seq_history(
+        ([["append", "x", 1]], [["append", "x", 1]]),
+        ([["r", "x", None]], [["r", "x", [1, 1]]]),
+    )
+    r = check(hist)
+    assert r["valid?"] is False
+    assert "duplicate-elements" in r["anomaly-types"]
+
+
+# -- cycle anomalies (CPU oracle) -----------------------------------------
+
+def g0_history():
+    """ww cycle: T0 and T1 append to x and y in opposite orders."""
+    return seq_history(
+        ([["append", "x", 1], ["append", "y", 3]],
+         [["append", "x", 1], ["append", "y", 3]]),
+        ([["append", "x", 2], ["append", "y", 4]],
+         [["append", "x", 2], ["append", "y", 4]]),
+        ([["r", "x", None], ["r", "y", None]],
+         [["r", "x", [1, 2]], ["r", "y", [4, 3]]]),
+    )
+
+
+def g1c_history():
+    """wr cycle: each txn reads the other's append."""
+    return seq_history(
+        ([["append", "x", 1], ["r", "y", None]],
+         [["append", "x", 1], ["r", "y", [2]]]),
+        ([["append", "y", 2], ["r", "x", None]],
+         [["append", "y", 2], ["r", "x", [1]]]),
+    )
+
+
+def g_single_history():
+    """T0 -rw-> T1 -wr-> T0: exactly one anti-dependency."""
+    return seq_history(
+        ([["r", "y", None], ["r", "x", None]],
+         [["r", "y", [2]], ["r", "x", []]]),
+        ([["append", "x", 1], ["append", "y", 2]],
+         [["append", "x", 1], ["append", "y", 2]]),
+        ([["r", "x", None]], [["r", "x", [1]]]),
+    )
+
+
+def g2_history():
+    """Write skew: two rw edges, no ww/wr cycle."""
+    return seq_history(
+        ([["r", "x", None], ["append", "y", 1]],
+         [["r", "x", []], ["append", "y", 1]]),
+        ([["r", "y", None], ["append", "x", 2]],
+         [["r", "y", []], ["append", "x", 2]]),
+        ([["r", "x", None], ["r", "y", None]],
+         [["r", "x", [2]], ["r", "y", [1]]]),
+    )
+
+
+def test_G0():
+    r = check(g0_history())
+    assert r["valid?"] is False
+    assert "G0" in r["anomaly-types"]
+
+
+def test_G1c():
+    r = check(g1c_history())
+    assert r["valid?"] is False
+    assert "G1c" in r["anomaly-types"]
+    assert "G0" not in r["anomaly-types"]
+
+
+def test_G_single():
+    r = check(g_single_history())
+    assert r["valid?"] is False
+    assert "G-single" in r["anomaly-types"]
+
+
+def test_G2():
+    r = check(g2_history())
+    assert r["valid?"] is False
+    assert "G2-item" in r["anomaly-types"]
+    assert "G-single" not in r["anomaly-types"]
+
+
+def test_G2_allowed_when_only_G1_prohibited():
+    r = check(g2_history(), anomalies=("G1",))
+    assert r["valid?"] is True
+    assert "G2-item" in r["anomaly-types"]
+
+
+def test_witness_cycle_present():
+    r = check(g1c_history())
+    w = r["anomalies"]["G1c"]
+    assert isinstance(w, list) and "cycle-txns" in w[0]
+    # the witness is a closed loop of real ops
+    cyc = w[0]["cycle-txns"]
+    assert cyc[0] == cyc[-1]
+
+
+# -- realtime edges --------------------------------------------------------
+
+def test_realtime_strengthens_to_invalid():
+    # T1 appends x=1; after it completes, T2 reads x=[] (stale read).
+    # Without realtime edges: G-single-free?? T2 -rw-> T1 but no return
+    # path. With realtime: T1 -rt-> T2 closes the loop.
+    hist = seq_history(
+        ([["append", "x", 1]], [["append", "x", 1]]),
+        ([["r", "x", None]], [["r", "x", []]]),
+        ([["r", "x", None]], [["r", "x", [1]]]),
+    )
+    r = check(hist)
+    assert r["valid?"] is True
+    r = check(hist, realtime=True)
+    assert r["valid?"] is False
+    assert "G-single" in r["anomaly-types"]
+
+
+# -- differential: CPU oracle vs TPU kernel --------------------------------
+
+class SerialDB:
+    """A sequential list-append database for generating ground-truth
+    histories."""
+
+    def __init__(self):
+        self.lists = {}
+
+    def apply(self, mops):
+        out = []
+        for mf, k, v in mops:
+            if mf == "append":
+                self.lists.setdefault(k, []).append(v)
+                out.append([mf, k, v])
+            else:
+                out.append(["r", k, list(self.lists.get(k, []))])
+        return out
+
+
+def random_history(rng, n_txns=30, n_keys=4, corrupt=0):
+    db = SerialDB()
+    counter = [0]
+    hist = []
+    for i in range(n_txns):
+        mops = []
+        for _ in range(rng.randint(1, 4)):
+            k = rng.randint(0, n_keys - 1)
+            if rng.random() < 0.5:
+                counter[0] += 1
+                mops.append(["append", k, counter[0]])
+            else:
+                mops.append(["r", k, None])
+        ok_mops = db.apply(mops)
+        hist.append({"type": "invoke", "process": i % 5, "f": "txn",
+                     "value": mops})
+        hist.append({"type": "ok", "process": i % 5, "f": "txn",
+                     "value": ok_mops})
+    for _ in range(corrupt):
+        # swap two read results, truncate a read, or reorder
+        ok_ops = [o for o in hist if o["type"] == "ok"]
+        o = rng.choice(ok_ops)
+        reads = [m for m in o["value"] if m[0] == "r" and m[2]]
+        if reads:
+            m = rng.choice(reads)
+            kind = rng.random()
+            if kind < 0.4 and len(m[2]) > 0:
+                m[2].pop()          # miss the tail append
+            elif kind < 0.7:
+                m[2] = m[2][::-1]   # scramble order
+            else:
+                m[2] = m[2] + m[2][-1:]  # duplicate
+    return hist
+
+
+def test_serializable_histories_are_valid():
+    rng = random.Random(7)
+    for _ in range(10):
+        r = check(random_history(rng))
+        assert r["valid?"] is True, r["anomaly-types"]
+
+
+@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("realtime,process_order",
+                         [(False, False), (True, False), (True, True)])
+def test_differential_cpu_vs_tpu(seed, realtime, process_order):
+    rng = random.Random(seed)
+    hists = [random_history(rng, n_txns=20, corrupt=rng.randint(0, 3))
+             for _ in range(4)]
+    # Mix in indeterminate txns: drop some completions to :info.
+    for hist in hists:
+        for o in hist:
+            if o["type"] == "ok" and rng.random() < 0.1:
+                o["type"] = "info"
+                o["value"] = None
+    encs = [encode.encode_history(h) for h in hists]
+    cpu = [dict.fromkeys(
+        elle.cycle_anomalies_cpu(e, realtime=realtime,
+                                 process_order=process_order), True)
+        for e in encs]
+    tpu = kernels.check_encoded_batch(encs, realtime=realtime,
+                                      process_order=process_order)
+    assert cpu == tpu
+
+
+def test_differential_handcrafted_cases():
+    hists = [g0_history(), g1c_history(), g_single_history(), g2_history()]
+    encs = [encode.encode_history(h) for h in hists]
+    cpu = [dict.fromkeys(elle.cycle_anomalies_cpu(e), True) for e in encs]
+    tpu = kernels.check_encoded_batch(encs)
+    assert cpu == tpu
+    assert "G0" in tpu[0]
+    assert "G1c" in tpu[1]
+    assert "G-single" in tpu[2]
+    assert "G2-item" in tpu[3]
