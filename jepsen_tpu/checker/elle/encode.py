@@ -32,10 +32,21 @@ from . import txn as t
 
 OK, INFO, FAIL = 0, 1, 2  # txn status codes
 
-# Completion index for indeterminate txns in realtime ordering: they never
-# completed, so nothing can be realtime-after them. Fits in int32 so the
-# value survives JAX's int64->int32 cast when x64 is disabled.
-NEVER_COMPLETED = np.int64(2**31 - 1)
+# Completion index base for indeterminate txns in realtime ordering: they
+# never completed, so nothing can be realtime-after them. Each info row
+# gets NEVER_COMPLETED + row so completion keys stay *distinct* (the
+# device kernel's successor-by-min formulation and the CPU oracle's stable
+# sort must agree on process order between two crashed txns). Base + row
+# fits in int32 so values survive JAX's int64->int32 cast without x64.
+NEVER_COMPLETED = np.int64(2**30)
+
+
+def effective_complete_index(status: np.ndarray,
+                             complete_index: np.ndarray) -> np.ndarray:
+    """Completion keys for ordering: real index for committed txns, a
+    distinct beyond-everything key for indeterminate ones."""
+    rows = np.arange(len(status), dtype=np.int64)
+    return np.where(status == INFO, NEVER_COMPLETED + rows, complete_index)
 
 
 @dataclass

@@ -22,7 +22,7 @@ from typing import Iterable
 
 import numpy as np
 
-from .encode import INFO, NEVER_COMPLETED, EncodedHistory
+from .encode import EncodedHistory, effective_complete_index
 
 WW, WR, RW, PROC, RT = 0, 1, 2, 3, 4
 EDGE_NAMES = {WW: "ww", WR: "wr", RW: "rw", PROC: "process", RT: "realtime"}
@@ -57,9 +57,8 @@ def build_edges(enc: EncodedHistory, process_order: bool = False,
         if nxt is not None and nxt != r:
             edges.append((r, nxt, RW))
     # Indeterminate txns never completed: nothing is realtime-after them,
-    # and they sort last in their process's order.
-    complete = np.where(enc.status == INFO, NEVER_COMPLETED,
-                        enc.complete_index)
+    # and they sort last (in row order) in their process's order.
+    complete = effective_complete_index(enc.status, enc.complete_index)
     if process_order:
         last_by_proc: dict = {}
         for row in np.argsort(complete, kind="stable"):
@@ -198,21 +197,18 @@ def classify_cycles(n: int, edges: list[tuple[int, int, int]],
             break
 
     # G-single / G2-item: per rw edge, can we get back without / only-with
-    # further rw edges?
+    # further rw edges? One wwr BFS per edge; full-graph BFS only on miss.
     for s, d, ty in edges:
         if ty != RW:
             continue
-        if "G-single" not in out:
-            path = _bfs_path(wwr_adj, d, s)
-            if path is not None:
+        path = _bfs_path(wwr_adj, d, s)
+        if path is not None:
+            if "G-single" not in out:
                 out["G-single"] = path + [d] if want_witnesses else True
-                continue
-        if "G2-item" not in out:
-            path = _bfs_path(wwr_adj, d, s)
-            if path is None:
-                path = _bfs_path(full_adj, d, s)
-                if path is not None:
-                    out["G2-item"] = path + [d] if want_witnesses else True
+        elif "G2-item" not in out:
+            path = _bfs_path(full_adj, d, s)
+            if path is not None:
+                out["G2-item"] = path + [d] if want_witnesses else True
         if "G-single" in out and "G2-item" in out:
             break
     return out

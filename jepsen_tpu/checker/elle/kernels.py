@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...devices import default_devices
-from .encode import INFO, NEVER_COMPLETED, EncodedHistory
+from .encode import EncodedHistory, effective_complete_index
 
 # Flag bit positions in the kernel's output word.
 G0, G1C, G_SINGLE, G2_ITEM, CYCLE = 0, 1, 2, 3, 4
@@ -90,8 +90,8 @@ def pack_batch(encs: list[EncodedHistory],
         appends[i, : len(a)] = a
         reads[i, : len(r)] = r
         invoke_idx[i, : e.n] = e.invoke_index
-        complete_idx[i, : e.n] = np.where(
-            e.status == INFO, NEVER_COMPLETED, e.complete_index)
+        complete_idx[i, : e.n] = effective_complete_index(
+            e.status, e.complete_index)
         process[i, : e.n] = e.process
         n_txns[i] = e.n
     return {"appends": appends, "reads": reads, "n_txns": n_txns,
@@ -241,8 +241,14 @@ def check_batch_device(appends, reads, invoke_index, complete_index, process,
 
 
 def flags_to_names(word: int) -> dict:
-    return {name: True for bit, name in FLAG_NAMES.items()
-            if word & (1 << bit)}
+    """Anomaly names for a flag word. In detect-only mode (classify=False)
+    no classify bits exist, so a set CYCLE bit reports as a generic
+    "cycle" anomaly rather than vanishing."""
+    out = {name: True for bit, name in FLAG_NAMES.items()
+           if word & (1 << bit)}
+    if not out and word & (1 << CYCLE):
+        out["cycle"] = True
+    return out
 
 
 def check_encoded_batch(encs: list[EncodedHistory],

@@ -1,0 +1,87 @@
+"""Deterministic generator simulation harness.
+
+Mirrors the reference's pure_test.clj simulated executors
+(pure_test.clj:126-170): drive a generator to completion against a model
+of worker behavior with fixed latencies — `perfect` (every op completes
+:ok in 10 ms), `perfect_info` (every op times out :info in 10 ms),
+`imperfect` (cycles ok/info/fail with 10/20/30 ms latencies) — recording
+the full invoke/complete history without any real threads or clocks.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+
+from jepsen_tpu import generator as gen
+
+MS = 1_000_000
+
+
+def perfect(op):
+    return {**op, "type": "ok", "time": op["time"] + 10 * MS}
+
+
+def perfect_info(op):
+    return {**op, "type": "info", "time": op["time"] + 10 * MS}
+
+
+def make_imperfect():
+    cycle = itertools.cycle([("ok", 10), ("info", 20), ("fail", 30)])
+
+    def imperfect(op):
+        t, lat = next(cycle)
+        return {**op, "type": t, "time": op["time"] + lat * MS}
+
+    return imperfect
+
+
+def simulate(g, completion_fn, concurrency=None, test=None, max_steps=100_000):
+    """Run generator g to exhaustion; returns the history (invokes and
+    completions interleaved by time). Concurrency comes from the kwarg,
+    else test["concurrency"], else 2."""
+    test = dict(test or {})
+    if concurrency is not None:
+        test["concurrency"] = concurrency
+    test.setdefault("concurrency", 2)
+    ctx = gen.Context.for_test(test)
+    history: list = []
+    inflight: list = []  # heap of (time, seq, completion-op)
+    tiebreak = itertools.count()
+
+    def apply_completion():
+        nonlocal ctx, g
+        t, _, comp = heapq.heappop(inflight)
+        thread = ctx.process_to_thread(comp["process"])
+        ctx = ctx.with_time(t).free(thread)
+        if thread != gen.NEMESIS and comp.get("type") == "info":
+            ctx = ctx.with_worker(thread, ctx.next_process(thread))
+        g = gen.update(g, test, ctx, comp)
+        history.append(comp)
+
+    for _ in range(max_steps):
+        res = gen.op(g, test, ctx)
+        if res is None:
+            if not inflight:
+                return history
+            apply_completion()
+            continue
+        o, g2 = res
+        if o is gen.PENDING:
+            if not inflight:
+                raise RuntimeError("generator pending forever (deadlock)")
+            apply_completion()
+            continue
+        if inflight and inflight[0][0] <= o.get("time", ctx.time):
+            # A completion is due before this op; handle it first and
+            # re-ask the generator (discarding g2, like the interpreter).
+            apply_completion()
+            continue
+        thread = ctx.process_to_thread(o.get("process"))
+        ctx = ctx.with_time(o["time"]).busy(thread)
+        g = gen.update(g2, test, ctx, o)
+        history.append(o)
+        comp = completion_fn(o)
+        if comp is not None:
+            heapq.heappush(inflight, (comp["time"], next(tiebreak), comp))
+    raise RuntimeError("simulation did not terminate")

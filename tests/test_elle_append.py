@@ -338,6 +338,40 @@ def test_differential_cpu_vs_tpu(seed, realtime, process_order):
     assert cpu == tpu
 
 
+def test_process_order_parity_with_crashed_txns():
+    """Two same-process crashed txns + a read proving reversed ww order:
+    process edge A->B plus ww edge B->A is a G0 cycle; both backends must
+    see it (regression: device tie-breaking at never-completed keys)."""
+    hist = [
+        {"type": "invoke", "process": 0, "f": "txn",
+         "value": [["append", "x", 2]]},
+        {"type": "info", "process": 0, "f": "txn", "value": None},
+        {"type": "invoke", "process": 0, "f": "txn",
+         "value": [["append", "x", 1]]},
+        {"type": "info", "process": 0, "f": "txn", "value": None},
+        {"type": "invoke", "process": 1, "f": "txn",
+         "value": [["r", "x", None]]},
+        {"type": "ok", "process": 1, "f": "txn",
+         "value": [["r", "x", [1, 2]]]},
+    ]
+    enc = encode.encode_history(hist)
+    cpu = dict.fromkeys(
+        elle.cycle_anomalies_cpu(enc, process_order=True), True)
+    tpu = kernels.check_encoded_batch([enc], process_order=True)[0]
+    assert cpu == tpu
+    assert "G0" in cpu
+
+
+def test_detect_mode_reports_generic_cycle():
+    enc = encode.encode_history(g1c_history())
+    r = kernels.check_encoded_batch([enc], classify=False)
+    assert r == [{"cycle": True}]
+    valid_enc = encode.encode_history(seq_history(
+        ([["append", "x", 1]], [["append", "x", 1]])))
+    r = kernels.check_encoded_batch([valid_enc], classify=False)
+    assert r == [{}]
+
+
 def test_differential_handcrafted_cases():
     hists = [g0_history(), g1c_history(), g_single_history(), g2_history()]
     encs = [encode.encode_history(h) for h in hists]

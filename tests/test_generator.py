@@ -1,0 +1,255 @@
+"""Pure generator DSL tests, driven by the deterministic simulator.
+
+Scenarios follow the reference's pure_test.clj structure: every
+combinator gets at least one deftest-equivalent.
+"""
+
+import pytest
+
+from gen_sim import MS, make_imperfect, perfect, perfect_info, simulate
+from jepsen_tpu import generator as gen
+
+
+def invokes(history):
+    return [o for o in history if o["type"] == "invoke"]
+
+
+def fs(history):
+    return [o["f"] for o in invokes(history)]
+
+
+# -- lifting plain values --------------------------------------------------
+
+def test_map_literal_yields_one_op():
+    h = simulate({"f": "write", "value": 2}, perfect)
+    assert len(invokes(h)) == 1
+    op = invokes(h)[0]
+    assert op["f"] == "write" and op["type"] == "invoke"
+    assert op["time"] == 0
+    assert op["process"] in (0, 1, "nemesis")
+
+
+def test_seq_of_maps():
+    h = simulate([{"f": "a"}, {"f": "b"}, {"f": "c"}], perfect)
+    assert fs(h) == ["a", "b", "c"]
+
+
+def test_fn_generator():
+    counter = [0]
+
+    def f():
+        counter[0] += 1
+        if counter[0] <= 3:
+            return {"f": "w", "value": counter[0]}
+        return None
+
+    h = simulate(f, perfect)
+    assert [o["value"] for o in invokes(h)] == [1, 2, 3]
+
+
+def test_none_is_empty():
+    assert simulate(None, perfect) == []
+
+
+# -- limit / once / repeat ------------------------------------------------
+
+def test_limit():
+    h = simulate(gen.limit(3, gen.repeat_gen({"f": "w"})), perfect)
+    assert fs(h) == ["w", "w", "w"]
+
+
+def test_once():
+    h = simulate(gen.once(gen.repeat_gen({"f": "w"})), perfect)
+    assert len(invokes(h)) == 1
+
+
+def test_repeat_bounded():
+    h = simulate(gen.repeat_gen({"f": "w"}, 5), perfect)
+    assert len(invokes(h)) == 5
+
+
+# -- map / f_map / filter --------------------------------------------------
+
+def test_map_and_fmap():
+    g = gen.map_gen(lambda o: {**o, "value": 9},
+                    gen.limit(2, gen.repeat_gen({"f": "w"})))
+    h = simulate(g, perfect)
+    assert [o["value"] for o in invokes(h)] == [9, 9]
+
+    g = gen.f_map({"start": "start-partition"},
+                  gen.limit(1, gen.repeat_gen({"f": "start"})))
+    h = simulate(g, perfect)
+    assert fs(h) == ["start-partition"]
+
+
+def test_filter():
+    vals = [{"f": "w", "value": i} for i in range(6)]
+    g = gen.filter_gen(lambda o: o["value"] % 2 == 0, vals)
+    h = simulate(g, perfect)
+    assert [o["value"] for o in invokes(h)] == [0, 2, 4]
+
+
+# -- mix / flip-flop / any ------------------------------------------------
+
+def test_mix_draws_from_all():
+    g = gen.mix([gen.limit(5, gen.repeat_gen({"f": "a"})),
+                 gen.limit(5, gen.repeat_gen({"f": "b"}))])
+    h = simulate(g, perfect)
+    assert sorted(fs(h)) == ["a"] * 5 + ["b"] * 5
+
+
+def test_flip_flop():
+    g = gen.flip_flop([{"f": "a"}, {"f": "a"}, {"f": "a"}],
+                      [{"f": "b"}, {"f": "b"}])
+    h = simulate(g, perfect)
+    assert fs(h) == ["a", "b", "a", "b", "a"]
+
+
+def test_any_prefers_soonest():
+    g = gen.any_gen(gen.limit(1, gen.repeat_gen({"f": "a"})),
+                    gen.limit(1, gen.repeat_gen({"f": "b"})))
+    h = simulate(g, perfect)
+    assert sorted(fs(h)) == ["a", "b"]
+
+
+# -- time: stagger / delay_til / time_limit --------------------------------
+
+def test_stagger_spaces_ops():
+    g = gen.stagger(0.01, gen.limit(10, gen.repeat_gen({"f": "w"})))
+    h = simulate(g, perfect)
+    times = [o["time"] for o in invokes(h)]
+    assert times == sorted(times)
+    # Mean interval ~10ms over 10 ops: total elapsed within loose bounds.
+    assert 0 < times[-1] < 10 * 40 * MS
+
+
+def test_delay_til_aligns():
+    g = gen.delay_til(0.01, gen.limit(5, gen.repeat_gen({"f": "w"})))
+    h = simulate(g, perfect)
+    for o in invokes(h):
+        assert o["time"] % (10 * MS) == 0
+
+
+def test_time_limit():
+    g = gen.time_limit(0.05, gen.clients(gen.repeat_gen({"f": "w"})))
+    h = simulate(g, perfect, concurrency=1)
+    times = [o["time"] for o in invokes(h)]
+    # Ops start at 0, complete every 10ms; cutoff at 50ms.
+    assert times[-1] < 50 * MS
+    assert 3 <= len(times) <= 6
+
+
+# -- threads: clients / nemesis / each_thread / reserve --------------------
+
+def test_clients_excludes_nemesis():
+    g = gen.clients(gen.limit(6, gen.repeat_gen({"f": "w"})))
+    h = simulate(g, perfect)
+    assert all(isinstance(o["process"], int) for o in invokes(h))
+
+
+def test_nemesis_only():
+    g = gen.nemesis(gen.limit(2, gen.repeat_gen({"f": "kill"})))
+    h = simulate(g, perfect)
+    assert all(o["process"] == "nemesis" for o in invokes(h))
+
+
+def test_clients_nemesis_routing():
+    g = gen.clients(gen.limit(4, gen.repeat_gen({"f": "w"})),
+                    gen.limit(2, gen.repeat_gen({"f": "kill"})))
+    h = simulate(g, perfect)
+    client_fs = [o["f"] for o in invokes(h) if isinstance(o["process"], int)]
+    nem_fs = [o["f"] for o in invokes(h) if o["process"] == "nemesis"]
+    assert client_fs == ["w"] * 4
+    assert nem_fs == ["kill"] * 2
+
+
+def test_each_thread():
+    g = gen.each_thread({"f": "w"})
+    h = simulate(g, perfect, concurrency=3)
+    procs = sorted(str(o["process"]) for o in invokes(h))
+    assert procs == ["0", "1", "2", "nemesis"]
+
+
+def test_reserve():
+    g = gen.reserve(1, gen.limit(2, gen.repeat_gen({"f": "a"})),
+                    1, gen.limit(2, gen.repeat_gen({"f": "b"})),
+                    gen.clients(gen.limit(2, gen.repeat_gen({"f": "c"}))))
+    h = simulate(g, perfect, concurrency=3)
+    by_f = {}
+    for o in invokes(h):
+        by_f.setdefault(o["f"], set()).add(o["process"])
+    assert by_f["a"] == {0}
+    assert by_f["b"] == {1}
+    assert by_f["c"] == {2}
+
+
+# -- synchronize / phases / then ------------------------------------------
+
+def test_phases_barrier():
+    g = gen.phases(gen.limit(4, gen.repeat_gen({"f": "a"})),
+                   gen.limit(2, gen.repeat_gen({"f": "b"})))
+    h = simulate(g, perfect, concurrency=2)
+    seq = fs(h)
+    assert seq == ["a", "a", "a", "a", "b", "b"]
+    # All a-completions precede the first b invocation.
+    first_b = next(o for o in h if o["type"] == "invoke" and o["f"] == "b")
+    a_comps = [o for o in h if o["type"] == "ok" and o["f"] == "a"]
+    assert all(c["time"] <= first_b["time"] for c in a_comps)
+
+
+def test_then():
+    g = gen.then(gen.once({"f": "b"}), gen.limit(2, gen.repeat_gen({"f": "a"})))
+    h = simulate(g, perfect)
+    assert fs(h) == ["a", "a", "b"]
+
+
+# -- until_ok / process_limit ----------------------------------------------
+
+def test_until_ok_with_perfect():
+    g = gen.until_ok(gen.repeat_gen({"f": "w"}))
+    h = simulate(g, perfect, concurrency=1)
+    # Stops after the first ok completion (plus ops already in flight).
+    assert len(invokes(h)) <= 2
+    assert any(o["type"] == "ok" for o in h)
+
+
+def test_process_limit_with_crashes():
+    g = gen.process_limit(5, gen.clients(gen.repeat_gen({"f": "w"})))
+    h = simulate(g, perfect_info, concurrency=2)
+    procs = {o["process"] for o in invokes(h)}
+    assert len(procs) <= 5
+    # Crashes retire processes: later processes appear.
+    assert max(procs) >= 2
+
+
+def test_imperfect_mix_of_completions():
+    g = gen.clients(gen.limit(9, gen.repeat_gen({"f": "w"})))
+    h = simulate(g, make_imperfect(), concurrency=3)
+    types = {o["type"] for o in h}
+    assert types == {"invoke", "ok", "info", "fail"}
+    # info-crashed processes get replaced
+    assert any(isinstance(o["process"], int) and o["process"] >= 3
+               for o in invokes(h))
+
+
+# -- validate --------------------------------------------------------------
+
+def test_validate_rejects_bad_generator():
+    class Bad(gen.Generator):
+        def op(self, test, ctx):
+            return ({"f": "w"}, None)  # missing time/process
+
+    with pytest.raises(ValueError):
+        simulate(gen.Validate(Bad()), perfect)
+
+
+def test_update_reaches_nested_generators():
+    seen = []
+
+    def on_upd(this, test, ctx, event):
+        seen.append(event["type"])
+        return this
+
+    g = gen.on_update(on_upd, gen.limit(2, gen.repeat_gen({"f": "w"})))
+    simulate(g, perfect)
+    assert "invoke" in seen and "ok" in seen

@@ -1,0 +1,149 @@
+"""Interpreter tests: real worker threads, fake clients.
+
+Mirrors the reference's interpreter_test.clj: reified ok/failing/crashing
+clients, then assertions over the produced history's structure, timing,
+and process bookkeeping (crash → process remap)."""
+
+import threading
+
+import pytest
+
+from jepsen_tpu import client as jclient
+from jepsen_tpu import generator as gen
+from jepsen_tpu import util
+from jepsen_tpu.generator import interpreter
+
+
+class OkClient(jclient.Client):
+    def invoke(self, test, op):
+        return {**op, "type": "ok"}
+
+
+class CrashingClient(jclient.Client):
+    """Raises on every invoke — ops become :info and processes retire."""
+
+    def invoke(self, test, op):
+        raise RuntimeError("kaboom")
+
+
+class EveryOtherFails(jclient.Client):
+    lock = threading.Lock()
+    n = 0
+
+    def invoke(self, test, op):
+        with EveryOtherFails.lock:
+            EveryOtherFails.n += 1
+            fail = EveryOtherFails.n % 2 == 0
+        return {**op, "type": "fail" if fail else "ok"}
+
+
+def run_test(**kw):
+    test = {"concurrency": 2, "nodes": ["n1", "n2"], **kw}
+    with util.relative_time():
+        return interpreter.run(test)
+
+
+def test_basic_run_produces_paired_history():
+    test_gen = gen.clients(gen.limit(10, gen.repeat_gen({"f": "w", "value": 1})))
+    h = run_test(client=OkClient(), generator=test_gen)
+    invokes = [o for o in h if o["type"] == "invoke"]
+    oks = [o for o in h if o["type"] == "ok"]
+    assert len(invokes) == 10
+    assert len(oks) == 10
+    # times are monotonically nondecreasing
+    times = [o["time"] for o in h]
+    assert times == sorted(times)
+    # every completion follows its invocation for the same process
+    pending = set()
+    for o in h:
+        if o["type"] == "invoke":
+            assert o["process"] not in pending
+            pending.add(o["process"])
+        else:
+            assert o["process"] in pending
+            pending.remove(o["process"])
+
+
+def test_crash_remaps_process():
+    test_gen = gen.clients(gen.limit(4, gen.repeat_gen({"f": "w"})))
+    h = run_test(client=CrashingClient(), generator=test_gen)
+    infos = [o for o in h if o["type"] == "info"]
+    assert len(infos) == 4
+    procs = {o["process"] for o in h}
+    # crashed processes are replaced by p + concurrency
+    assert any(p >= 2 for p in procs if isinstance(p, int))
+    errors = {o.get("error", "") for o in infos}
+    assert any("indeterminate" in e for e in errors)
+
+
+def test_mixed_ok_fail():
+    EveryOtherFails.n = 0
+    test_gen = gen.clients(gen.limit(8, gen.repeat_gen({"f": "w"})))
+    h = run_test(client=EveryOtherFails(), generator=test_gen)
+    comps = [o for o in h if o["type"] in ("ok", "fail")]
+    assert len(comps) == 8
+    assert {o["type"] for o in comps} == {"ok", "fail"}
+
+
+def test_sleep_and_log_stay_out_of_history():
+    test_gen = gen.clients([
+        gen.once({"f": "w"}),
+        gen.sleep(0.01),
+        gen.log_gen("hello"),
+        gen.once({"f": "w"}),
+    ])
+    h = run_test(client=OkClient(), generator=test_gen)
+    assert all(o["type"] in ("invoke", "ok") for o in h)
+    assert len([o for o in h if o["type"] == "invoke"]) == 2
+
+
+def test_nemesis_ops_routed():
+    class FakeNemesis:
+        def invoke(self, test, op):
+            return {**op, "type": "info", "value": "partitioned"}
+
+    test_gen = gen.any_gen(
+        gen.clients(gen.limit(2, gen.repeat_gen({"f": "w"}))),
+        gen.nemesis(gen.once({"f": "start-partition"})))
+    h = run_test(client=OkClient(), generator=test_gen,
+                 nemesis=FakeNemesis())
+    nem_ops = [o for o in h if o["process"] == "nemesis"]
+    assert len(nem_ops) == 2  # invoke + info completion
+    assert nem_ops[-1]["value"] == "partitioned"
+
+
+def test_client_lifecycle_open_close():
+    events = []
+    lock = threading.Lock()
+
+    class LifecycleClient(jclient.Client):
+        def open(self, test, node):
+            c = LifecycleClient()
+            with lock:
+                events.append(("open", node))
+            return c
+
+        def invoke(self, test, op):
+            return {**op, "type": "ok"}
+
+        def close(self, test):
+            with lock:
+                events.append(("close", None))
+
+    test_gen = gen.clients(gen.limit(4, gen.repeat_gen({"f": "w"})))
+    run_test(client=LifecycleClient(), generator=test_gen)
+    opens = [e for e in events if e[0] == "open"]
+    closes = [e for e in events if e[0] == "close"]
+    assert len(opens) == len(closes)
+    assert len(opens) >= 2  # one per worker thread at least
+    # clients bound round-robin to nodes
+    assert {n for _, n in opens} == {"n1", "n2"}
+
+
+def test_generator_exception_shuts_down_workers():
+    class Boom(gen.Generator):
+        def op(self, test, ctx):
+            raise ValueError("bad generator")
+
+    with pytest.raises(RuntimeError):
+        run_test(client=OkClient(), generator=Boom())
