@@ -1,0 +1,151 @@
+"""L8: the command-line interface.
+
+Counterpart of jepsen.cli (jepsen/src/jepsen/cli.clj): per-suite mains
+call `run_cli(test_fn=...)` to get `test`, `analyze`, and `serve`
+subcommands with the standard option set (cli.clj:55-99) and exit codes
+(cli.clj:117-127):
+
+    0    test ran and was valid
+    1    test ran and was invalid
+    2    validity unknown
+    254  usage error
+    255  crash
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+from pathlib import Path
+from typing import Callable
+
+from . import core
+from .store import Store
+
+log = logging.getLogger(__name__)
+
+
+def validity_exit_code(results: dict | None) -> int:
+    v = (results or {}).get("valid?")
+    if v is True:
+        return 0
+    if v == "unknown" or v is None:
+        return 2
+    return 1
+
+
+def add_test_opts(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--node", "-n", action="append", dest="nodes",
+                   metavar="HOST", help="node to test (repeatable)")
+    p.add_argument("--nodes-file", help="file with one node per line")
+    p.add_argument("--username", default="root")
+    p.add_argument("--password")
+    p.add_argument("--port", type=int, default=22)
+    p.add_argument("--private-key-path")
+    p.add_argument("--dummy", action="store_true",
+                   help="use the no-op dummy remote")
+    p.add_argument("--concurrency", default="1n",
+                   help="worker count; 'Nn' means N per node")
+    p.add_argument("--time-limit", type=float, default=60.0,
+                   help="seconds of main workload")
+    p.add_argument("--test-count", type=int, default=1)
+    p.add_argument("--leave-db-running", action="store_true")
+    p.add_argument("--store", default="store", help="store directory")
+
+
+def test_map_from_args(args: argparse.Namespace) -> dict:
+    nodes = list(args.nodes or [])
+    if args.nodes_file:
+        nodes += [ln.strip() for ln in
+                  Path(args.nodes_file).read_text().splitlines()
+                  if ln.strip()]
+    t: dict = {
+        "concurrency": args.concurrency,
+        "time_limit": args.time_limit,
+        "leave_db_running": args.leave_db_running,
+        "store": Store(args.store),
+        "ssh": {"username": args.username, "password": args.password,
+                "port": args.port, "private_key_path": args.private_key_path,
+                "dummy": args.dummy},
+    }
+    if nodes:
+        t["nodes"] = nodes
+    return t
+
+
+def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
+            name: str = "jepsen-tpu", opt_fn=None,
+            argv: list[str] | None = None) -> int:
+    """Build and dispatch the CLI. `test_fn(base_test, args)` returns the
+    full test map; `opt_fn(parser)` may add suite-specific options."""
+    parser = argparse.ArgumentParser(prog=name)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_test = sub.add_parser("test", help="run a test")
+    add_test_opts(p_test)
+    if opt_fn:
+        opt_fn(p_test)
+
+    p_an = sub.add_parser("analyze",
+                          help="re-run the checker on a stored history")
+    p_an.add_argument("run_dir", nargs="?",
+                      help="store run dir (default: latest)")
+    # The same option set as `test` (including --store), so test_fn sees
+    # a complete args namespace when rebuilding checkers (cli.clj:381-411).
+    add_test_opts(p_an)
+    if opt_fn:
+        opt_fn(p_an)
+
+    p_serve = sub.add_parser("serve", help="serve the store over HTTP")
+    p_serve.add_argument("--port", type=int, default=8080)
+    p_serve.add_argument("--host", default="0.0.0.0")
+    p_serve.add_argument("--store", default="store")
+
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as e:
+        return 254 if e.code not in (0, None) else 0
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s [%(name)s] %(message)s")
+
+    try:
+        if args.command == "test":
+            code = 0
+            for i in range(args.test_count):
+                test = test_fn(test_map_from_args(args), args)
+                test = core.run(test)
+                print(json.dumps(
+                    {"valid?": test["results"].get("valid?"),
+                     "dir": str(test["store"].test_dir(test))}))
+                code = max(code, validity_exit_code(test.get("results")))
+                if code:
+                    break
+            return code
+        if args.command == "analyze":
+            store = Store(args.store)
+            run_dir = args.run_dir or store.latest()
+            if run_dir is None:
+                print("no stored runs", file=sys.stderr)
+                return 254
+            stored = store.load_test(run_dir)
+            test = test_fn(stored, args)
+            test.setdefault("name", stored.get("name", "analyze"))
+            test["history"] = stored["history"]
+            test["store"] = store
+            test = core.analyze(test)
+            print(json.dumps({"valid?": test["results"].get("valid?")}))
+            return validity_exit_code(test["results"])
+        if args.command == "serve":
+            from . import web
+            web.serve(Store(args.store), host=args.host, port=args.port)
+            return 0
+        return 254
+    except KeyboardInterrupt:
+        return 255
+    except Exception:
+        log.exception("fatal error")
+        return 255

@@ -184,6 +184,11 @@ class Seq(Generator):
             res = op(head, test, ctx)
             if res is not None:
                 o, g2 = res
+                if idx >= len(self.items):
+                    # Tail exhausted: unwrap to the head's own state so
+                    # chained Seqs don't nest one level per op
+                    # (pure.clj:536-548's cons/gen' distinction).
+                    return (o, g2)
                 return (o, Seq(g2, self.items, idx))
             if idx >= len(self.items):
                 return None

@@ -1,0 +1,102 @@
+"""CLI and web UI tests."""
+
+import json
+import threading
+import urllib.request
+
+from jepsen_tpu import checker as jchecker
+from jepsen_tpu import cli, generator as gen, web, workloads
+from jepsen_tpu.store import Store
+
+
+def make_test_fn(tmp_path):
+    def test_fn(base, args):
+        db, client = workloads.atom_fixtures()
+        return {
+            **base,
+            "name": "cli-test",
+            "nodes": base.get("nodes") or ["n1", "n2"],
+            "db": db,
+            "client": client,
+            "generator": gen.clients(
+                gen.limit(20, gen.repeat_gen({"f": "read"}))),
+            "checker": jchecker.stats(),
+            "store": Store(tmp_path / "store"),
+        }
+
+    return test_fn
+
+
+def test_cli_test_command(tmp_path, capsys):
+    code = cli.run_cli(make_test_fn(tmp_path),
+                       argv=["test", "--dummy", "--concurrency", "2"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert code == 0
+    assert out["valid?"] is True
+
+
+def test_cli_analyze_command(tmp_path, capsys):
+    test_fn = make_test_fn(tmp_path)
+    assert cli.run_cli(test_fn, argv=["test", "--dummy"]) == 0
+    capsys.readouterr()
+    code = cli.run_cli(test_fn, argv=["analyze", "--store",
+                                      str(tmp_path / "store")])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert code == 0
+    assert out["valid?"] is True
+
+
+def test_cli_invalid_exit_code(tmp_path, capsys):
+    class AlwaysInvalid(jchecker.Checker):
+        def check(self, test, history, opts):
+            return {"valid?": False}
+
+    def test_fn(base, args):
+        t = make_test_fn(tmp_path)(base, args)
+        t["checker"] = AlwaysInvalid()
+        return t
+
+    assert cli.run_cli(test_fn, argv=["test", "--dummy"]) == 1
+
+
+def test_cli_usage_error(tmp_path):
+    assert cli.run_cli(make_test_fn(tmp_path), argv=["bogus"]) == 254
+
+
+def test_web_serves_store(tmp_path, capsys):
+    # Build a store with one run.
+    cli.run_cli(make_test_fn(tmp_path), argv=["test", "--dummy"])
+    store = Store(tmp_path / "store")
+    srv = web.make_server(store, "127.0.0.1", 0)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        home = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/").read().decode()
+        assert "cli-test" in home and "valid" in home
+        # run dir listing
+        import re
+        m = re.search(r"href='/files/([^']+)'", home)
+        run = m.group(1)
+        listing = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/files/{run}").read().decode()
+        assert "history.edn" in listing
+        # file fetch
+        hist = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/files/{run}/history.edn").read()
+        assert b":invoke" in hist
+        # zip export
+        z = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/zip/{run}").read()
+        assert z[:2] == b"PK"
+        # traversal guard
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/files/../../etc/passwd")
+            raised = False
+        except urllib.error.HTTPError as e:
+            raised = e.code == 404
+        assert raised
+    finally:
+        srv.shutdown()

@@ -1,0 +1,213 @@
+"""Control plane tests: escaping, session wrapping, backends, fan-out.
+
+The dummy remote mirrors the reference's :dummy? mode test strategy
+(SURVEY.md §4.2): full command flows recorded with zero I/O. The local
+remote runs real commands in this process's environment.
+"""
+
+import pytest
+
+from jepsen_tpu import control, db, os_setup
+from jepsen_tpu.control import CommandError, DummyRemote, Lit, LocalRemote
+from jepsen_tpu.control import net as cnet
+from jepsen_tpu.control import util as cutil
+
+
+def dummy_test(nodes=("n1", "n2", "n3")):
+    return {"nodes": list(nodes), "ssh": {"dummy": True}}
+
+
+def dummy_session(test=None, node="n1"):
+    test = test or dummy_test()
+    return control.session(test, node), test["remote"]
+
+
+# -- escaping --------------------------------------------------------------
+
+def test_build_cmd_escaping():
+    assert control.build_cmd("echo", "hi") == "echo hi"
+    assert control.build_cmd("echo", "hi there") == "echo 'hi there'"
+    assert control.build_cmd("echo", "it's") == 'echo \'it\'"\'"\'s\''
+    assert control.build_cmd("kill", "-9", 123) == "kill -9 123"
+    assert control.build_cmd(Lit("a | b")) == "a | b"
+    assert control.build_cmd(["ls", "-la"], "/tmp") == "ls -la /tmp"
+
+
+# -- session wrapping ------------------------------------------------------
+
+def test_exec_records_commands():
+    sess, remote = dummy_session()
+    sess.exec("echo", "hello")
+    assert remote.actions[-1] == ("n1", "execute", "echo hello")
+
+
+def test_su_wraps_sudo():
+    sess, remote = dummy_session()
+    sess.su().exec("whoami")
+    node, kind, cmd = remote.actions[-1]
+    assert cmd.startswith("sudo -S -u root bash -c ")
+    assert "whoami" in cmd
+
+
+def test_cd_wraps_directory():
+    sess, remote = dummy_session()
+    sess.cd("/opt/db").exec("ls")
+    assert remote.actions[-1][2] == "cd /opt/db && ls"
+
+
+def test_su_and_cd_compose():
+    sess, remote = dummy_session()
+    sess.cd("/opt").su().exec("ls")
+    cmd = remote.actions[-1][2]
+    assert cmd.startswith("sudo") and "cd /opt && ls" in cmd
+
+
+def test_upload_download_recorded():
+    sess, remote = dummy_session()
+    sess.upload("/local/x", "/remote/x")
+    sess.download("/remote/y", "/local/y")
+    assert ("n1", "upload", ("/local/x", "/remote/x")) in remote.actions
+    assert ("n1", "download", ("/remote/y", "/local/y")) in remote.actions
+
+
+# -- local remote (real execution) ----------------------------------------
+
+def local_session():
+    test = {"nodes": ["local"], "remote": LocalRemote()}
+    return control.session(test, "local")
+
+
+def test_local_exec():
+    sess = local_session()
+    assert sess.exec("echo", "hello world") == "hello world"
+
+
+def test_local_exec_failure_raises():
+    sess = local_session()
+    with pytest.raises(CommandError) as ei:
+        sess.exec("false")
+    assert ei.value.node == "local"
+
+
+def test_local_exec_ok_captures_failure():
+    sess = local_session()
+    res = sess.exec_ok(Lit("echo out; echo err >&2; exit 3"))
+    assert res.exit == 3
+    assert res.out.strip() == "out"
+    assert res.err.strip() == "err"
+
+
+def test_local_exists_and_tmpdir(tmp_path):
+    sess = local_session()
+    assert cutil.exists(sess, str(tmp_path))
+    assert not cutil.exists(sess, str(tmp_path / "nope"))
+    d = cutil.tmp_dir(sess, str(tmp_path / "jep"))
+    assert cutil.exists(sess, d)
+
+
+def test_local_daemon_lifecycle(tmp_path):
+    sess = local_session()
+    pidfile = str(tmp_path / "d.pid")
+    logfile = str(tmp_path / "d.log")
+    cutil.start_daemon(sess, "sleep", 30, pidfile=pidfile, logfile=logfile)
+    assert cutil.daemon_running(sess, pidfile)
+    cutil.stop_daemon(sess, pidfile)
+    assert not cutil.daemon_running(sess, pidfile)
+
+
+# -- on_nodes fan-out ------------------------------------------------------
+
+def test_on_nodes_parallel_sessions():
+    test = dummy_test()
+
+    def setup(t, node):
+        control.exec("hostname")
+        return node.upper()
+
+    out = control.on_nodes(test, setup)
+    assert out == {"n1": "N1", "n2": "N2", "n3": "N3"}
+    execs = [(n, c) for n, k, c in test["remote"].actions if k == "execute"]
+    assert sorted(execs) == [("n1", "hostname"), ("n2", "hostname"),
+                             ("n3", "hostname")]
+
+
+def test_on_nodes_propagates_exceptions():
+    test = dummy_test()
+
+    def boom(t, node):
+        raise ValueError(f"bad {node}")
+
+    with pytest.raises(ValueError):
+        control.on_nodes(test, boom)
+
+
+# -- db cycle against dummy -----------------------------------------------
+
+class RecordingDB(db.DB, db.Primary):
+    def __init__(self):
+        self.events = []
+
+    def setup(self, test, node):
+        self.events.append(("setup", node))
+
+    def teardown(self, test, node):
+        self.events.append(("teardown", node))
+
+    def setup_primary(self, test, node):
+        self.events.append(("primary", node))
+
+
+def test_db_cycle():
+    test = dummy_test()
+    d = RecordingDB()
+    db.cycle(d, test)
+    kinds = [k for k, _ in d.events]
+    assert kinds.count("teardown") == 3
+    assert kinds.count("setup") == 3
+    assert ("primary", "n1") in d.events
+    # teardowns precede setups
+    assert max(i for i, (k, _) in enumerate(d.events) if k == "teardown") \
+        < min(i for i, (k, _) in enumerate(d.events) if k == "setup")
+
+
+def test_db_cycle_retries_setup_failures():
+    test = dummy_test()
+    attempts = []
+
+    class Flaky(db.DB):
+        def setup(self, t, node):
+            attempts.append(node)
+            if len(attempts) <= 3:
+                raise db.SetupFailed("not yet")
+
+    db.cycle(Flaky(), test)
+    assert len(attempts) > 3
+
+
+# -- net helpers -----------------------------------------------------------
+
+def test_net_ip_parsing():
+    sess, remote = dummy_session()
+    remote.responses["getent"] = (
+        "192.168.1.5    STREAM n2\n192.168.1.5    DGRAM\n")
+    cnet.clear_ip_cache()
+    assert cnet.ip(sess, "n2") == "192.168.1.5"
+    # memoized: a second call doesn't re-exec
+    n = len(remote.actions)
+    assert cnet.ip(sess, "n2") == "192.168.1.5"
+    assert len(remote.actions) == n
+
+
+def test_os_debian_setup_commands():
+    test = dummy_test()
+    osd = os_setup.debian()
+
+    def setup(t, node):
+        osd.setup(t, node)
+
+    cnet.clear_ip_cache()
+    control.on_nodes(test, setup, ["n1"])
+    cmds = [c for n, k, c in test["remote"].actions if k == "execute"]
+    assert any("apt-get install" in c for c in cmds)
+    assert any("/etc/hosts" in c for c in cmds)
+    assert any("iptables -F -w" in c for c in cmds)

@@ -1,0 +1,213 @@
+"""Whole-system integration tests: full runs with the dummy remote and
+the in-process atom DB — the reference's core_test.clj strategy
+(basic-cas-test, core_test.clj:61-135; dummy-remote runs, 55-59)."""
+
+import json
+
+import pytest
+
+from jepsen_tpu import checker as jchecker
+from jepsen_tpu import core, generator as gen, net as jnet, workloads
+from jepsen_tpu.checker import elle
+from jepsen_tpu.store import Store
+from jepsen_tpu.workloads import append as append_wl
+from jepsen_tpu.workloads import bank as bank_wl
+from jepsen_tpu.workloads import set_workload
+
+
+def base_test(tmp_path, **kw):
+    db, client = workloads.atom_fixtures()
+    t = {
+        "name": "itest",
+        "nodes": ["n1", "n2", "n3"],
+        "concurrency": 3,
+        "ssh": {"dummy": True},
+        "net": jnet.noop(),
+        "db": db,
+        "client": client,
+        "store": Store(tmp_path / "store"),
+    }
+    t.update(kw)
+    return t
+
+
+def test_full_cas_run(tmp_path):
+    """1000 ops through the full runner, checked + persisted."""
+    test = base_test(
+        tmp_path,
+        generator=gen.clients(gen.limit(1000, gen.mix([
+            gen.repeat_gen({"f": "read"}),
+            lambda: {"f": "write", "value": __import__("random").randint(0, 4)},
+            lambda: {"f": "cas",
+                     "value": [__import__("random").randint(0, 4),
+                               __import__("random").randint(0, 4)]},
+        ]))),
+        checker=jchecker.compose({"stats": jchecker.stats()}),
+    )
+    test = core.run(test)
+    assert test["results"]["valid?"] is True
+    assert test["results"]["stats"]["count"] == 1000
+    hist = test["history"]
+    assert len(hist) == 2000  # every op completed
+    # indexes assigned
+    assert [o["index"] for o in hist] == list(range(2000))
+    # artifacts persisted
+    d = test["store"].test_dir(test)
+    assert (d / "history.edn").exists()
+    assert (d / "results.edn").exists()
+    loaded = test["store"].load_results(d)
+    assert loaded["valid?"] is True
+
+
+def test_append_workload_end_to_end_with_elle(tmp_path):
+    """List-append against a real (serializable) in-process store,
+    checked by the Elle checker: must be valid."""
+    import threading
+
+    class ListDB:
+        def __init__(self):
+            self.lists = {}
+            self.lock = threading.Lock()
+
+    store = ListDB()
+
+    class ListClient(workloads.jclient.Client):
+        def open(self, test, node):
+            return self
+
+        def invoke(self, test, op):
+            out = []
+            with store.lock:
+                for mf, k, v in op["value"]:
+                    if mf == "append":
+                        store.lists.setdefault(k, []).append(v)
+                        out.append([mf, k, v])
+                    else:
+                        out.append(["r", k, list(store.lists.get(k, []))])
+            return {**op, "type": "ok", "value": out}
+
+    wl = append_wl.test(key_count=4)
+    test = base_test(
+        tmp_path, name="append-itest",
+        client=ListClient(),
+        generator=gen.time_limit(1.0, wl["generator"]),
+        checker=wl["checker"],
+    )
+    test = core.run(test)
+    r = test["results"]
+    assert r["valid?"] is True, r.get("anomaly-types")
+    assert r["txn-count"] > 50
+
+
+def test_bank_workload_catches_broken_bank(tmp_path):
+    """A non-transactional bank (reads see partial transfers) must be
+    flagged invalid."""
+    import threading
+
+    balances = {a: 0 for a in range(4)}
+    balances[0] = 20
+    lock = threading.Lock()
+
+    class BrokenBank(workloads.jclient.Client):
+        def open(self, test, node):
+            return self
+
+        def invoke(self, test, op):
+            import time
+            if op["f"] == "read":
+                # Read account-by-account without a lock: torn reads.
+                snap = {}
+                for a in balances:
+                    snap[a] = balances[a]
+                    time.sleep(0.0002)
+                return {**op, "type": "ok", "value": snap}
+            v = op["value"]
+            with lock:
+                if balances[v["from"]] < v["amount"]:
+                    return {**op, "type": "fail"}
+                balances[v["from"]] -= v["amount"]
+            import time as t2
+            t2.sleep(0.0005)  # the torn window
+            with lock:
+                balances[v["to"]] += v["amount"]
+            return {**op, "type": "ok"}
+
+    wl = bank_wl.test(accounts=list(range(4)), total=20)
+    test = base_test(
+        tmp_path, name="bank-itest",
+        client=BrokenBank(),
+        generator=gen.time_limit(1.5, wl["generator"]),
+        checker=wl["checker"],
+        **{"total-amount": 20},
+    )
+    test = core.run(test)
+    assert test["results"]["valid?"] is False
+    assert test["results"]["bad-read-count"] > 0
+
+
+def test_set_workload(tmp_path):
+    import threading
+
+    s = set()
+    lock = threading.Lock()
+
+    class SetClient(workloads.jclient.Client):
+        def open(self, test, node):
+            return self
+
+        def invoke(self, test, op):
+            with lock:
+                if op["f"] == "add":
+                    s.add(op["value"])
+                    return {**op, "type": "ok"}
+                return {**op, "type": "ok", "value": sorted(s)}
+
+    wl = set_workload.test(n=50)
+    test = base_test(tmp_path, name="set-itest", client=SetClient(),
+                     generator=wl["generator"], checker=wl["checker"])
+    test = core.run(test)
+    assert test["results"]["valid?"] is True
+    assert test["results"]["ok-count"] == 50
+
+
+def test_crashed_clients_and_nemesis_in_history(tmp_path):
+    class Flaky(workloads.jclient.Client):
+        def open(self, test, node):
+            return self
+
+        def invoke(self, test, op):
+            if op["value"] == 3:
+                raise RuntimeError("crash!")
+            return {**op, "type": "ok"}
+
+    class FakeNemesis:
+        def setup(self, test):
+            return self
+
+        def invoke(self, test, op):
+            return {**op, "type": "info", "value": "did-a-fault"}
+
+        def teardown(self, test):
+            pass
+
+    test = base_test(
+        tmp_path, name="crash-itest",
+        client=Flaky(),
+        nemesis=FakeNemesis(),
+        generator=gen.any_gen(
+            gen.clients([{"f": "w", "value": v} for v in range(8)]),
+            gen.nemesis(gen.once({"f": "break", "type": "info"}))),
+        checker=jchecker.stats(),
+    )
+    test = core.run(test)
+    hist = test["history"]
+    assert any(o["type"] == "info" and isinstance(o["process"], int)
+               for o in hist)
+    assert any(o["process"] == "nemesis" for o in hist)
+
+
+def test_concurrency_n_syntax():
+    t = core.prepare_test({"nodes": ["a", "b", "c"], "concurrency": "2n"})
+    assert t["concurrency"] == 6
+    t = core.prepare_test({"nodes": ["a"], "concurrency": "7"})
+    assert t["concurrency"] == 7

@@ -1,0 +1,232 @@
+"""Nemesis layer tests: grudges, partitioners, composition, packages,
+and native clock-helper builds."""
+
+import random
+import subprocess
+
+import pytest
+
+from gen_sim import perfect_info, simulate
+from jepsen_tpu import db as jdb
+from jepsen_tpu import generator as gen
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu import net as jnet
+from jepsen_tpu.nemesis import combined
+
+
+NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+# -- grudges ---------------------------------------------------------------
+
+def test_bisect():
+    assert nem.bisect(NODES) == [["n1", "n2"], ["n3", "n4", "n5"]]
+    assert nem.bisect([]) == [[], []]
+
+
+def test_split_one():
+    loner, rest = nem.split_one(NODES, loner="n3")
+    assert loner == ["n3"]
+    assert rest == ["n1", "n2", "n4", "n5"]
+
+
+def test_complete_grudge():
+    g = nem.complete_grudge(nem.bisect(NODES))
+    assert g["n1"] == {"n3", "n4", "n5"}
+    assert g["n4"] == {"n1", "n2"}
+    # Nobody snubs their own component.
+    for node, snubbed in g.items():
+        assert node not in snubbed
+
+
+def test_bridge():
+    g = nem.bridge(NODES)
+    # n3 is the bridge: snubs nobody, snubbed by nobody.
+    assert "n3" not in g
+    for node, snubbed in g.items():
+        assert "n3" not in snubbed
+    assert g["n1"] == {"n4", "n5"}
+    assert g["n4"] == {"n1", "n2"}
+
+
+def test_majorities_ring():
+    g = nem.majorities_ring(NODES)
+    # Every node sees a majority (snubs a minority).
+    assert set(g) == set(NODES)
+    for node, snubbed in g.items():
+        assert len(snubbed) == 2  # 5 nodes: majority 3, so snub 2
+        assert node not in snubbed
+    # No two nodes see the same majority.
+    views = [frozenset(set(NODES) - s) for s in g.values()]
+    assert len(set(views)) == len(NODES)
+
+
+# -- partitioner -----------------------------------------------------------
+
+def dummy_test():
+    return {"nodes": list(NODES), "ssh": {"dummy": True},
+            "net": jnet.noop()}
+
+
+def test_partitioner_start_stop():
+    test = dummy_test()
+    p = nem.partition_random_halves().setup(test)
+    res = p.invoke(test, {"type": "info", "f": "start", "value": None})
+    assert res["value"][0] == "isolated"
+    grudge = res["value"][1]
+    assert len(test["net"].grudges) == 1
+    assert test["net"].grudges[0] == grudge
+    res = p.invoke(test, {"type": "info", "f": "stop", "value": None})
+    assert res["value"] == "network-healed"
+    assert test["net"].healed >= 2  # setup heal + stop heal
+
+
+def test_partitioner_explicit_grudge():
+    test = dummy_test()
+    p = nem.partitioner().setup(test)
+    grudge = {"n1": {"n2"}}
+    res = p.invoke(test, {"type": "info", "f": "start", "value": grudge})
+    assert test["net"].grudges[-1] == grudge
+
+
+# -- compose ---------------------------------------------------------------
+
+class Recorder(nem.Nemesis):
+    def __init__(self, fs):
+        self.fs = frozenset(fs)
+        self.ops = []
+
+    def invoke(self, test, op):
+        self.ops.append(op)
+        return {**op, "type": "info"}
+
+
+def test_compose_routes_by_fs():
+    a = Recorder({"kill"})
+    b = Recorder({"start", "stop"})
+    c = nem.compose([a, b])
+    test = dummy_test()
+    c.invoke(test, {"f": "kill"})
+    c.invoke(test, {"f": "start"})
+    assert [o["f"] for o in a.ops] == ["kill"]
+    assert [o["f"] for o in b.ops] == ["start"]
+    with pytest.raises(ValueError):
+        c.invoke(test, {"f": "nonsense"})
+
+
+def test_compose_rewrites_fs():
+    inner = Recorder({"start", "stop"})
+    c = nem.compose({
+        nem_router({"start-partition": "start", "stop-partition": "stop"}):
+            inner})
+    res = c.invoke(dummy_test(), {"f": "start-partition"})
+    assert inner.ops[0]["f"] == "start"
+    assert res["f"] == "start-partition"
+
+
+def nem_router(d):
+    from jepsen_tpu.nemesis.combined import _freeze_router
+    return _freeze_router(d)
+
+
+# -- combined packages -----------------------------------------------------
+
+class KillableDB(jdb.DB, jdb.Process, jdb.Pause):
+    def __init__(self):
+        self.events = []
+
+    def start(self, test, node):
+        self.events.append(("start", node))
+
+    def kill(self, test, node):
+        self.events.append(("kill", node))
+
+    def pause(self, test, node):
+        self.events.append(("pause", node))
+
+    def resume(self, test, node):
+        self.events.append(("resume", node))
+
+
+def test_nemesis_package_composition():
+    db = KillableDB()
+    pkg = combined.nemesis_package(db=db, interval=0.001,
+                                   faults=("partition", "kill"))
+    assert pkg["nemesis"].fs >= {"start-partition", "stop-partition",
+                                 "start-kill", "stop-kill"}
+
+
+def test_package_generator_alternates():
+    pkg = combined.partition_package(interval=0.001)
+    h = simulate(gen.nemesis(gen.limit(6, pkg["generator"])), perfect_info,
+                 concurrency=2, test={"nodes": list(NODES)})
+    # Nemesis ops are :info at invocation and completion: each f twice.
+    fs = [o["f"] for o in h]
+    assert fs == ["start-partition"] * 2 + ["stop-partition"] * 2 \
+        + ["start-partition"] * 2 + ["stop-partition"] * 2 \
+        + ["start-partition"] * 2 + ["stop-partition"] * 2
+
+
+def test_db_nemesis_kills_targets():
+    db = KillableDB()
+    test = dummy_test()
+    n = combined.DBNemesis(db)
+    random.seed(1)
+    res = n.invoke(test, {"f": "start-kill", "value": "majority"})
+    assert res["type"] == "info"
+    assert len([e for e in db.events if e[0] == "kill"]) == 3
+    res = n.invoke(test, {"f": "stop-kill", "value": None})
+    assert len([e for e in db.events if e[0] == "start"]) == 5
+
+
+def test_db_nodes_specs():
+    test = dummy_test()
+    assert len(combined.db_nodes(test, None, "one")) == 1
+    assert len(combined.db_nodes(test, None, "minority")) == 2
+    assert len(combined.db_nodes(test, None, "majority")) == 3
+    assert combined.db_nodes(test, None, "all") == NODES
+    assert combined.db_nodes(test, None, ["n2"]) == ["n2"]
+
+
+# -- native helpers --------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def built_helpers(tmp_path_factory):
+    d = tmp_path_factory.mktemp("native")
+    bins = {}
+    for name in ("bump_time", "strobe_time"):
+        out = d / name
+        r = subprocess.run(
+            ["g++", "-O2", "-o", str(out), f"native/{name}.cc"],
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        bins[name] = str(out)
+    return bins
+
+
+def test_native_helpers_compile(built_helpers):
+    assert len(built_helpers) == 2
+
+
+def test_bump_time_usage_errors(built_helpers):
+    r = subprocess.run([built_helpers["bump_time"]], capture_output=True)
+    assert r.returncode == 2
+    r = subprocess.run([built_helpers["bump_time"], "abc"],
+                       capture_output=True)
+    assert r.returncode == 2
+    # A real bump requires CAP_SYS_TIME; unprivileged it must fail
+    # cleanly, not crash.
+    r = subprocess.run([built_helpers["bump_time"], "1000"],
+                       capture_output=True, text=True)
+    assert r.returncode in (0, 1)
+    if r.returncode == 1:
+        assert "settimeofday" in r.stderr
+
+
+def test_strobe_time_usage_errors(built_helpers):
+    r = subprocess.run([built_helpers["strobe_time"], "10", "0", "1"],
+                       capture_output=True)
+    assert r.returncode == 2
+    r = subprocess.run([built_helpers["strobe_time"], "10", "5"],
+                       capture_output=True)
+    assert r.returncode == 2
