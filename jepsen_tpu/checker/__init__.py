@@ -514,3 +514,69 @@ class CounterChecker(Checker):
 
 def counter() -> Checker:
     return CounterChecker()
+
+
+class Linearizable(Checker):
+    """Linearizability checker over a data-type model — the reference's
+    `checker/linearizable` (jepsen/src/jepsen/checker.clj:188-219),
+    rebuilt on the native knossos engine.
+
+    `model` is a `models.Model` (immutable; step returns a successor).
+    `algorithm` mirrors knossos: "wgl" | "linear" | "competition"; on
+    this build all CPU routes share the WGL engine and the `linear`
+    config-space search is the TPU kernel, selected with backend="tpu"
+    (register/cas histories only; anything unencodable falls back to
+    CPU, as does a frontier overflow — verdicts only ever degrade to
+    the oracle, never diverge from it)."""
+
+    def __init__(self, m: model.Model | None = None,
+                 algorithm: str = "competition", backend: str = "cpu",
+                 frontier: int = 512):
+        self.model = m if m is not None else model.cas_register()
+        self.algorithm = algorithm
+        self.backend = backend
+        self.frontier = frontier
+
+    def _cpu(self, history: list) -> dict:
+        from . import knossos
+        return knossos.analysis(self.model, history,
+                                algorithm=self.algorithm)
+
+    def check(self, test, history, opts):
+        return self.check_batch(test, [history], opts)[0]
+
+    def check_batch(self, test, histories: list[list], opts) -> list[dict]:
+        """Check many histories at once — the TPU batch path used by
+        `independent.checker` to shard per-key subhistories across the
+        device mesh instead of pmapping JVM threads."""
+        if self.backend != "tpu":
+            return [self._cpu(hs) for hs in histories]
+        from . import knossos
+        from .knossos import encode as kenc
+        from .knossos import kernels as kker
+        encs = []
+        cpu_idx = []
+        enc_idx = []
+        for i, hs in enumerate(histories):
+            try:
+                encs.append(kenc.encode_register_history(hs))
+                enc_idx.append(i)
+            except kenc.EncodingError:
+                cpu_idx.append(i)
+        results: list[dict | None] = [None] * len(histories)
+        if encs:
+            for i, r in zip(enc_idx, kker.check_encoded_batch(
+                    encs, frontier=self.frontier)):
+                if r["valid?"] == "unknown":
+                    cpu_idx.append(i)
+                else:
+                    results[i] = r
+        for i in cpu_idx:
+            results[i] = self._cpu(histories[i])
+        return results  # type: ignore[return-value]
+
+
+def linearizable(m: model.Model | None = None,
+                 algorithm: str = "competition",
+                 backend: str = "cpu", **kw) -> Checker:
+    return Linearizable(m, algorithm=algorithm, backend=backend, **kw)

@@ -1,0 +1,145 @@
+"""History -> tensor encoding for the TPU linearizability kernel.
+
+Register-shaped histories (f in {read, write, cas} — the model family the
+reference checks with knossos.model/cas-register; see the etcd suite's
+client ops and jepsen/src/jepsen/checker.clj:188-219) compile to a dense
+event stream:
+
+    events[E, 6] int32 = (kind, slot, f, arg1, arg2, known)
+
+kind: 0 invoke, 1 complete, 2 pad. Each determinate op contributes an
+invoke and a complete event at its real-time positions; indeterminate
+(:info) ops contribute only an invoke — their return is at infinity, so
+they occupy a pending slot forever and are never *required* to
+linearize. `slot` is a dense pending-op slot id (freed on completion);
+the kernel tracks "which pending slots has this configuration already
+applied" as a bitmask over slots, so the maximum concurrent pending
+count must stay under the kernel's slot budget.
+
+Register values are interned to small ints: nil -> 0, observed values
+-> 1..V-1. `known` = 0 marks reads whose value is unknown (indeterminate
+reads), which constrain nothing.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import numpy as np
+
+from ... import history as h
+
+READ, WRITE, CAS = 0, 1, 2
+INVOKE_EV, COMPLETE_EV, PAD_EV = 0, 1, 2
+
+_F_CODES = {"read": READ, "write": WRITE, "cas": CAS}
+
+
+class EncodingError(ValueError):
+    """History doesn't fit the register kernel (unknown :f, too much
+    concurrency, non-internable values). Callers fall back to the CPU
+    engine."""
+
+
+@dataclass
+class EncodedRegisterHistory:
+    events: np.ndarray      # [E, 6] int32
+    n_events: int
+    n_slots: int            # max concurrently-pending ops
+    n_values: int           # interned values incl. nil
+    values: list            # intern table, index -> original value
+
+
+def encode_register_history(raw_history: list[dict],
+                            max_slots: int = 24) -> EncodedRegisterHistory:
+    """Compile one register history into the kernel event stream."""
+    hist = h.remove_failures(h.complete(h.client_ops(raw_history)))
+    intern: dict[Any, int] = {None: 0}
+    values: list = [None]
+
+    def vid(v: Any) -> int:
+        if isinstance(v, list):
+            v = tuple(v)
+        i = intern.get(v)
+        if i is None:
+            i = len(values)
+            intern[v] = i
+            values.append(v)
+        return i
+
+    events: list[tuple[int, int, int, int, int, int]] = []
+    slot_of: dict[Any, int] = {}       # process -> slot
+    free: list[int] = []
+    next_slot = 0
+    peak = 0
+
+    for o in hist:
+        p = o.get("process")
+        if h.is_invoke(o):
+            f = _F_CODES.get(o.get("f"))
+            if f is None:
+                raise EncodingError(f"unencodable op f={o.get('f')!r}")
+            if free:
+                slot = free.pop()
+            else:
+                slot = next_slot
+                next_slot += 1
+                peak = max(peak, next_slot)
+                if next_slot > max_slots:
+                    raise EncodingError(
+                        f"concurrency exceeds {max_slots} pending slots")
+            slot_of[p] = slot
+            v = o.get("value")
+            if f == CAS:
+                if not (isinstance(v, (list, tuple)) and len(v) == 2):
+                    raise EncodingError(f"cas value {v!r} is not [old new]")
+                a1, a2, known = vid(v[0]), vid(v[1]), 1
+            elif f == WRITE:
+                a1, a2, known = vid(v), 0, 1
+            else:  # READ: value known only for determinate reads
+                known = 0 if v is None else 1
+                a1, a2 = (vid(v) if known else 0), 0
+            events.append((INVOKE_EV, slot, f, a1, a2, known))
+        elif p in slot_of:
+            slot = slot_of.pop(p)
+            if h.is_info(o):
+                # Return at infinity: slot stays occupied, no event.
+                continue
+            events.append((COMPLETE_EV, slot, 0, 0, 0, 0))
+            free.append(slot)
+    arr = np.asarray(events, np.int32).reshape(-1, 6)
+    return EncodedRegisterHistory(
+        events=arr, n_events=len(events), n_slots=max(peak, 1),
+        n_values=len(values), values=values)
+
+
+@dataclass(frozen=True)
+class RegisterBatchShape:
+    """Static padding plan for a batch of encoded register histories."""
+
+    n_events: int
+    n_slots: int
+
+    @staticmethod
+    def plan(encs: list[EncodedRegisterHistory],
+             multiple: int = 8) -> "RegisterBatchShape":
+        ev = max((e.n_events for e in encs), default=1)
+        ev = max(multiple, ((ev + multiple - 1) // multiple) * multiple)
+        return RegisterBatchShape(
+            n_events=ev,
+            n_slots=max((e.n_slots for e in encs), default=1))
+
+
+def pack_register_batch(encs: list[EncodedRegisterHistory],
+                        shape: RegisterBatchShape | None = None) -> dict:
+    """Stack encoded histories into one padded [B, E, 6] tensor."""
+    shape = shape or RegisterBatchShape.plan(encs)
+    B = len(encs)
+    events = np.full((B, shape.n_events, 6), 0, np.int32)
+    events[:, :, 0] = PAD_EV
+    for i, e in enumerate(encs):
+        if e.n_events > shape.n_events or e.n_slots > shape.n_slots:
+            raise ValueError(f"history {i} exceeds batch shape {shape}")
+        events[i, : e.n_events] = e.events
+    return {"events": events, "shape": shape}

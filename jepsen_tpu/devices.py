@@ -14,10 +14,28 @@ from __future__ import annotations
 import os
 
 
-def default_devices(min_count: int = 1) -> list:
+def _pin_requested_platform() -> str | None:
+    """Honor an explicit platform request even when a plugin (e.g. the
+    axon TPU tunnel) has force-updated the jax_platforms config from
+    sitecustomize, overriding the JAX_PLATFORMS env var. Without the
+    re-pin, merely creating an array initializes every configured
+    backend — and a dead tunnel hangs the process."""
     import jax
 
     plat = os.environ.get("JEPSEN_TPU_PLATFORM")
+    want = plat or os.environ.get("JAX_PLATFORMS")
+    if want and "axon" not in want and jax.config.jax_platforms != want:
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception:
+            pass
+    return plat
+
+
+def default_devices(min_count: int = 1) -> list:
+    import jax
+
+    plat = _pin_requested_platform()
     if plat:
         return jax.devices(plat)
     devs = jax.devices()

@@ -1,0 +1,283 @@
+"""Independent keys: lift single-key tests over many keys.
+
+Counterpart of jepsen.independent (jepsen/src/jepsen/independent.clj):
+op values become `[k v]` tuples; generators run a fresh sub-generator
+per key — sequentially (one key at a time) or concurrently (thread
+groups each owning a key); the checker splits the history into per-key
+subhistories and checks each.
+
+The reference exists because single-history linearizability cost
+explodes with length (independent.clj:1-7) and regains throughput with
+`bounded-pmap` over keys (independent.clj:472-492). Here the same
+decomposition is the TPU *batching* axis: when the sub-checker exposes
+`check_batch` (e.g. `checker.linearizable(backend="tpu")`), every
+per-key subhistory is encoded into one padded tensor batch and checked
+in a single device dispatch, sharded dp across the mesh — keys map to
+batch rows instead of JVM threads.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable
+
+from . import generator as gen
+from . import history as h
+from .checker import Checker, check_safe, merge_valid
+from .util import bounded_pmap
+
+
+class Tuple(tuple):
+    """A distinguished [key value] pair. A subclass so the checker can
+    tell lifted values from ordinary two-element vectors
+    (independent.clj:22-30)."""
+
+    __slots__ = ()
+
+    def __new__(cls, k, v):
+        return super().__new__(cls, (k, v))
+
+    @property
+    def key(self):
+        return self[0]
+
+    @property
+    def value(self):
+        return self[1]
+
+    def __repr__(self):
+        return f"[{self[0]!r} {self[1]!r}]"
+
+
+def tuple_(k, v) -> Tuple:
+    return Tuple(k, v)
+
+
+def is_tuple(v: Any) -> bool:
+    return isinstance(v, Tuple)
+
+
+def key_of(v: Any):
+    return v.key if is_tuple(v) else None
+
+
+def value_of(v: Any):
+    return v.value if is_tuple(v) else v
+
+
+def _wrap(k, res):
+    """Wrap a sub-generator op result's value into a [k v] tuple."""
+    o, g2 = res
+    if isinstance(o, dict):
+        o = {**o, "value": Tuple(k, o.get("value"))}
+    return o, g2
+
+
+def _unwrap_event(event: dict) -> dict:
+    v = event.get("value")
+    if is_tuple(v):
+        return {**event, "value": v.value}
+    return event
+
+
+class SequentialGenerator(gen.Generator):
+    """One key at a time: run gen_fn(k) to exhaustion, then the next key
+    (independent.clj:32-66)."""
+
+    def __init__(self, keys: Iterable, gen_fn: Callable,
+                 _state=None):
+        if _state is None:
+            keys = list(keys)
+            _state = (keys, 0, gen_fn(keys[0]) if keys else None)
+        self.gen_fn = gen_fn
+        self.keys, self.i, self.cur = _state
+
+    def _with(self, i, cur):
+        return SequentialGenerator(
+            self.keys, self.gen_fn, _state=(self.keys, i, cur))
+
+    def op(self, test, ctx):
+        i, cur = self.i, self.cur
+        while i < len(self.keys):
+            if cur is None:
+                cur = self.gen_fn(self.keys[i])
+            res = gen.op(cur, test, ctx)
+            if res is not None:
+                o, g2 = _wrap(self.keys[i], res)
+                return o, self._with(i, g2)
+            i, cur = i + 1, None
+        return None
+
+    def update(self, test, ctx, event):
+        if self.cur is None or self.i >= len(self.keys):
+            return self
+        v = event.get("value")
+        if is_tuple(v) and v.key == self.keys[self.i]:
+            return self._with(
+                self.i,
+                gen.update(self.cur, test, ctx, _unwrap_event(event)))
+        return self
+
+
+def sequential_generator(keys: Iterable, gen_fn: Callable):
+    return SequentialGenerator(keys, gen_fn)
+
+
+class ConcurrentGenerator(gen.Generator):
+    """Thread groups of size n, each owning one key at a time
+    (independent.clj:138-268, the pure PureConcurrentGenerator).
+
+    Client threads are partitioned by `thread // n`; each group runs
+    gen_fn(k) restricted to its own threads and claims the next
+    unclaimed key when its current generator is exhausted. Requires
+    integer client threads; the nemesis is untouched (wrap with
+    gen.clients as usual)."""
+
+    def __init__(self, n: int, keys: Iterable, gen_fn: Callable,
+                 _state=None):
+        self.n = n
+        self.gen_fn = gen_fn
+        if _state is None:
+            _state = (list(keys), 0, {}, {})
+        # groups: group-id -> (key, sub-generator); done groups absent
+        # but recorded in exhausted so they don't re-claim.
+        self.keys, self.next_key, self.groups, self.key_group = _state
+
+    def _with(self, next_key, groups, key_group):
+        return ConcurrentGenerator(
+            self.n, self.keys, self.gen_fn,
+            _state=(self.keys, next_key, groups, key_group))
+
+    def _group_threads(self, ctx, g):
+        lo, hi = g * self.n, (g + 1) * self.n
+        return lambda t: isinstance(t, int) and lo <= t < hi
+
+    def _probe(self, g, test, ctx):
+        """Try to produce an op from group g against a private copy of
+        the state; only the winning probe's state is kept, so key
+        claims by losing probes simply re-happen next call (gen.op is
+        pure). Returns (op, successor-ConcurrentGenerator) or None."""
+        groups = dict(self.groups)
+        key_group = dict(self.key_group)
+        nk = self.next_key
+        gctx = ctx.restrict(self._group_threads(ctx, g))
+        entry = groups.get(g)
+        while True:
+            if entry is None:
+                if nk >= len(self.keys):
+                    return None
+                k = self.keys[nk]
+                nk += 1
+                entry = (k, self.gen_fn(k))
+                key_group[k] = g
+            k, sub = entry
+            res = gen.op(sub, test, gctx)
+            if res is None:
+                entry = None
+                continue
+            o, g2 = _wrap(k, res)
+            groups[g] = (k, g2)
+            return o, self._with(nk, groups, key_group)
+
+    def op(self, test, ctx):
+        soonest = None
+        gids = sorted({t // self.n for t in ctx.free_threads
+                      if isinstance(t, int)})
+        for g in gids:
+            cand = self._probe(g, test, ctx)
+            if cand is not None:
+                soonest = gen.soonest_op_vec(soonest, (*cand, g))
+        if soonest is None:
+            return None
+        o, succ, _ = soonest
+        return o, succ
+
+    def update(self, test, ctx, event):
+        v = event.get("value")
+        if not is_tuple(v):
+            return self
+        g = self.key_group.get(v.key)
+        if g is None or g not in self.groups:
+            return self
+        k, sub = self.groups[g]
+        if k != v.key:
+            return self
+        gctx = ctx.restrict(self._group_threads(ctx, g))
+        groups = dict(self.groups)
+        groups[g] = (k, gen.update(sub, test, gctx,
+                                   _unwrap_event(event)))
+        return self._with(self.next_key, groups, self.key_group)
+
+
+def concurrent_generator(n: int, keys: Iterable, gen_fn: Callable):
+    return ConcurrentGenerator(n, keys, gen_fn)
+
+
+# ---------------------------------------------------------------------------
+# Checker
+# ---------------------------------------------------------------------------
+
+def history_keys(history: list) -> list:
+    """All keys appearing in lifted op values, in first-seen order
+    (independent.clj:426-437)."""
+    seen = []
+    ss = set()
+    for o in history:
+        v = o.get("value")
+        if is_tuple(v) and v.key not in ss:
+            ss.add(v.key)
+            seen.append(v.key)
+    return seen
+
+
+def subhistory(k, history: list) -> list:
+    """The history restricted to key k: lifted ops for k unwrapped;
+    un-lifted ops (nemesis &c) retained (independent.clj:438-449)."""
+    out = []
+    for o in history:
+        v = o.get("value")
+        if is_tuple(v):
+            if v.key == k:
+                out.append({**o, "value": v.value})
+        else:
+            out.append(o)
+    return out
+
+
+class IndependentChecker(Checker):
+    """Check each key's subhistory with the sub-checker
+    (independent.clj:451-502).
+
+    If the sub-checker exposes `check_batch(test, histories, opts)`,
+    all subhistories go down in one batched device dispatch (the TPU
+    path); otherwise they fan out over a bounded thread pool like the
+    reference's bounded-pmap."""
+
+    def __init__(self, sub: Checker):
+        self.sub = sub
+
+    def check(self, test, history, opts):
+        opts = opts or {}
+        ks = history_keys(history)
+        subs = [subhistory(k, history) for k in ks]
+        if hasattr(self.sub, "check_batch"):
+            try:
+                results = self.sub.check_batch(test, subs, opts)
+            except Exception:
+                results = [check_safe(self.sub, test, s, opts)
+                           for s in subs]
+        else:
+            results = bounded_pmap(
+                lambda s: check_safe(self.sub, test, s, opts), subs)
+        result_map = dict(zip(ks, results))
+        failures = [k for k, r in result_map.items()
+                    if r.get("valid?") is False]
+        return {
+            "valid?": merge_valid(
+                [r.get("valid?", True) for r in results] or [True]),
+            "results": result_map,
+            "failures": failures,
+        }
+
+
+def checker(sub: Checker) -> Checker:
+    return IndependentChecker(sub)

@@ -1,0 +1,58 @@
+"""Linearizable register workload.
+
+Counterpart of jepsen.tests.linearizable-register
+(jepsen/src/jepsen/tests/linearizable_register.clj:23-60): independent
+CAS registers per key, a read/write/cas op mix, and a per-key
+linearizability check against the CAS-register model.
+
+TPU-first twist: with checker backend="tpu" the per-key subhistories
+batch into one padded event-tensor dispatch through
+checker.knossos.kernels instead of a thread-pool of searches.
+"""
+
+from __future__ import annotations
+
+import random
+
+from .. import generator as gen
+from .. import independent
+from ..checker import linearizable, models
+
+
+def r(test=None, ctx=None):
+    return {"type": "invoke", "f": "read", "value": None}
+
+
+def w(test=None, ctx=None):
+    return {"type": "invoke", "f": "write", "value": random.randint(0, 4)}
+
+
+def cas(test=None, ctx=None):
+    return {"type": "invoke", "f": "cas",
+            "value": [random.randint(0, 4), random.randint(0, 4)]}
+
+
+def rand_op(test=None, ctx=None):
+    return random.choice((r, w, cas))(test, ctx)
+
+
+def generator(threads_per_key: int = 2, key_count: int = 10,
+              ops_per_key: int = 100):
+    """Concurrent per-key generators over a rotating key space
+    (linearizable_register.clj:34-50)."""
+    return independent.concurrent_generator(
+        threads_per_key, range(key_count),
+        lambda k: gen.limit(ops_per_key, rand_op))
+
+
+def checker(backend: str = "cpu", algorithm: str = "competition"):
+    return independent.checker(
+        linearizable(models.cas_register(), algorithm=algorithm,
+                     backend=backend))
+
+
+def test(threads_per_key: int = 2, key_count: int = 10,
+         ops_per_key: int = 100, backend: str = "cpu") -> dict:
+    return {"generator": gen.clients(
+                generator(threads_per_key, key_count, ops_per_key)),
+            "checker": checker(backend=backend)}

@@ -7,15 +7,25 @@ path; real-chip benchmarks happen in bench.py).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 # Some environments register a TPU plugin regardless of JAX_PLATFORMS;
 # this pin makes jepsen_tpu.devices resolve the virtual CPU mesh.
-os.environ.setdefault("JEPSEN_TPU_PLATFORM", "cpu")
+os.environ["JEPSEN_TPU_PLATFORM"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The axon TPU-tunnel plugin (when present) force-updates the
+# jax_platforms *config* to "axon,cpu" from sitecustomize, overriding
+# the env var — and initializing the axon backend can hang when the
+# tunnel is unreachable. Re-pin the config so tests stay on the
+# 8-device virtual CPU mesh.
+import jax  # noqa: E402
+
+if jax.config.jax_platforms != "cpu":
+    jax.config.update("jax_platforms", "cpu")
 
 import random
 

@@ -1,0 +1,360 @@
+"""Linearizability engine tests: CPU WGL oracle golden cases, TPU kernel
+parity (the acceptance criterion, SURVEY.md §4.3), and the independent
+key-decomposition layer that feeds the batch path."""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from jepsen_tpu import independent
+from jepsen_tpu.checker import linearizable, models
+from jepsen_tpu.checker import knossos
+from jepsen_tpu.checker.knossos import encode as kenc
+from jepsen_tpu.checker.knossos import kernels as kker
+
+
+def op(type, process, f, value=None, **kw):
+    return {"type": type, "process": process, "f": f, "value": value, **kw}
+
+
+def pairs_history(*steps):
+    """Build a history from (process, f, value, result-type[, result-value])
+    sequential steps — each op completes before the next begins."""
+    hist = []
+    for s in steps:
+        p, f, v, t = s[0], s[1], s[2], s[3]
+        rv = s[4] if len(s) > 4 else v
+        hist.append(op("invoke", p, f, v))
+        hist.append(op(t, p, f, rv))
+    return hist
+
+
+CASR = models.cas_register()
+
+
+# ---------------------------------------------------------------------------
+# CPU WGL golden verdicts
+# ---------------------------------------------------------------------------
+
+class TestWGL:
+    def test_empty_history_valid(self):
+        assert knossos.wgl(CASR, [])["valid?"] is True
+
+    def test_sequential_write_read_valid(self):
+        h = pairs_history((0, "write", 1, "ok"), (0, "read", 1, "ok"))
+        assert knossos.wgl(CASR, h)["valid?"] is True
+
+    def test_read_wrong_value_invalid(self):
+        h = pairs_history((0, "write", 1, "ok"), (0, "read", 2, "ok"))
+        r = knossos.wgl(CASR, h)
+        assert r["valid?"] is False
+        assert "op" in r  # the op whose return the search died at
+
+    def test_initial_nil_read_valid(self):
+        h = pairs_history((0, "read", None, "ok"))
+        assert knossos.wgl(CASR, h)["valid?"] is True
+
+    def test_concurrent_writes_reorder_valid(self):
+        # w1 and w2 overlap; a later read of 1 forces order w2, w1.
+        h = [op("invoke", 0, "write", 1), op("invoke", 1, "write", 2),
+             op("ok", 0, "write", 1), op("ok", 1, "write", 2),
+             op("invoke", 2, "read"), op("ok", 2, "read", 1)]
+        assert knossos.wgl(CASR, h)["valid?"] is True
+
+    def test_sequential_writes_fix_order_invalid(self):
+        # w1 completes before w2 begins; read of 1 afterwards is stale.
+        h = pairs_history((0, "write", 1, "ok"), (1, "write", 2, "ok"),
+                          (2, "read", 1, "ok"))
+        assert knossos.wgl(CASR, h)["valid?"] is False
+
+    def test_cas_chain_valid(self):
+        h = pairs_history((0, "write", 1, "ok"), (0, "cas", [1, 2], "ok"),
+                          (1, "read", 2, "ok"))
+        assert knossos.wgl(CASR, h)["valid?"] is True
+
+    def test_cas_from_wrong_value_invalid(self):
+        h = pairs_history((0, "write", 1, "ok"), (0, "cas", [3, 4], "ok"))
+        assert knossos.wgl(CASR, h)["valid?"] is False
+
+    def test_info_write_may_happen(self):
+        # Indeterminate write of 3; later read sees 3: the write happened.
+        h = [op("invoke", 0, "write", 3), op("info", 0, "write", 3),
+             op("invoke", 1, "read"), op("ok", 1, "read", 3)]
+        assert knossos.wgl(CASR, h)["valid?"] is True
+
+    def test_info_write_may_not_happen(self):
+        h = [op("invoke", 0, "write", 3), op("info", 0, "write", 3),
+             op("invoke", 1, "read"), op("ok", 1, "read", None)]
+        assert knossos.wgl(CASR, h)["valid?"] is True
+
+    def test_failed_write_dropped(self):
+        h = [op("invoke", 0, "write", 9), op("fail", 0, "write", 9),
+             op("invoke", 1, "read"), op("ok", 1, "read", None)]
+        assert knossos.wgl(CASR, h)["valid?"] is True
+
+    def test_failed_write_observed_invalid(self):
+        h = [op("invoke", 0, "write", 9), op("fail", 0, "write", 9),
+             op("invoke", 1, "read"), op("ok", 1, "read", 9)]
+        assert knossos.wgl(CASR, h)["valid?"] is False
+
+    def test_mutex_model(self):
+        h = pairs_history((0, "acquire", None, "ok"),
+                          (1, "acquire", None, "ok"))
+        assert knossos.wgl(models.mutex(), h)["valid?"] is False
+        h2 = pairs_history((0, "acquire", None, "ok"),
+                           (0, "release", None, "ok"),
+                           (1, "acquire", None, "ok"))
+        assert knossos.wgl(models.mutex(), h2)["valid?"] is True
+
+    def test_unknown_on_cache_exhaustion(self):
+        h = [op("invoke", p, "write", p) for p in range(6)] + \
+            [op("ok", p, "write", p) for p in range(6)]
+        r = knossos.wgl(CASR, h, max_configs=2)
+        assert r["valid?"] == "unknown"
+
+
+# ---------------------------------------------------------------------------
+# Random linearizable histories (simulated atomic register) + corruption
+# ---------------------------------------------------------------------------
+
+def random_register_history(rng: random.Random, n_ops=25, n_procs=4,
+                            n_values=4, info_prob=0.08):
+    """Simulate a real atomic register: each op takes effect at one
+    instant between invoke and complete, so the history is linearizable
+    by construction."""
+    hist = []
+    value = None
+    free = list(range(n_procs))
+    pending = []  # [process, op, applied?, result]
+    ops_left = n_ops
+    while ops_left > 0 or pending:
+        choices = []
+        if free and ops_left > 0:
+            choices.append("invoke")
+        if any(not p[2] for p in pending):
+            choices.append("apply")
+        if any(p[2] for p in pending):
+            choices.append("complete")
+        action = rng.choice(choices)
+        if action == "invoke":
+            p = free.pop(rng.randrange(len(free)))
+            f = rng.choice(["read", "write", "cas"])
+            if f == "read":
+                o = op("invoke", p, "read")
+            elif f == "write":
+                o = op("invoke", p, "write", rng.randrange(n_values))
+            else:
+                o = op("invoke", p, "cas",
+                       [rng.randrange(n_values), rng.randrange(n_values)])
+            hist.append(o)
+            pending.append([p, o, False, None])
+            ops_left -= 1
+        elif action == "apply":
+            cand = [p for p in pending if not p[2]]
+            ent = rng.choice(cand)
+            f, v = ent[1]["f"], ent[1]["value"]
+            if f == "read":
+                ent[3] = ("ok", value)
+            elif f == "write":
+                value = v
+                ent[3] = ("ok", v)
+            else:
+                old, new = v
+                if old == value:
+                    value = new
+                    ent[3] = ("ok", v)
+                else:
+                    ent[3] = ("fail", v)
+            ent[2] = True
+        else:
+            cand = [p for p in pending if p[2]]
+            ent = rng.choice(cand)
+            pending.remove(ent)
+            p, o = ent[0], ent[1]
+            if rng.random() < info_prob:
+                hist.append(op("info", p, o["f"], o["value"]))
+            else:
+                t, rv = ent[3]
+                hist.append(op(t, p, o["f"], rv))
+            free.append(p)
+    return hist
+
+
+def corrupt(rng: random.Random, hist):
+    """Flip one ok read's value — usually breaking linearizability."""
+    hist = [dict(o) for o in hist]
+    reads = [o for o in hist
+             if o["type"] == "ok" and o["f"] == "read"]
+    if reads:
+        o = rng.choice(reads)
+        o["value"] = (o["value"] or 0) + 7
+    return hist
+
+
+class TestRandomHistories:
+    def test_simulated_histories_are_linearizable(self):
+        rng = random.Random(7)
+        for _ in range(20):
+            h = random_register_history(rng)
+            assert knossos.wgl(CASR, h)["valid?"] is True
+
+    def test_corrupted_histories_checked(self):
+        rng = random.Random(8)
+        seen_invalid = 0
+        for _ in range(20):
+            h = corrupt(rng, random_register_history(rng, info_prob=0.0))
+            if knossos.wgl(CASR, h)["valid?"] is False:
+                seen_invalid += 1
+        assert seen_invalid > 5  # corruption usually detected
+
+
+# ---------------------------------------------------------------------------
+# TPU kernel parity (differential: kernel verdict == WGL verdict)
+# ---------------------------------------------------------------------------
+
+def kernel_verdict(h, frontier=256):
+    enc = kenc.encode_register_history(h)
+    return kker.check_encoded_batch([enc], frontier=frontier)[0]
+
+
+class TestKernelParity:
+    GOLDENS = [
+        (pairs_history((0, "write", 1, "ok"), (0, "read", 1, "ok")), True),
+        (pairs_history((0, "write", 1, "ok"), (0, "read", 2, "ok")), False),
+        (pairs_history((0, "read", None, "ok")), True),
+        ([op("invoke", 0, "write", 1), op("invoke", 1, "write", 2),
+          op("ok", 0, "write", 1), op("ok", 1, "write", 2),
+          op("invoke", 2, "read"), op("ok", 2, "read", 1)], True),
+        (pairs_history((0, "write", 1, "ok"), (1, "write", 2, "ok"),
+                       (2, "read", 1, "ok")), False),
+        (pairs_history((0, "write", 1, "ok"), (0, "cas", [1, 2], "ok"),
+                       (1, "read", 2, "ok")), True),
+        (pairs_history((0, "write", 1, "ok"), (0, "cas", [3, 4], "ok")),
+         False),
+        ([op("invoke", 0, "write", 3), op("info", 0, "write", 3),
+          op("invoke", 1, "read"), op("ok", 1, "read", 3)], True),
+        ([op("invoke", 0, "write", 3), op("info", 0, "write", 3),
+          op("invoke", 1, "read"), op("ok", 1, "read", None)], True),
+        ([op("invoke", 0, "write", 9), op("fail", 0, "write", 9),
+          op("invoke", 1, "read"), op("ok", 1, "read", 9)], False),
+    ]
+
+    def test_golden_verdicts_on_device(self):
+        encs = [kenc.encode_register_history(h) for h, _ in self.GOLDENS]
+        results = kker.check_encoded_batch(encs)
+        for (h, expect), r in zip(self.GOLDENS, results):
+            assert r["valid?"] is expect, (h, r)
+
+    def test_differential_random(self):
+        rng = random.Random(99)
+        hists = [random_register_history(rng, n_ops=15, n_procs=3)
+                 for _ in range(8)]
+        hists += [corrupt(rng, random_register_history(
+            rng, n_ops=15, n_procs=3, info_prob=0.0)) for _ in range(8)]
+        cpu = [knossos.wgl(CASR, h)["valid?"] for h in hists]
+        tpu = [kernel_verdict(h)["valid?"] for h in hists]
+        assert cpu == tpu
+
+    def test_overflow_degrades_to_unknown(self):
+        h = [op("invoke", p, "write", p) for p in range(8)] + \
+            [op("ok", p, "write", p) for p in range(8)]
+        r = kernel_verdict(h, frontier=4)
+        assert r["valid?"] == "unknown"
+
+    def test_unencodable_raises(self):
+        with pytest.raises(kenc.EncodingError):
+            kenc.encode_register_history(
+                pairs_history((0, "enqueue", 1, "ok")))
+
+
+# ---------------------------------------------------------------------------
+# Linearizable checker + independent decomposition
+# ---------------------------------------------------------------------------
+
+class TestLinearizableChecker:
+    def test_cpu_backend(self):
+        h = pairs_history((0, "write", 1, "ok"), (0, "read", 1, "ok"))
+        c = linearizable(CASR, backend="cpu")
+        assert c.check({}, h, {})["valid?"] is True
+
+    def test_tpu_backend_with_fallback(self):
+        good = pairs_history((0, "write", 1, "ok"), (0, "read", 1, "ok"))
+        bad = pairs_history((0, "write", 1, "ok"), (0, "read", 2, "ok"))
+        weird = pairs_history((0, "enqueue", 1, "ok"))  # CPU fallback
+        c = linearizable(CASR, backend="tpu")
+        rs = c.check_batch({}, [good, bad, weird], {})
+        assert rs[0]["valid?"] is True
+        assert rs[1]["valid?"] is False
+        assert rs[2]["valid?"] is False  # queue op vs cas-register model
+
+    def test_independent_checker_batches(self):
+        T = independent.tuple_
+        h = []
+        for k, val, expect_read in [("a", 1, 1), ("b", 2, 3)]:
+            h.append(op("invoke", 0, "write", T(k, val)))
+            h.append(op("ok", 0, "write", T(k, val)))
+            h.append(op("invoke", 1, "read", T(k, None)))
+            h.append(op("ok", 1, "read", T(k, expect_read)))
+        c = independent.checker(linearizable(CASR, backend="tpu"))
+        r = c.check({}, h, {})
+        assert r["valid?"] is False
+        assert r["results"]["a"]["valid?"] is True
+        assert r["results"]["b"]["valid?"] is False
+        assert r["failures"] == ["b"]
+
+
+class TestIndependentGenerators:
+    def test_tuple_helpers(self):
+        t = independent.tuple_("k", 5)
+        assert independent.is_tuple(t)
+        assert independent.key_of(t) == "k"
+        assert independent.value_of(t) == 5
+        assert not independent.is_tuple(["k", 5])
+
+    def test_sequential_generator(self):
+        import jepsen_tpu.generator as g
+        from gen_sim import perfect, simulate
+        sg = independent.sequential_generator(
+            ["x", "y"],
+            lambda k: g.limit(3, lambda test, ctx:
+                              {"type": "invoke", "f": "read", "value": None}))
+        hist = simulate(g.clients(sg), perfect, concurrency=2)
+        invokes = [o for o in hist if o["type"] == "invoke"]
+        assert len(invokes) == 6
+        keys = [o["value"].key for o in invokes]
+        assert keys == ["x"] * 3 + ["y"] * 3
+
+    def test_concurrent_generator(self):
+        import jepsen_tpu.generator as g
+        from gen_sim import perfect, simulate
+        cg = independent.concurrent_generator(
+            2, ["x", "y"],
+            lambda k: g.limit(4, lambda test, ctx:
+                              {"type": "invoke", "f": "read", "value": None}))
+        hist = simulate(g.clients(cg), perfect, concurrency=4)
+        invokes = [o for o in hist if o["type"] == "invoke"]
+        assert len(invokes) == 8
+        by_key: dict = {}
+        for o in invokes:
+            by_key.setdefault(o["value"].key, set()).add(o["process"] // 2)
+        # each key served by exactly one thread-group
+        assert all(len(gs) == 1 for gs in by_key.values())
+
+    def test_register_workload_end_to_end(self):
+        import jepsen_tpu.generator as g
+        from gen_sim import perfect, simulate
+        from jepsen_tpu.workloads import register as reg
+        t = reg.test(threads_per_key=2, key_count=3, ops_per_key=6,
+                     backend="tpu")
+        hist = simulate(t["generator"], perfect, concurrency=6)
+        # The perfect executor oks every op — including random cas ops,
+        # which usually can't all have succeeded, so the verdict is
+        # typically False. What must hold: TPU and CPU backends agree
+        # per key, and every key got checked.
+        r_tpu = t["checker"].check({}, hist, {})
+        r_cpu = reg.checker(backend="cpu").check({}, hist, {})
+        assert len(r_tpu["results"]) == 3
+        assert {k: v["valid?"] for k, v in r_tpu["results"].items()} == \
+               {k: v["valid?"] for k, v in r_cpu["results"].items()}
