@@ -118,13 +118,31 @@ class SSHRemote(Remote):
     def connect(self, spec: dict) -> dict:
         return spec
 
+    # ssh exits 255 for its OWN failures — but so may the remote
+    # command. Disambiguate by echoing the command's exit status to
+    # stderr from the remote shell: marker present = the command ran.
+    _EC_MARK = "__JEPSEN_TPU_EC:"
+
     def execute(self, spec: dict, cmd: str, stdin: str = "") -> Result:
-        argv = ["ssh", *self._base_args(spec), self._dest(spec), cmd]
+        wrapped = (f"( {cmd}\n); __jec=$?; "
+                   f"echo '{self._EC_MARK}'$__jec >&2; exit $__jec")
+        argv = ["ssh", *self._base_args(spec), self._dest(spec), wrapped]
         p = subprocess.run(argv, input=stdin, capture_output=True,
                            text=True, timeout=spec.get("timeout", 300))
-        if p.returncode == 255:  # ssh's own failure, not the command's
-            raise ConnectionError_(p.stderr.strip())
-        return Result(p.stdout, p.stderr, p.returncode)
+        remote_ec = None
+        err_lines = []
+        for ln in p.stderr.splitlines():
+            if ln.startswith(self._EC_MARK):
+                try:
+                    remote_ec = int(ln[len(self._EC_MARK):])
+                except ValueError:
+                    pass
+            else:
+                err_lines.append(ln)
+        err = "\n".join(err_lines)
+        if p.returncode == 255 and remote_ec != 255:
+            raise ConnectionError_(err.strip())
+        return Result(p.stdout, err, p.returncode)
 
     def _scp_args(self, spec: dict) -> list[str]:
         args = [a if a != "-p" else "-P" for a in self._base_args(spec)]
@@ -266,12 +284,15 @@ class Session:
 
     def _with_reconnect(self, f: Callable[[], Any]) -> Any:
         """Retry transport failures with reconnects (reconnect.clj:92-129,
-        control.clj:168-189)."""
+        control.clj:168-189). Command *timeouts* are NOT retried — the
+        remote side effects may have happened, and re-executing a
+        non-idempotent command (a clock bump, a daemon start) would
+        silently corrupt the test; TimeoutExpired propagates."""
         last: Exception | None = None
         for attempt in range(self.retries + 1):
             try:
                 return f()
-            except (ConnectionError_, subprocess.TimeoutExpired) as e:
+            except ConnectionError_ as e:
                 last = e
                 time.sleep(self.retry_backoff * (attempt + 1))
                 try:

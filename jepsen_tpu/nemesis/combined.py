@@ -54,8 +54,10 @@ class DBNemesis(Nemesis):
 
     fs = frozenset({"start-kill", "stop-kill", "start-pause", "stop-pause"})
 
-    def __init__(self, db):
+    def __init__(self, db, fs: frozenset | None = None):
         self.db = db
+        if fs is not None:
+            self.fs = fs  # restrict routing (e.g. kill-only package)
 
     def invoke(self, test, op):
         f = op.get("f")
@@ -136,7 +138,7 @@ def kill_package(db, interval: float = DEFAULT_INTERVAL,
         return random.choice(list(targets))
 
     return {
-        "nemesis": DBNemesis(db),
+        "nemesis": DBNemesis(db, fs=frozenset({"start-kill", "stop-kill"})),
         "generator": _cycle_gen("start-kill", value, "stop-kill", interval),
         "final_generator": gen.once({"type": "info", "f": "stop-kill",
                                      "value": None}),
@@ -151,7 +153,7 @@ def pause_package(db, interval: float = DEFAULT_INTERVAL,
         return random.choice(list(targets))
 
     return {
-        "nemesis": DBNemesis(db),
+        "nemesis": DBNemesis(db, fs=frozenset({"start-pause", "stop-pause"})),
         "generator": _cycle_gen("start-pause", value, "stop-pause", interval),
         "final_generator": gen.once({"type": "info", "f": "stop-pause",
                                      "value": None}),
@@ -176,9 +178,18 @@ def compose_packages(packages: list[dict]) -> dict:
     """Merge packages: nemeses composed by f-routing, generators merged
     with `any`, final generators run in sequence (combined.clj:294-316)."""
     routes = {}
+    claimed: dict = {}
     for p in packages:
         nem = p["nemesis"]
-        routes[frozenset(nem.fs)] = nem
+        fs = frozenset(nem.fs)
+        for f in fs:
+            if f in claimed:
+                raise ValueError(
+                    f"nemesis op {f!r} routed to two packages "
+                    f"({claimed[f]!r} and {nem!r}); packages must have "
+                    f"disjoint :f sets")
+            claimed[f] = nem
+        routes[fs] = nem
     return {
         "nemesis": compose(routes),
         "generator": gen.any_gen(*[p["generator"] for p in packages]),

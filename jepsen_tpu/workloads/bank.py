@@ -78,10 +78,11 @@ def generator(accounts=None, max_transfer=DEFAULT_MAX_TRANSFER):
     return gen.clients(gen.mix([read, transfer]))
 
 
-def test(accounts=None, total=DEFAULT_TOTAL, **kw) -> dict:
+def test(accounts=None, total=DEFAULT_TOTAL,
+         max_transfer=DEFAULT_MAX_TRANSFER, **kw) -> dict:
     accounts = accounts or DEFAULT_ACCOUNTS
-    return {"generator": generator(accounts),
+    return {"generator": generator(accounts, max_transfer),
             "checker": checker(total=total, **kw),
             "accounts": accounts,
             "total-amount": total,
-            "max-transfer": DEFAULT_MAX_TRANSFER}
+            "max-transfer": max_transfer}
