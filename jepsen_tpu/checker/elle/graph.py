@@ -59,11 +59,25 @@ def build_edges(enc: EncodedHistory, process_order: bool = False,
     # Indeterminate txns never completed: nothing is realtime-after them,
     # and they sort last (in row order) in their process's order.
     complete = effective_complete_index(enc.status, enc.complete_index)
+    edges += order_edges(enc.n, enc.process, enc.invoke_index, complete,
+                         process_order=process_order, realtime=realtime)
+    return edges
+
+
+def order_edges(n: int, process: np.ndarray, invoke_index: np.ndarray,
+                effective_complete: np.ndarray, process_order: bool = False,
+                realtime: bool = False) -> list[tuple[int, int, int]]:
+    """Process-order / realtime edges from txn-row timing arrays — the
+    single host-side implementation shared by every CPU oracle
+    (list-append, rw-register). `effective_complete` must come from
+    encode.effective_complete_index so indeterminate txns sort last with
+    distinct keys, matching the device kernel's formulation."""
+    edges: list[tuple[int, int, int]] = []
     if process_order:
         last_by_proc: dict = {}
-        for row in np.argsort(complete, kind="stable"):
+        for row in np.argsort(effective_complete, kind="stable"):
             row = int(row)
-            p = int(enc.process[row])
+            p = int(process[row])
             if p < 0:
                 continue
             if p in last_by_proc:
@@ -73,9 +87,9 @@ def build_edges(enc: EncodedHistory, process_order: bool = False,
         # t1 completed before t2 invoked. Already transitively closed, so
         # emit the full relation (CPU oracle scale only; the device builds
         # this densely via a broadcast compare).
-        for i in range(enc.n):
-            for j in range(enc.n):
-                if j != i and complete[j] < enc.invoke_index[i]:
+        for i in range(n):
+            for j in range(n):
+                if j != i and effective_complete[j] < invoke_index[i]:
                     edges.append((j, i, RT))
     return edges
 

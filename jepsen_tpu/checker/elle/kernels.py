@@ -176,6 +176,20 @@ def check_batched_impl(appends, reads, invoke_index, complete_index, process,
     edges = jax.vmap(functools.partial(
         _edges_one, n_keys=n_keys, max_pos=max_pos, n_txns=n_txns))
     ww, wr, rw = edges(appends, reads)
+    return classify_matrices_impl(
+        ww, wr, rw, invoke_index, complete_index, process, n_live,
+        steps=steps, classify=classify, realtime=realtime,
+        process_order=process_order, constrain=constrain)
+
+
+def classify_matrices_impl(ww, wr, rw, invoke_index, complete_index, process,
+                           n_live, *, steps: int, classify: bool,
+                           realtime: bool, process_order: bool,
+                           constrain) -> jnp.ndarray:
+    """Closure + anomaly classification over explicit [B,T,T] boolean edge
+    matrices. Entry point for checkers (rw-register) whose edge
+    construction happens host-side from inferred version graphs rather
+    than from per-key position chains."""
     T = ww.shape[-1]
     nI = ~jnp.eye(T, dtype=bool)
     live = jnp.arange(T)[None, :] < n_live[:, None]          # [B,T]
@@ -238,6 +252,70 @@ def check_batch_device(appends, reads, invoke_index, complete_index, process,
         n_keys=n_keys, max_pos=max_pos, n_txns=n_txns, steps=steps,
         classify=classify, realtime=realtime, process_order=process_order,
         constrain=_identity)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "steps", "classify", "realtime", "process_order"))
+def classify_matrices_device(ww, wr, rw, invoke_index, complete_index,
+                             process, n_live, *, steps: int,
+                             classify: bool = True, realtime: bool = False,
+                             process_order: bool = False) -> jnp.ndarray:
+    """Jitted single-device entry over packed [B,T,T] edge matrices."""
+    return classify_matrices_impl(
+        ww, wr, rw, invoke_index, complete_index, process, n_live,
+        steps=steps, classify=classify, realtime=realtime,
+        process_order=process_order, constrain=_identity)
+
+
+def pack_edge_matrices(per_history: list[dict], multiple: int = 128) -> dict:
+    """Pack host-built sparse edges into stacked dense bool matrices.
+
+    per_history: dicts with keys n (txn count), edges (list of
+    (src, dst, cls) with cls in {graph.WW, WR, RW}), invoke_index,
+    complete_index, process (np arrays of length n)."""
+    from . import graph as g
+    B = len(per_history)
+    T = pad_to(max((h["n"] for h in per_history), default=1), multiple)
+    ww = np.zeros((B, T, T), bool)
+    wr = np.zeros((B, T, T), bool)
+    rw = np.zeros((B, T, T), bool)
+    invoke_idx = np.zeros((B, T), np.int64)
+    complete_idx = np.zeros((B, T), np.int64)
+    process = np.full((B, T), -1, np.int32)
+    n_live = np.zeros((B,), np.int32)
+    # Only the three dependency classes are accepted: realtime/process
+    # edges are built in-kernel from the timing tensors (passing them
+    # here would double-count them against the kernel's flags).
+    mats = {g.WW: ww, g.WR: wr, g.RW: rw}
+    for i, hist in enumerate(per_history):
+        n = hist["n"]
+        n_live[i] = n
+        for s, d, cls in hist["edges"]:
+            if s != d:
+                mats[cls][i, s, d] = True
+        invoke_idx[i, :n] = hist["invoke_index"]
+        complete_idx[i, :n] = hist["complete_index"]
+        process[i, :n] = hist["process"]
+    return {"ww": ww, "wr": wr, "rw": rw, "invoke_index": invoke_idx,
+            "complete_index": complete_idx, "process": process,
+            "n_txns": n_live, "T": T}
+
+
+def check_edge_batch(per_history: list[dict], realtime: bool = False,
+                     process_order: bool = False,
+                     classify: bool = True) -> list[dict]:
+    """Device cycle check over host-built edge lists: per-history
+    {anomaly-name: True} dicts (the rw-register device path)."""
+    if not per_history:
+        return []
+    p = pack_edge_matrices(per_history)
+    flags = classify_matrices_device(
+        jnp.asarray(p["ww"]), jnp.asarray(p["wr"]), jnp.asarray(p["rw"]),
+        jnp.asarray(p["invoke_index"]), jnp.asarray(p["complete_index"]),
+        jnp.asarray(p["process"]), jnp.asarray(p["n_txns"]),
+        steps=closure_steps(p["T"]), classify=classify, realtime=realtime,
+        process_order=process_order)
+    return [flags_to_names(int(w)) for w in np.asarray(flags)]
 
 
 def flags_to_names(word: int) -> dict:

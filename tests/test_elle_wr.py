@@ -1,0 +1,310 @@
+"""rw-register (Elle wr) checker tests: hand-built anomaly histories with
+golden verdicts, a sequentially-consistent simulator producing valid
+histories, and CPU-vs-TPU differential parity (SURVEY.md §4.3 tier a)."""
+
+import random
+
+import pytest
+
+from jepsen_tpu.checker.elle import wr
+from jepsen_tpu.workloads import wr as wr_workload
+
+
+def hist(ops):
+    """Build an indexed history from (type, process, txn) tuples."""
+    out = []
+    for i, (ty, p, txn) in enumerate(ops):
+        out.append({"type": ty, "process": p, "f": "txn", "value": txn,
+                    "index": i, "time": i * 1000})
+    return out
+
+
+def check(history, backend="cpu", **kw):
+    c = wr.rw_register_checker(backend=backend, **kw)
+    return c.check({}, history, {})
+
+
+def ok_txn(p, txn):
+    return [("invoke", p, txn), ("ok", p, txn)]
+
+
+def interleave(*txns):
+    """Sequential (non-overlapping) completed txns."""
+    ops = []
+    for p, txn in txns:
+        ops += ok_txn(p, txn)
+    return hist(ops)
+
+
+class TestHostAnomalies:
+    def test_valid_simple(self):
+        h = interleave(
+            (0, [["w", "x", 1]]),
+            (1, [["r", "x", 1]]),
+            (0, [["w", "x", 2]]),
+            (1, [["r", "x", 2]]))
+        res = check(h)
+        assert res["valid?"] is True
+
+    def test_internal(self):
+        h = interleave((0, [["w", "x", 1], ["r", "x", 2]]))
+        res = check(h)
+        assert res["valid?"] is False
+        assert "internal" in res["anomaly-types"]
+
+    def test_internal_read_read(self):
+        h = interleave((0, [["r", "x", 1], ["r", "x", 2]]))
+        res = check(h)
+        assert "internal" in res["anomaly-types"]
+
+    def test_g1a_aborted_read(self):
+        h = hist([
+            ("invoke", 0, [["w", "x", 1]]),
+            ("fail", 0, [["w", "x", 1]]),
+            ("invoke", 1, [["r", "x", None]]),
+            ("ok", 1, [["r", "x", 1]]),
+        ])
+        res = check(h)
+        assert res["valid?"] is False
+        assert "G1a" in res["anomaly-types"]
+
+    def test_g1b_intermediate_read(self):
+        h = interleave(
+            (0, [["w", "x", 1], ["w", "x", 2]]),
+            (1, [["r", "x", 1]]))
+        res = check(h)
+        assert res["valid?"] is False
+        assert "G1b" in res["anomaly-types"]
+
+    def test_g1a_intermediate_failed_write(self):
+        # Reading a failed txn's NON-final write is still an aborted
+        # read, not a phantom.
+        h = hist([
+            ("invoke", 0, [["w", "x", 1], ["w", "x", 2]]),
+            ("fail", 0, [["w", "x", 1], ["w", "x", 2]]),
+            ("invoke", 1, [["r", "x", None]]),
+            ("ok", 1, [["r", "x", 1]]),
+        ])
+        res = check(h)
+        assert "G1a" in res["anomaly-types"]
+        assert "phantom-read" not in res["anomaly-types"]
+
+    def test_phantom(self):
+        h = interleave((1, [["r", "x", 99]]))
+        res = check(h)
+        assert res["valid?"] is False
+        assert "phantom-read" in res["anomaly-types"]
+
+    def test_own_intermediate_read_ok(self):
+        h = interleave((0, [["w", "x", 1], ["r", "x", 1], ["w", "x", 2]]))
+        res = check(h)
+        assert res["valid?"] is True
+
+
+class TestCycles:
+    def test_g1c_wr_cycle(self):
+        # t1 writes x=1 and reads y=1 (from t2); t2 writes y=1, reads x=1.
+        h = interleave(
+            (0, [["w", "x", 1], ["r", "y", 1]]),
+            (1, [["w", "y", 1], ["r", "x", 1]]))
+        res = check(h)
+        assert res["valid?"] is False
+        assert "G1c" in res["anomaly-types"]
+
+    def test_g0_write_cycle_wfr(self):
+        # wfr version orders: x: 1 < 2 (T2 reads x=1, writes x=2), and
+        # y: 1 < 2 (T1 reads y=1, writes y=2). Writers: x1,y2 by T1;
+        # x2,y1 by T2. ww edges: T1->T2 (key x), T2->T1 (key y): a pure
+        # write cycle.
+        h = hist([
+            ("invoke", 0, [["w", "x", 1], ["r", "y", None], ["w", "y", 2]]),
+            ("invoke", 1, [["w", "y", 1], ["r", "x", None], ["w", "x", 2]]),
+            ("ok", 0, [["w", "x", 1], ["r", "y", 1], ["w", "y", 2]]),
+            ("ok", 1, [["w", "y", 1], ["r", "x", 1], ["w", "x", 2]]),
+        ])
+        res = check(h, wfr_keys=True)
+        assert res["valid?"] is False
+        assert "G0" in res["anomaly-types"]
+
+    def test_sequential_keys_ww_edges(self):
+        # One process's successive writes to a key produce a ww edge
+        # between the two writer txns.
+        from jepsen_tpu.checker.elle import graph as g
+        h = interleave(
+            (0, [["w", "x", 1]]),
+            (0, [["w", "x", 2]]))
+        enc = wr.encode_wr_history(h, sequential_keys=True)
+        assert (0, 1, g.WW) in enc.edges
+        # Without the flag no write order is inferable: no ww edges.
+        enc2 = wr.encode_wr_history(h)
+        assert not any(ty == g.WW for _, _, ty in enc2.edges)
+
+    def test_linearizable_keys_ww_chain(self):
+        from jepsen_tpu.checker.elle import graph as g
+        # Non-overlapping writes by different processes: realtime orders
+        # them; transitive reduction keeps the chain adjacent.
+        h = interleave(
+            (0, [["w", "x", 1]]),
+            (1, [["w", "x", 2]]),
+            (2, [["w", "x", 3]]))
+        enc = wr.encode_wr_history(h, linearizable_keys=True)
+        ww = {(s, d) for s, d, ty in enc.edges if ty == g.WW}
+        assert ww == {(0, 1), (1, 2)}
+
+    def test_wfr_consistent_chain_valid(self):
+        h = interleave(
+            (0, [["w", "x", 1]]),
+            (1, [["r", "x", 1], ["w", "x", 2]]),
+            (0, [["r", "x", 2], ["w", "x", 3]]),
+        )
+        res = check(h, wfr_keys=True)
+        assert res["valid?"] is True  # consistent chain 1<2<3
+
+    def test_cyclic_versions(self):
+        h = interleave(
+            (0, [["r", "x", 2], ["w", "x", 1]]),
+            (1, [["r", "x", 1], ["w", "x", 2]]))
+        res = check(h, wfr_keys=True)
+        assert res["valid?"] is False
+        assert "cyclic-versions" in res["anomaly-types"]
+
+    def test_g_single(self):
+        # T1 reads x=nil (missed T2's write), T2 writes x; T2 reads y=1
+        # written by T1 => rw T1->T2, wr T1->T2? Need cycle back.
+        # T1: r x nil, w y 1 ; T2: w x 1, r y 1.
+        # rw: T1 -> T2 (read nil, missed x=1). wr: T1 -> T2 (T2 read y=1).
+        # Need T2 -> T1 edge: make T2's write x=1 read by... use wr from
+        # T2 to T1: T1 reads x... conflict. Craft classic G-single:
+        # T1: r x nil, r y 1 ; T2: w x 1, w y 1 (y first).
+        # wr: T2 -> T1 (y=1). rw: T1 -> T2 (x nil missed x=1). Cycle with
+        # exactly one rw => G-single.
+        h = hist([
+            ("invoke", 0, [["r", "x", None], ["r", "y", None]]),
+            ("invoke", 1, [["w", "y", 1], ["w", "x", 1]]),
+            ("ok", 1, [["w", "y", 1], ["w", "x", 1]]),
+            ("ok", 0, [["r", "x", None], ["r", "y", 1]]),
+        ])
+        res = check(h)
+        assert res["valid?"] is False
+        assert "G-single" in res["anomaly-types"]
+
+    def test_g2_item(self):
+        # Write skew: T1 reads x=nil writes y=1; T2 reads y=nil writes
+        # x=1. rw T1->T2 (x), rw T2->T1 (y): two rw edges.
+        h = hist([
+            ("invoke", 0, [["r", "x", None], ["w", "y", 1]]),
+            ("invoke", 1, [["r", "y", None], ["w", "x", 1]]),
+            ("ok", 0, [["r", "x", None], ["w", "y", 1]]),
+            ("ok", 1, [["r", "y", None], ["w", "x", 1]]),
+        ])
+        res = check(h)
+        assert res["valid?"] is False
+        assert "G2-item" in res["anomaly-types"]
+        assert "G-single" not in res["anomaly-types"]
+
+    def test_g2_allowed_when_not_prohibited(self):
+        h = hist([
+            ("invoke", 0, [["r", "x", None], ["w", "y", 1]]),
+            ("invoke", 1, [["r", "y", None], ["w", "x", 1]]),
+            ("ok", 0, [["r", "x", None], ["w", "y", 1]]),
+            ("ok", 1, [["r", "y", None], ["w", "x", 1]]),
+        ])
+        res = check(h, anomalies=("G1",))
+        assert res["valid?"] is True
+
+
+def simulate_serial(seed, n_ops=120, n_procs=4, key_count=4):
+    """Serially-executed rw-register txns: always valid under every
+    inference mode."""
+    rng = random.Random(seed)
+    state: dict = {}
+    counters: dict = {}
+    ops = []
+    i = 0
+    for _ in range(n_ops):
+        p = rng.randrange(n_procs)
+        txn = []
+        for _ in range(rng.randint(1, 3)):
+            k = rng.randrange(key_count)
+            if rng.random() < 0.5:
+                txn.append(["r", k, None])
+            else:
+                counters[k] = counters.get(k, 0) + 1
+                txn.append(["w", k, counters[k]])
+        inv = {"type": "invoke", "process": p, "f": "txn",
+               "value": [list(m) for m in txn], "index": i, "time": i}
+        i += 1
+        done = []
+        for f, k, v in txn:
+            if f == "w":
+                state[k] = v
+                done.append(["w", k, v])
+            else:
+                done.append(["r", k, state.get(k)])
+        ok = {"type": "ok", "process": p, "f": "txn", "value": done,
+              "index": i, "time": i}
+        i += 1
+        ops += [inv, ok]
+    return ops
+
+
+class TestDifferential:
+    @pytest.mark.parametrize("seed", range(5))
+    def test_serial_valid_all_modes(self, seed):
+        h = simulate_serial(seed)
+        for kw in ({}, {"sequential_keys": True},
+                   {"linearizable_keys": True}, {"wfr_keys": True},
+                   {"sequential_keys": True, "linearizable_keys": True,
+                    "wfr_keys": True}):
+            res = check(h, **kw)
+            assert res["valid?"] is True, (kw, res["anomaly-types"])
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_cpu_tpu_parity_serial(self, seed):
+        h = simulate_serial(seed, n_ops=60)
+        a = check(h, backend="cpu", linearizable_keys=True)
+        b = check(h, backend="tpu", linearizable_keys=True)
+        assert a["valid?"] == b["valid?"]
+        assert a["anomaly-types"] == b["anomaly-types"]
+
+    def test_cpu_tpu_parity_anomalous(self):
+        cases = [
+            interleave((0, [["w", "x", 1], ["r", "y", 1]]),
+                       (1, [["w", "y", 1], ["r", "x", 1]])),
+            hist([
+                ("invoke", 0, [["r", "x", None], ["w", "y", 1]]),
+                ("invoke", 1, [["r", "y", None], ["w", "x", 1]]),
+                ("ok", 0, [["r", "x", None], ["w", "y", 1]]),
+                ("ok", 1, [["r", "y", None], ["w", "x", 1]]),
+            ]),
+            hist([
+                ("invoke", 0, [["r", "x", None], ["r", "y", None]]),
+                ("invoke", 1, [["w", "y", 1], ["w", "x", 1]]),
+                ("ok", 1, [["w", "y", 1], ["w", "x", 1]]),
+                ("ok", 0, [["r", "x", None], ["r", "y", 1]]),
+            ]),
+        ]
+        for h in cases:
+            a = check(h, backend="cpu")
+            b = check(h, backend="tpu")
+            assert a["valid?"] == b["valid?"]
+            cyc = {"G0", "G1c", "G-single", "G2-item"}
+            assert set(a["anomaly-types"]) & cyc \
+                == set(b["anomaly-types"]) & cyc
+
+
+class TestWorkload:
+    def test_generator_unique_writes(self):
+        g = wr_workload.WrGen(seed=7)
+        seen = set()
+        for _ in range(300):
+            op = g()
+            for f, k, v in op["value"]:
+                if f == "w":
+                    assert (k, v) not in seen
+                    seen.add((k, v))
+
+    def test_test_map(self):
+        t = wr_workload.test(seed=1)
+        assert t["name"] == "rw-register"
+        assert t["checker"] is not None and t["generator"] is not None
