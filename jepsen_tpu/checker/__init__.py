@@ -580,3 +580,40 @@ def linearizable(m: model.Model | None = None,
                  algorithm: str = "competition",
                  backend: str = "cpu", **kw) -> Checker:
     return Linearizable(m, algorithm=algorithm, backend=backend, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Plot/report checkers live in submodules (perf, clock, timeline) but are
+# part of the reference's jepsen.checker namespace (checker.clj:797-837) —
+# re-export the constructors here. Imported lazily to keep matplotlib off
+# the fast path.
+# ---------------------------------------------------------------------------
+
+def _submodule(name: str):
+    import importlib
+    return importlib.import_module(f"{__name__}.{name}")
+
+
+def latency_graph(nemeses=None) -> Checker:
+    return _submodule("perf").latency_graph(nemeses)
+
+
+def rate_graph(nemeses=None) -> Checker:
+    return _submodule("perf").rate_graph_checker(nemeses)
+
+
+def perf_checker(opts: dict | None = None) -> Checker:
+    """Composite latency+rate plots (checker.clj:822-829). Named
+    perf_checker because `checker.perf` is the helper submodule, as in the
+    reference's jepsen.checker.perf namespace."""
+    return _submodule("perf").perf(opts)
+
+
+def clock_plot() -> Checker:
+    return _submodule("clock").clock_plot()
+
+
+def timeline_checker() -> Checker:
+    """Timeline HTML checker; the submodule `checker.timeline` mirrors
+    jepsen.checker.timeline (whose constructor is `html`)."""
+    return _submodule("timeline").html()

@@ -255,6 +255,29 @@ class IndependentChecker(Checker):
     def __init__(self, sub: Checker):
         self.sub = sub
 
+    @staticmethod
+    def _sub_opts(opts: dict, k) -> dict:
+        """Per-key opts: nest artifact output under independent/<key> so
+        store-writing sub-checkers (timeline, perf plots...) don't clobber
+        each other across keys (independent.clj:474-478)."""
+        base = opts.get("subdirectory")
+        base = ([base] if isinstance(base, str) else list(base or []))
+        return {**opts, "subdirectory": base + ["independent", str(k)],
+                "history-key": k}
+
+    def _persist_key(self, test: dict, opts: dict, k, sub: list,
+                     result: dict) -> None:
+        """Write per-key results.edn + history.edn (independent.clj:480-488)."""
+        store = test.get("store")
+        if store is None:
+            return
+        from . import edn, history as h
+        from .store import _results_to_edn
+        sub_opts = self._sub_opts(opts, k)
+        d = store.path(test, *sub_opts["subdirectory"], "results.edn")
+        d.write_text(edn.dumps(_results_to_edn(result)) + "\n")
+        d.parent.joinpath("history.edn").write_text(h.history_to_edn(sub))
+
     def check(self, test, history, opts):
         opts = opts or {}
         ks = history_keys(history)
@@ -263,11 +286,18 @@ class IndependentChecker(Checker):
             try:
                 results = self.sub.check_batch(test, subs, opts)
             except Exception:
-                results = [check_safe(self.sub, test, s, opts)
-                           for s in subs]
+                results = [check_safe(self.sub, test, s, self._sub_opts(opts, k))
+                           for k, s in zip(ks, subs)]
         else:
             results = bounded_pmap(
-                lambda s: check_safe(self.sub, test, s, opts), subs)
+                lambda ks_: check_safe(self.sub, test, ks_[1],
+                                       self._sub_opts(opts, ks_[0])),
+                list(zip(ks, subs)))
+        for k, s, r in zip(ks, subs, results):
+            try:
+                self._persist_key(test, opts, k, s, r)
+            except Exception:
+                pass
         result_map = dict(zip(ks, results))
         failures = [k for k, r in result_map.items()
                     if r.get("valid?") is False]

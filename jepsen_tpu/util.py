@@ -171,3 +171,66 @@ def chunk_vec(n: int, xs: Sequence[T]) -> list[list[T]]:
 def name_of(x: Any) -> str:
     """Human-readable name for fs/processes in results."""
     return x if isinstance(x, str) else str(x)
+
+
+# ---------------------------------------------------------------------------
+# Latency pairing and nemesis intervals (reference util.clj:619-700) — the
+# data layer under the perf/timeline/clock plot checkers.
+# ---------------------------------------------------------------------------
+
+def history_latencies(history: Sequence[dict]) -> list[dict]:
+    """Return the history with every invocation annotated with
+
+        "latency"     nanoseconds until its completion
+        "completion"  the completing op itself (also latency-annotated)
+
+    Invocations that never complete get neither key. Mirrors the
+    reference's jepsen.util/history->latencies (util.clj:619-653)."""
+    out: list[dict] = []
+    invokes: dict = {}  # process -> index into out
+    for op in history:
+        if op.get("type") == "invoke":
+            out.append(op)
+            invokes[op.get("process")] = len(out) - 1
+        elif op.get("process") in invokes:
+            i = invokes.pop(op.get("process"))
+            inv = out[i]
+            lat = (op.get("time") or 0) - (inv.get("time") or 0)
+            op = {**op, "latency": lat}
+            out[i] = {**inv, "latency": lat, "completion": op}
+            out.append(op)
+        else:
+            out.append(op)
+    return out
+
+
+def nemesis_intervals(history: Sequence[dict],
+                      opts: dict | None = None) -> list[tuple[dict, dict | None]]:
+    """Pair nemesis :start/:stop transitions into [start, stop] intervals.
+
+    Nemesis ops come in invoke/complete pairs, so ``s1 s2 e1 e2`` pairs the
+    first with the third and the second with the fourth; every open start is
+    closed by the next stop pair; unclosed starts yield (start, None). opts
+    may carry "start"/"stop" sets of f-names (defaults {"start"}/{"stop"}).
+    Mirrors reference util.clj:655-700."""
+    opts = opts or {}
+    start_fs = set(opts.get("start") or {"start"})
+    stop_fs = set(opts.get("stop") or {"stop"})
+    nem = [o for o in history if o.get("process") == "nemesis"]
+    pairs = [(nem[i], nem[i + 1]) for i in range(0, len(nem) - 1, 2)
+             if nem[i].get("f") == nem[i + 1].get("f")]
+    intervals: list[tuple[dict, dict | None]] = []
+    starts: list[tuple[dict, dict]] = []
+    for a, b in pairs:
+        f = a.get("f")
+        if f in start_fs:
+            starts.append((a, b))
+        elif f in stop_fs:
+            for s1, s2 in starts:
+                intervals.append((s1, a))
+                intervals.append((s2, b))
+            starts = []
+    for s1, s2 in starts:
+        intervals.append((s1, None))
+        intervals.append((s2, None))
+    return intervals

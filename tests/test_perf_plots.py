@@ -1,0 +1,220 @@
+"""Perf/clock/timeline checker tests.
+
+Data-layer functions are golden-tested (quantile index rule, bucketing,
+latency pairing, nemesis intervals — reference perf.clj:21-86,
+util.clj:619-700); renderers are exercised end-to-end into a tmp store
+and asserted to produce non-empty artifacts.
+"""
+
+import random
+
+import pytest
+
+from jepsen_tpu import checker as c
+from jepsen_tpu import util
+from jepsen_tpu.checker import clock as clockmod
+from jepsen_tpu.checker import perf
+from jepsen_tpu.checker import timeline as tlmod
+from jepsen_tpu.store import Store
+
+S = 1_000_000_000  # ns per second
+
+
+def test_bucket_scale_and_time():
+    assert perf.bucket_scale(10, 0) == 5
+    assert perf.bucket_scale(10, 3) == 35
+    assert perf.bucket_time(10, 0) == 5
+    assert perf.bucket_time(10, 9.99) == 5
+    assert perf.bucket_time(10, 10.01) == 15
+
+
+def test_buckets():
+    assert perf.buckets(10, 30) == [5, 15, 25]
+    assert perf.buckets(10, 4) == []
+
+
+def test_quantiles_floor_rule():
+    # floor(n*q) with clamp to n-1, matching perf.clj:51-61.
+    pts = [1, 2, 3, 4]
+    q = perf.quantiles([0, 0.5, 0.99, 1], pts)
+    assert q == {0: 1, 0.5: 3, 0.99: 4, 1: 4}
+    assert perf.quantiles([0.5], []) == {}
+
+
+def test_latencies_to_quantiles():
+    pts = [(1, 10.0), (2, 20.0), (11, 30.0)]
+    out = perf.latencies_to_quantiles(10, [1], pts)
+    assert out == {1: [(5, 20.0), (15, 30.0)]}
+
+
+def test_history_latencies_pairs_and_skips():
+    h = [
+        {"type": "invoke", "process": 0, "f": "r", "time": 0},
+        {"type": "invoke", "process": 1, "f": "w", "time": 1 * S},
+        {"type": "ok", "process": 1, "f": "w", "time": 3 * S},
+        {"type": "info", "process": 0, "f": "r", "time": 4 * S},
+        {"type": "invoke", "process": 2, "f": "r", "time": 5 * S},
+    ]
+    lh = util.history_latencies(h)
+    assert lh[1]["latency"] == 2 * S
+    assert lh[1]["completion"]["type"] == "ok"
+    assert lh[0]["latency"] == 4 * S          # info completes too
+    assert "latency" not in lh[4]             # never completed
+
+
+def test_nemesis_intervals_interleaving():
+    def nem(f, t):
+        return {"type": "info", "process": "nemesis", "f": f, "time": t}
+    h = [nem("start", 1), nem("start", 2),
+         nem("start", 3), nem("start", 4),
+         nem("stop", 5), nem("stop", 6)]
+    iv = util.nemesis_intervals(h)
+    got = [(a["time"], b["time"] if b else None) for a, b in iv]
+    # s1 s2 s3 s4 e1 e2 -> [s1 e1] [s2 e2] [s3 e1] [s4 e2]
+    assert got == [(1, 5), (2, 6), (3, 5), (4, 6)]
+
+
+def test_nemesis_intervals_unclosed():
+    def nem(f, t):
+        return {"type": "info", "process": "nemesis", "f": f, "time": t}
+    iv = util.nemesis_intervals([nem("start", 1), nem("start", 2)])
+    assert [(a["time"], b) for a, b in iv] == [(1, None), (2, None)]
+
+
+def test_invokes_by_f_type():
+    h = [
+        {"type": "invoke", "process": 0, "f": "r", "time": 0},
+        {"type": "ok", "process": 0, "f": "r", "time": 1},
+        {"type": "invoke", "process": 0, "f": "r", "time": 2},
+        {"type": "fail", "process": 0, "f": "r", "time": 3},
+        {"type": "invoke", "process": 0, "f": "w", "time": 4},
+        {"type": "ok", "process": 0, "f": "w", "time": 5},
+    ]
+    d = perf.invokes_by_f_type(util.history_latencies(h))
+    assert len(d["r"]["ok"]) == 1
+    assert len(d["r"]["fail"]) == 1
+    assert len(d["w"]["ok"]) == 1
+
+
+def test_rates():
+    h = [{"type": "ok", "process": 0, "f": "r", "time": int(t * S)}
+         for t in (0, 1, 2, 11)]
+    out = perf.rates(h, dt=10)
+    assert out["r"]["ok"][5.0] == pytest.approx(0.3)
+    assert out["r"]["ok"][15.0] == pytest.approx(0.1)
+
+
+def _random_history(n=200, seed=7):
+    rng = random.Random(seed)
+    h, t = [], 0
+    for i in range(n):
+        p = i % 5
+        t += rng.randint(1, 20) * 1_000_000
+        f = rng.choice(["read", "write", "cas"])
+        h.append({"type": "invoke", "process": p, "f": f, "time": t})
+        t += rng.randint(1, 50) * 1_000_000
+        h.append({"type": rng.choice(["ok", "ok", "ok", "fail", "info"]),
+                  "process": p, "f": f, "time": t})
+        if i % 40 == 10:
+            h.append({"type": "info", "process": "nemesis", "f": "start",
+                      "time": t, "value": "partition"})
+            h.append({"type": "info", "process": "nemesis", "f": "start",
+                      "time": t + 1, "value": "partition"})
+        if i % 40 == 30:
+            h.append({"type": "info", "process": "nemesis", "f": "stop",
+                      "time": t, "value": "heal"})
+            h.append({"type": "info", "process": "nemesis", "f": "stop",
+                      "time": t + 1, "value": "heal"})
+    return h
+
+
+def test_perf_checker_renders_artifacts(tmp_path):
+    store = Store(tmp_path / "store")
+    test = {"name": "perf-test", "store": store}
+    res = c.perf_checker().check(test, _random_history(), {})
+    assert res["valid?"] is True
+    d = store.test_dir(test)
+    for f in ("latency-raw.png", "latency-quantiles.png", "rate.png"):
+        assert (d / f).stat().st_size > 1000, f
+
+
+def test_perf_checker_without_store_is_noop():
+    assert c.perf_checker().check({"name": "x"}, _random_history(50), {})[
+        "valid?"] is True
+
+
+def test_clock_datasets_and_plot(tmp_path):
+    h = [
+        {"type": "info", "process": "nemesis", "f": "bump",
+         "time": 1 * S, "clock-offsets": {"n1": 0.5, "n2": 0.0}},
+        {"type": "info", "process": "nemesis", "f": "bump",
+         "time": 2 * S, "clock-offsets": {"n1": 2.5}},
+        {"type": "ok", "process": 0, "f": "r", "time": 3 * S},
+    ]
+    ds = clockmod.history_to_datasets(h)
+    assert ds["n1"] == [(1.0, 0.5), (2.0, 2.5), (3.0, 2.5)]
+    assert ds["n2"] == [(1.0, 0.0), (3.0, 0.0)]
+    store = Store(tmp_path / "store")
+    test = {"name": "clock-test", "store": store}
+    assert c.clock_plot().check(test, h, {})["valid?"] is True
+    assert (store.test_dir(test) / "clock-skew.png").stat().st_size > 1000
+
+
+def test_short_node_names():
+    assert clockmod.short_node_names(
+        ["n1.foo.com", "n2.foo.com"]) == ["n1", "n2"]
+    assert clockmod.short_node_names(["n1"]) == ["n1"]
+    assert clockmod.short_node_names(["a.x", "b.y"]) == ["a.x", "b.y"]
+
+
+def test_timeline_html(tmp_path):
+    store = Store(tmp_path / "store")
+    test = {"name": "tl-test", "store": store}
+    res = c.timeline_checker().check(test, _random_history(30), {})
+    assert res["valid?"] is True
+    out = (store.test_dir(test) / "timeline.html").read_text()
+    assert "op ok" in out and "op invoke" not in out  # pairs render completions
+    assert "tl-test" in out
+    assert 'id="i' in out
+
+
+def test_timeline_pending_invoke_renders_as_invoke():
+    h = [{"type": "invoke", "process": 0, "f": "r", "value": None,
+          "time": 0}]
+    out = tlmod.render_html({"name": "t"}, h)
+    assert "op invoke" in out
+
+
+def test_independent_timeline_per_key_subdirs(tmp_path):
+    """Store-writing sub-checkers must not clobber each other across
+    independent keys (independent.clj:474-488)."""
+    from jepsen_tpu import independent
+    store = Store(tmp_path / "store")
+    test = {"name": "indep-tl", "store": store}
+    h = []
+    for k in (1, 2):
+        h.append({"type": "invoke", "process": k, "f": "r",
+                  "value": independent.tuple_(k, None), "time": k * S})
+        h.append({"type": "ok", "process": k, "f": "r",
+                  "value": independent.tuple_(k, k), "time": k * S + 1000})
+    res = independent.checker(c.timeline_checker()).check(test, h, {})
+    assert res["valid?"] is True
+    d = store.test_dir(test)
+    for k in (1, 2):
+        assert (d / "independent" / str(k) / "timeline.html").exists()
+        assert (d / "independent" / str(k) / "results.edn").exists()
+        assert (d / "independent" / str(k) / "history.edn").exists()
+
+
+def test_nemesis_activity_catchall_band():
+    def nem(f, t):
+        return {"type": "info", "process": "nemesis", "f": f, "time": t}
+    h = [nem("start-partition", 1), nem("start-partition", 2),
+         nem("strobe-clock", 3), nem("strobe-clock", 4)]
+    acts = perf.nemesis_activity(
+        [{"name": "partition", "start": {"start-partition"},
+          "stop": {"stop-partition"}, "fs": set()}], h)
+    names = {a["name"]: a for a in acts}
+    assert len(names["partition"]["ops"]) == 2
+    # strobe-clock ops land in the default band, not dropped
+    assert {o["f"] for o in names["nemesis"]["ops"]} == {"strobe-clock"}
