@@ -19,7 +19,8 @@ def history_to_datasets(history: Sequence[dict]) -> dict:
     each series extended to the final history time (clock.clj:13-34)."""
     if not history:
         return {}
-    final_t = nanos_to_secs(history[-1].get("time"))
+    final_t = max((nanos_to_secs(o.get("time")) for o in history),
+                  default=0.0)
     series: dict = {}
     for op in history:
         offsets = op.get("clock-offsets")

@@ -143,8 +143,7 @@ def nemesis_activity(nemeses: Sequence[dict] | None,
         ops = [o for o in nem_ops if not fs or o.get("f") in fs]
         claimed.update(id(o) for o in ops)
         intervals = util.nemesis_intervals(
-            ops, {"start": n.get("start") or {"start"},
-                  "stop": n.get("stop") or {"stop"}})
+            ops, {"start": n.get("start"), "stop": n.get("stop")})
         out.append({**n, "ops": ops, "intervals": intervals})
     # Unmatched nemesis ops render under a default band so fault activity
     # never silently disappears from a plot (nemesis-ops, perf.clj:204-216).
@@ -184,10 +183,14 @@ def _draw_nemeses(ax, history, nemeses, t_max: float) -> None:
 # ---------------------------------------------------------------------------
 
 def _fig(title: str, ylabel: str, logy: bool):
-    import matplotlib
-    matplotlib.use("Agg", force=False)
-    import matplotlib.pyplot as plt
-    fig, ax = plt.subplots(figsize=(9, 4), dpi=100)
+    # The OO API (Figure + Agg canvas), NOT pyplot: checkers render
+    # concurrently (Compose.real_pmap, independent's bounded_pmap) and
+    # pyplot's global figure registry is not thread-safe.
+    from matplotlib.backends.backend_agg import FigureCanvasAgg
+    from matplotlib.figure import Figure
+    fig = Figure(figsize=(9, 4), dpi=100)
+    FigureCanvasAgg(fig)
+    ax = fig.add_subplot()
     ax.set_title(title)
     ax.set_xlabel("Time (s)")
     ax.set_ylabel(ylabel)
@@ -202,8 +205,6 @@ def _finish(fig, ax, path) -> None:
         ax.legend(loc="upper left", bbox_to_anchor=(1.01, 1.0),
                   fontsize="small")
     fig.savefig(path, bbox_inches="tight")
-    import matplotlib.pyplot as plt
-    plt.close(fig)
 
 
 def _t_max(history) -> float:
@@ -244,9 +245,9 @@ def quantiles_graph(test: dict, history: Sequence[dict], path,
     by_f = {f: latencies_to_quantiles(
         dt, qs, [latency_point(o) for o in ops if "latency" in o])
         for f, ops in invokes_by_f(lh).items()}
-    q_colors = {q: c for q, c in zip(
-        sorted(qs, reverse=True),
-        ["#FF1E90", "#FFA400", "#81BFFC", "#53DF83", "#909090"])}
+    palette = ["#FF1E90", "#FFA400", "#81BFFC", "#53DF83", "#909090"]
+    q_colors = {q: palette[i % len(palette)]
+                for i, q in enumerate(sorted(qs, reverse=True))}
     fig, ax = _fig(f"{test.get('name', '')} latency", "Latency (ms)", True)
     any_points = False
     markers = "osv^D*Pp"

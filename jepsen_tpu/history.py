@@ -202,41 +202,16 @@ def history_from_edn(text: str) -> list[Op]:
 # ---------------------------------------------------------------------------
 
 def history_latencies(history: list[Op]) -> list[Op]:
-    """Annotate invocations with :latency (completion time - invoke time, ns)
-    and :completion-type. Pending ops get no latency."""
-    out = []
-    for inv, comp in pairs(history):
-        if not is_invoke(inv):
-            continue
-        o = dict(inv)
-        if comp is not None:
-            o["latency"] = comp.get("time", 0) - inv.get("time", 0)
-            o["completion-type"] = comp["type"]
-        out.append(o)
-    return out
+    """Canonical implementation lives in util.history_latencies
+    (reference util.clj:619-653): invocations gain "latency" (ns) and
+    "completion" (the completing op)."""
+    from .util import history_latencies as _hl
+    return _hl(history)
 
 
 def nemesis_intervals(history: list[Op], start_fs: set | None = None,
                       stop_fs: set | None = None) -> list[tuple[Op, Op | None]]:
-    """Pair up nemesis activation/deactivation ops into [start, stop] spans,
-    for shading fault windows on performance plots."""
-    if start_fs is None:
-        start_fs = {"start", "start-partition", "start-kill",
-                    "start-pause", "kill", "pause"}
-    if stop_fs is None:
-        stop_fs = {"stop", "stop-partition", "stop-kill", "stop-pause",
-                   "resume", "heal", "start!", "stop!"}
-    spans: list[tuple[Op, Op | None]] = []
-    current: Op | None = None
-    for o in history:
-        if o.get("process") != NEMESIS or is_invoke(o):
-            continue
-        f = o.get("f")
-        if f in start_fs and current is None:
-            current = o
-        elif f in stop_fs and current is not None:
-            spans.append((current, o))
-            current = None
-    if current is not None:
-        spans.append((current, None))
-    return spans
+    """Canonical implementation lives in util.nemesis_intervals
+    (reference util.clj:655-700)."""
+    from .util import nemesis_intervals as _ni
+    return _ni(history, {"start": start_fs, "stop": stop_fs})

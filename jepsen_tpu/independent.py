@@ -18,12 +18,15 @@ batch rows instead of JVM threads.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Callable, Iterable
 
 from . import generator as gen
 from . import history as h
 from .checker import Checker, check_safe, merge_valid
 from .util import bounded_pmap
+
+log = logging.getLogger(__name__)
 
 
 class Tuple(tuple):
@@ -283,6 +286,9 @@ class IndependentChecker(Checker):
         ks = history_keys(history)
         subs = [subhistory(k, history) for k in ks]
         if hasattr(self.sub, "check_batch"):
+            # Batch checkers get the shared opts (one device dispatch, no
+            # per-key namespacing) and so must not write store artifacts
+            # themselves; per-key results/history are persisted below.
             try:
                 results = self.sub.check_batch(test, subs, opts)
             except Exception:
@@ -297,7 +303,8 @@ class IndependentChecker(Checker):
             try:
                 self._persist_key(test, opts, k, s, r)
             except Exception:
-                pass
+                log.warning("couldn't persist results for key %r",
+                            k, exc_info=True)
         result_map = dict(zip(ks, results))
         failures = [k for k, r in result_map.items()
                     if r.get("valid?") is False]

@@ -204,33 +204,58 @@ def history_latencies(history: Sequence[dict]) -> list[dict]:
     return out
 
 
+# Nemesis f-names that begin/end a fault window, covering the combined
+# nemesis packages' start-x/stop-x convention (nemesis/combined.clj) as
+# well as the plain start/stop of nemesis.clj.
+DEFAULT_NEMESIS_START_FS = frozenset(
+    {"start", "start-partition", "start-kill", "start-pause",
+     "kill", "pause"})
+DEFAULT_NEMESIS_STOP_FS = frozenset(
+    {"stop", "stop-partition", "stop-kill", "stop-pause",
+     "resume", "heal", "start!", "stop!"})
+
+
 def nemesis_intervals(history: Sequence[dict],
                       opts: dict | None = None) -> list[tuple[dict, dict | None]]:
     """Pair nemesis :start/:stop transitions into [start, stop] intervals.
 
-    Nemesis ops come in invoke/complete pairs, so ``s1 s2 e1 e2`` pairs the
-    first with the third and the second with the fourth; every open start is
-    closed by the next stop pair; unclosed starts yield (start, None). opts
-    may carry "start"/"stop" sets of f-names (defaults {"start"}/{"stop"}).
-    Mirrors reference util.clj:655-700."""
+    In runner histories nemesis ops come in invoke/complete pairs with the
+    same :f, so ``s1 s2 e1 e2`` pairs the first with the third and the
+    second with the fourth (reference util.clj:655-700); a transition
+    recorded as a single op (hand-written histories) forms its own event.
+    Every open start is closed by the next stop; unclosed starts yield
+    (start, None). opts may carry "start"/"stop" f-name sets (defaults
+    cover the combined-nemesis start-x/stop-x names)."""
     opts = opts or {}
-    start_fs = set(opts.get("start") or {"start"})
-    stop_fs = set(opts.get("stop") or {"stop"})
+    start_fs = set(opts.get("start") or DEFAULT_NEMESIS_START_FS)
+    stop_fs = set(opts.get("stop") or DEFAULT_NEMESIS_STOP_FS)
     nem = [o for o in history if o.get("process") == "nemesis"]
-    pairs = [(nem[i], nem[i + 1]) for i in range(0, len(nem) - 1, 2)
-             if nem[i].get("f") == nem[i + 1].get("f")]
+    # Group invoke/complete pairs (same f, adjacent); lone transitions
+    # self-pair.
+    events: list[tuple[dict, dict]] = []
+    i = 0
+    while i < len(nem):
+        a = nem[i]
+        if i + 1 < len(nem) and nem[i + 1].get("f") == a.get("f"):
+            events.append((a, nem[i + 1]))
+            i += 2
+        else:
+            events.append((a, a))
+            i += 1
     intervals: list[tuple[dict, dict | None]] = []
     starts: list[tuple[dict, dict]] = []
-    for a, b in pairs:
+    for a, b in events:
         f = a.get("f")
         if f in start_fs:
             starts.append((a, b))
         elif f in stop_fs:
             for s1, s2 in starts:
                 intervals.append((s1, a))
-                intervals.append((s2, b))
+                if s1 is not s2 or a is not b:
+                    intervals.append((s2, b))
             starts = []
     for s1, s2 in starts:
         intervals.append((s1, None))
-        intervals.append((s2, None))
+        if s1 is not s2:
+            intervals.append((s2, None))
     return intervals

@@ -1,0 +1,202 @@
+"""Long-fork workload: a parallel-snapshot-isolation anomaly where
+concurrent writes are observed in conflicting orders by different reads.
+
+Counterpart of jepsen.tests.long-fork
+(jepsen/src/jepsen/tests/long_fork.clj). Writes are single-key inserts
+``[["w", k, 1]]``, each key written at most once; reads scan a whole
+*group* of n consecutive keys. Reads over the same group must form a
+total order under "dominates" comparison (nil -> value transitions only);
+two mutually incomparable reads are a long fork (read-compare
+long_fork.clj:210-246; find-forks 268-276).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Sequence
+
+from .. import generator as gen
+from ..checker import Checker
+
+
+def group_for(n: int, k: int) -> range:
+    """The n keys of k's group: [k - k%n, k - k%n + n) (long_fork.clj:100-106)."""
+    lo = k - (k % n)
+    return range(lo, lo + n)
+
+
+def read_txn_for(n: int, k: int, rng: random.Random | None = None) -> list:
+    """A txn reading k's whole group in shuffled order (long_fork.clj:108-114)."""
+    ks = list(group_for(n, k))
+    (rng or random).shuffle(ks)
+    return [["r", kk, None] for kk in ks]
+
+
+class LongForkGen(gen.Generator):
+    """Single inserts followed by group reads from the same worker, mixed
+    with reads of other in-flight groups (Generator long_fork.clj:116-151).
+
+    State: next_key counter + {worker: last-written-key}."""
+
+    def __init__(self, n: int, next_key: int = 0,
+                 workers: dict | None = None, seed: int | None = None,
+                 rng: random.Random | None = None):
+        self.n = n
+        self.next_key = next_key
+        self.workers = workers or {}
+        self.rng = rng or random.Random(seed)
+
+    def op(self, test, ctx):
+        worker = next((t for t in ctx.free_threads if t != gen.NEMESIS), None)
+        if worker is None:
+            return gen.PENDING, self
+        process = ctx.thread_to_process(worker)
+        k = self.workers.get(worker)
+        if k is not None:
+            # We wrote a key: read its group and clear our slot.
+            o = gen.fill_in_op(
+                {"process": process, "f": "read",
+                 "value": read_txn_for(self.n, k, self.rng)}, ctx)
+            return o, LongForkGen(self.n, self.next_key,
+                                  {**self.workers, worker: None},
+                                  rng=self.rng)
+        active = [v for v in self.workers.values() if v is not None]
+        if active and self.rng.random() < 0.5:
+            k = self.rng.choice(active)
+            o = gen.fill_in_op(
+                {"process": process, "f": "read",
+                 "value": read_txn_for(self.n, k, self.rng)}, ctx)
+            return o, self
+        o = gen.fill_in_op(
+            {"process": process, "f": "write",
+             "value": [["w", self.next_key, 1]]}, ctx)
+        return o, LongForkGen(self.n, self.next_key + 1,
+                              {**self.workers, worker: self.next_key},
+                              rng=self.rng)
+
+
+def generator(n: int = 2) -> gen.Generator:
+    return LongForkGen(n)
+
+
+class IllegalHistory(Exception):
+    def __init__(self, info: dict):
+        self.info = info
+        super().__init__(str(info))
+
+
+def read_op_value_map(op: dict) -> dict:
+    """read txn -> {key: value} (long_fork.clj:248-257)."""
+    return {m[1]: m[2] for m in (op.get("value") or [])}
+
+
+def read_compare(a: dict, b: dict) -> int | None:
+    """-1 if a dominates, 0 equal, 1 if b dominates, None incomparable.
+    Values change only nil -> written-once value (long_fork.clj:210-246)."""
+    if len(a) != len(b):
+        raise IllegalHistory({"type": "illegal-history", "reads": [a, b],
+                              "msg": "reads query different keys"})
+    res = 0
+    for k, va in a.items():
+        if k not in b:
+            raise IllegalHistory({"type": "illegal-history", "reads": [a, b],
+                                  "key": k,
+                                  "msg": "reads query different keys"})
+        vb = b[k]
+        if va == vb:
+            continue
+        if vb is None:           # a saw a value b didn't: a dominates here
+            if res > 0:
+                return None
+            res = -1
+        elif va is None:         # b dominates here
+            if res < 0:
+                return None
+            res = 1
+        else:
+            raise IllegalHistory(
+                {"type": "illegal-history", "key": k, "reads": [a, b],
+                 "msg": "distinct non-nil values for one key; "
+                        "keys are written once"})
+    return res
+
+
+def is_read_txn(txn) -> bool:
+    return all(m[0] == "r" for m in (txn or []))
+
+
+def is_write_txn(txn) -> bool:
+    return bool(txn) and len(txn) == 1 and txn[0][0] == "w"
+
+
+def find_forks(ops: Sequence[dict]) -> list:
+    """All mutually incomparable read pairs in one group
+    (long_fork.clj:259-276)."""
+    forks = []
+    for i in range(len(ops)):
+        ma = read_op_value_map(ops[i])
+        for j in range(i + 1, len(ops)):
+            if read_compare(ma, read_op_value_map(ops[j])) is None:
+                forks.append([ops[i], ops[j]])
+    return forks
+
+
+def groups(n: int, read_ops: Sequence[dict]) -> list[list[dict]]:
+    """Partition reads by their key set; each must cover exactly n keys
+    (long_fork.clj:288-314)."""
+    by_keys: dict[frozenset, list] = {}
+    for op in read_ops:
+        ks = frozenset(m[1] for m in (op.get("value") or []))
+        by_keys.setdefault(ks, []).append(op)
+    for ks, ops in by_keys.items():
+        if len(ks) != n:
+            raise IllegalHistory(
+                {"type": "illegal-history", "op": ops[0],
+                 "msg": f"every read should observe exactly {n} keys, "
+                        f"got {len(ks)}"})
+    return list(by_keys.values())
+
+
+class LongForkChecker(Checker):
+    """Verifies single-write keys, then searches every read group for
+    incomparable pairs (checker long_fork.clj:363-378)."""
+
+    def __init__(self, n: int):
+        self.n = n
+
+    def check(self, test, history, opts):
+        reads = [o for o in history
+                 if o.get("type") == "ok" and is_read_txn(o.get("value"))]
+        early = [v for v in (o.get("value") for o in reads)
+                 if not any(m[2] is not None for m in v)]
+        late = [v for v in (o.get("value") for o in reads)
+                if all(m[2] is not None for m in v)]
+        base = {"reads-count": len(reads),
+                "early-read-count": len(early),
+                "late-read-count": len(late)}
+        # multiple writes to one key -> unknown (long_fork.clj:327-342)
+        seen: set = set()
+        for o in history:
+            if o.get("type") == "invoke" and is_write_txn(o.get("value")):
+                k = o["value"][0][1]
+                if k in seen:
+                    return {**base, "valid?": "unknown",
+                            "error": ["multiple-writes", k]}
+                seen.add(k)
+        try:
+            forks = [f for g in groups(self.n, reads)
+                     for f in find_forks(g)]
+        except IllegalHistory as e:
+            return {**base, "valid?": "unknown", "error": e.info}
+        if forks:
+            return {**base, "valid?": False, "forks": forks}
+        return {**base, "valid?": True}
+
+
+def checker(n: int = 2) -> Checker:
+    return LongForkChecker(n)
+
+
+def workload(n: int = 2) -> dict:
+    """{"checker", "generator"} package (long_fork.clj:380-385)."""
+    return {"checker": checker(n), "generator": generator(n)}

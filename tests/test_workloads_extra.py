@@ -1,0 +1,314 @@
+"""Golden tests for the long-fork, causal, causal-reverse, adya, and
+generic-cycle workloads (reference behaviors: tests/long_fork.clj,
+causal.clj, causal_reverse.clj, adya.clj, cycle.clj)."""
+
+import pytest
+
+from jepsen_tpu import generator as gen, independent
+from jepsen_tpu.workloads import adya, causal, causal_reverse, cycle, long_fork
+
+
+def ok(process, f, value):
+    return {"type": "ok", "process": process, "f": f, "value": value}
+
+
+def invoke(process, f, value):
+    return {"type": "invoke", "process": process, "f": f, "value": value}
+
+
+# --------------------------------------------------------------------------
+# long fork
+# --------------------------------------------------------------------------
+
+def read(vals: dict):
+    return ok(0, "read", [["r", k, v] for k, v in vals.items()])
+
+
+def test_long_fork_classic_anomaly():
+    # T3 sees x=nil,y=1; T4 sees x=1,y=nil — mutually incomparable.
+    h = [
+        invoke(0, "write", [["w", 0, 1]]), ok(0, "write", [["w", 0, 1]]),
+        invoke(1, "write", [["w", 1, 1]]), ok(1, "write", [["w", 1, 1]]),
+        read({0: None, 1: 1}),
+        read({0: 1, 1: None}),
+    ]
+    res = long_fork.checker(2).check({}, h, {})
+    assert res["valid?"] is False
+    assert len(res["forks"]) == 1
+
+
+def test_long_fork_total_order_ok():
+    h = [
+        invoke(0, "write", [["w", 0, 1]]), ok(0, "write", [["w", 0, 1]]),
+        read({0: None, 1: None}),
+        read({0: 1, 1: None}),
+        read({0: 1, 1: 1}),
+    ]
+    res = long_fork.checker(2).check({}, h, {})
+    assert res["valid?"] is True
+    assert res["reads-count"] == 3
+    assert res["early-read-count"] == 1
+    assert res["late-read-count"] == 1
+
+
+def test_long_fork_multiple_writes_unknown():
+    h = [
+        invoke(0, "write", [["w", 5, 1]]),
+        invoke(1, "write", [["w", 5, 1]]),
+    ]
+    res = long_fork.checker(2).check({}, h, {})
+    assert res["valid?"] == "unknown"
+    assert res["error"] == ["multiple-writes", 5]
+
+
+def test_long_fork_read_compare():
+    assert long_fork.read_compare({1: None}, {1: None}) == 0
+    assert long_fork.read_compare({1: 1}, {1: None}) == -1
+    assert long_fork.read_compare({1: None}, {1: 1}) == 1
+    assert long_fork.read_compare({1: 1, 2: None}, {1: None, 2: 1}) is None
+    with pytest.raises(long_fork.IllegalHistory):
+        long_fork.read_compare({1: 1}, {2: 1})
+    with pytest.raises(long_fork.IllegalHistory):
+        long_fork.read_compare({1: 1}, {1: 2})
+
+
+def test_long_fork_generator_writes_then_reads_group():
+    g = long_fork.LongForkGen(3, seed=0)
+    ctx = gen.Context.for_test({"concurrency": 2})
+    test = {}
+    seen_write_then_read = False
+    for _ in range(40):
+        res = gen.op(g, test, ctx)
+        assert res is not None
+        o, g = res
+        if o is gen.PENDING:
+            break
+        if o["f"] == "read":
+            ks = [m[1] for m in o["value"]]
+            assert len(ks) == 3
+            assert sorted(ks) == list(long_fork.group_for(3, ks[0]))
+            seen_write_then_read = True
+        else:
+            assert o["f"] == "write"
+            assert o["value"][0][0] == "w"
+    assert seen_write_then_read
+
+
+def test_long_fork_workload_package():
+    wl = long_fork.workload(2)
+    assert "checker" in wl and "generator" in wl
+
+
+# --------------------------------------------------------------------------
+# causal
+# --------------------------------------------------------------------------
+
+def causal_op(f, value=None, position=None, link=None):
+    return {"type": "ok", "process": 0, "f": f, "value": value,
+            "position": position, "link": link}
+
+
+def test_causal_valid_order():
+    h = [
+        causal_op("read-init", 0, position=1, link="init"),
+        causal_op("write", 1, position=2, link=1),
+        causal_op("read", 1, position=3, link=2),
+        causal_op("write", 2, position=4, link=3),
+        causal_op("read", 2, position=5, link=4),
+    ]
+    res = causal.check().check({}, h, {})
+    assert res["valid?"] is True
+
+
+def test_causal_bad_link():
+    h = [
+        causal_op("read-init", 0, position=1, link="init"),
+        causal_op("write", 1, position=2, link=99),
+    ]
+    res = causal.check().check({}, h, {})
+    assert res["valid?"] is False
+    assert "link" in res["error"].lower() or "Cannot link" in res["error"]
+
+
+def test_causal_stale_read():
+    h = [
+        causal_op("read-init", 0, position=1, link="init"),
+        causal_op("write", 1, position=2, link=1),
+        causal_op("read", 0, position=3, link=2),  # stale: register is 1
+    ]
+    res = causal.check().check({}, h, {})
+    assert res["valid?"] is False
+
+
+def test_causal_wrong_write_value():
+    h = [causal_op("write", 7, position=1, link="init")]
+    res = causal.check().check({}, h, {})
+    assert res["valid?"] is False
+    assert "expected value 1" in res["error"]
+
+
+def test_causal_nil_read_ok():
+    h = [causal_op("read", None, position=1, link="init")]
+    assert causal.check().check({}, h, {})["valid?"] is True
+
+
+# --------------------------------------------------------------------------
+# causal reverse
+# --------------------------------------------------------------------------
+
+def test_causal_reverse_detects_missing_predecessor():
+    h = [
+        invoke(0, "write", 1), ok(0, "write", 1),
+        # write 2 invoked after 1 acked: 1 must precede 2
+        invoke(1, "write", 2), ok(1, "write", 2),
+        # read sees 2 without 1 — anomaly
+        invoke(2, "read", None), ok(2, "read", [2]),
+    ]
+    res = causal_reverse.checker().check({}, h, {})
+    assert res["valid?"] is False
+    assert res["errors"][0]["missing"] == [1]
+
+
+def test_causal_reverse_concurrent_writes_ok():
+    h = [
+        # both writes in flight together: no precedence either way
+        invoke(0, "write", 1),
+        invoke(1, "write", 2),
+        ok(0, "write", 1), ok(1, "write", 2),
+        invoke(2, "read", None), ok(2, "read", [2]),
+    ]
+    res = causal_reverse.checker().check({}, h, {})
+    assert res["valid?"] is True
+
+
+def test_causal_reverse_full_visibility_ok():
+    h = [
+        invoke(0, "write", 1), ok(0, "write", 1),
+        invoke(1, "write", 2), ok(1, "write", 2),
+        invoke(2, "read", None), ok(2, "read", [1, 2]),
+    ]
+    assert causal_reverse.checker().check({}, h, {})["valid?"] is True
+
+
+def test_causal_reverse_workload_package():
+    wl = causal_reverse.workload(["n1", "n2", "n3"])
+    assert "checker" in wl and "generator" in wl
+
+
+# --------------------------------------------------------------------------
+# adya g2
+# --------------------------------------------------------------------------
+
+def test_adya_g2_one_insert_per_key_ok():
+    h = [
+        ok(0, "insert", independent.tuple_(1, [None, 10])),
+        {"type": "fail", "process": 1, "f": "insert",
+         "value": independent.tuple_(1, [11, None])},
+        ok(2, "insert", independent.tuple_(2, [12, None])),
+    ]
+    res = adya.g2_checker().check({}, h, {})
+    assert res["valid?"] is True
+    assert res["key-count"] == 2
+    assert res["legal-count"] == 2
+
+
+def test_adya_g2_double_insert_illegal():
+    h = [
+        ok(0, "insert", independent.tuple_(1, [None, 10])),
+        ok(1, "insert", independent.tuple_(1, [11, None])),
+    ]
+    res = adya.g2_checker().check({}, h, {})
+    assert res["valid?"] is False
+    assert res["illegal"] == {1: 2}
+
+
+def test_adya_gen_emits_pairs():
+    g = adya.g2_gen()
+    ctx = gen.Context.for_test({"concurrency": 4})
+    vals = []
+    for _ in range(8):
+        res = gen.op(g, {}, ctx)
+        if res is None:
+            break
+        o, g = res
+        if o is gen.PENDING:
+            break
+        assert o["f"] == "insert"
+        vals.append(o["value"])
+        ctx = ctx.busy(ctx.process_to_thread(o["process"]))
+    assert len(vals) >= 2
+    # each value is a lifted [key, [a,b]] with exactly one side set
+    for v in vals:
+        assert independent.is_tuple(v)
+        a, b = v.value
+        assert (a is None) != (b is None)
+    ids = [a or b for a, b in (v.value for v in vals)]
+    assert len(set(ids)) == len(ids)
+
+
+# --------------------------------------------------------------------------
+# generic cycle checker
+# --------------------------------------------------------------------------
+
+def test_cycle_checker_finds_cycle():
+    h = [ok(0, "txn", None), ok(1, "txn", None), ok(2, "txn", None)]
+
+    def analyzer(history):
+        return [(0, 1, "ww"), (1, 0, "ww")], lambda comp: "0<->1"
+
+    res = cycle.checker(analyzer).check({}, h, {})
+    assert res["valid?"] is False
+    assert res["scc-count"] == 1
+    assert res["cycles"][0]["explanation"] == "0<->1"
+    assert [o["index"] for o in res["cycles"][0]["ops"]] == [0, 1]
+
+
+def test_cycle_checker_acyclic():
+    h = [ok(0, "txn", None), ok(1, "txn", None)]
+    res = cycle.checker(lambda hist: [(0, 1, "ww")]).check({}, h, {})
+    assert res["valid?"] is True
+
+
+# --------------------------------------------------------------------------
+# long-fork end-to-end through the runner (atomic store => no forks)
+# --------------------------------------------------------------------------
+
+def test_long_fork_full_run(tmp_path):
+    import threading
+
+    from jepsen_tpu import client as jclient, core, db as jdb, net as jnet
+    from jepsen_tpu.store import Store
+
+    kv: dict = {}
+    lock = threading.Lock()
+
+    class KVClient(jclient.Client):
+        def open(self, test, node):
+            return self
+
+        def invoke(self, test, op):
+            with lock:
+                if op["f"] == "write":
+                    for _, k, v in op["value"]:
+                        kv[k] = v
+                    return {**op, "type": "ok"}
+                out = [["r", k, kv.get(k)] for _, k, _ in op["value"]]
+                return {**op, "type": "ok", "value": out}
+
+    wl = long_fork.workload(2)
+    test = {
+        "name": "long-fork-itest",
+        "nodes": ["n1", "n2", "n3"],
+        "concurrency": 4,
+        "ssh": {"dummy": True},
+        "net": jnet.noop(),
+        "db": jdb.noop(),
+        "client": KVClient(),
+        "store": Store(tmp_path / "store"),
+        "generator": gen.clients(gen.limit(200, wl["generator"])),
+        "checker": wl["checker"],
+    }
+    test = core.run(test)
+    res = test["results"]
+    assert res["valid?"] is True
+    assert res["reads-count"] > 0
