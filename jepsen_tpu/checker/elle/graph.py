@@ -103,7 +103,25 @@ def adjacency(n: int, edges: Iterable[tuple[int, int, int]],
     return adj
 
 
+# Above this node count, SCC dispatches to the C++ kernel when built
+# (native/graph_algo.cc via native_lib); below it, ctypes/CSR setup costs
+# more than the pure-Python walk.
+NATIVE_SCC_THRESHOLD = 256
+
+
 def tarjan_scc(n: int, adj: list[list[int]]) -> list[int]:
+    """SCC id per node (ids arbitrary). Large graphs go to the native
+    kernel; the pure-Python fallback handles the rest (and everything,
+    when no compiler is around)."""
+    if n >= NATIVE_SCC_THRESHOLD:
+        from ... import native_lib
+        out = native_lib.tarjan_scc(n, adj)
+        if out is not None:
+            return out
+    return _tarjan_scc_py(n, adj)
+
+
+def _tarjan_scc_py(n: int, adj: list[list[int]]) -> list[int]:
     """Iterative Tarjan: returns scc id per node (ids arbitrary)."""
     index = [-1] * n
     low = [0] * n
@@ -212,9 +230,23 @@ def classify_cycles(n: int, edges: list[tuple[int, int, int]],
 
     # G-single / G2-item: per rw edge, can we get back without / only-with
     # further rw edges? One wwr BFS per edge; full-graph BFS only on miss.
-    for s, d, ty in edges:
-        if ty != RW:
-            continue
+    rw_edges = [(s, d) for s, d, ty in edges if ty == RW]
+    if not want_witnesses and len(rw_edges) >= 64:
+        # Batch the probes through the native BFS kernel when we only
+        # need flags, not witness paths.
+        from ... import native_lib
+        back = native_lib.reach(n, wwr_adj, [(d, s) for s, d in rw_edges])
+        if back is not None:
+            if any(back):
+                out["G-single"] = True
+            misses = [(d, s) for (s, d), hit in zip(rw_edges, back)
+                      if not hit]
+            if misses:
+                full_back = native_lib.reach(n, full_adj, misses) or ()
+                if any(full_back):
+                    out["G2-item"] = True
+            return out
+    for s, d in rw_edges:
         path = _bfs_path(wwr_adj, d, s)
         if path is not None:
             if "G-single" not in out:

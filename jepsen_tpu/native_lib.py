@@ -1,0 +1,133 @@
+"""ctypes loader for the native graph kernels (native/graph_algo.cc).
+
+The C++ library plays the role the JVM's Tarjan-over-bifurcan plays in
+the reference's Elle (SURVEY.md §2.3-2.4): a sequential host fallback for
+pathological dependency graphs that resist the vectorized/TPU closure
+formulation. Compiled on first use with g++ (cached under native/build/);
+everything degrades cleanly to the pure-Python implementations when no
+toolchain is present.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
+_SO = _NATIVE_DIR / "build" / "libjepsen_graph.so"
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    src = _NATIVE_DIR / "graph_algo.cc"
+    if not src.exists():
+        return False
+    try:
+        _SO.parent.mkdir(parents=True, exist_ok=True)
+        subprocess.run(
+            ["g++", "-O2", "-fPIC", "-std=c++17", "-shared",
+             "-o", str(_SO), str(src)],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError) as e:
+        log.debug("native graph lib build failed: %s", e)
+        return False
+
+
+def lib() -> ctypes.CDLL | None:
+    """The loaded library, building it on first call; None when
+    unavailable (no source tree / no compiler)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("JEPSEN_TPU_NO_NATIVE"):
+            return None
+        if not _SO.exists() and not _build():
+            return None
+        try:
+            L = ctypes.CDLL(str(_SO))
+        except OSError as e:
+            log.debug("native graph lib load failed: %s", e)
+            return None
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        L.jt_tarjan_scc.restype = ctypes.c_int64
+        L.jt_tarjan_scc.argtypes = [ctypes.c_int64, i64p, i64p, i64p]
+        L.jt_reach.restype = None
+        L.jt_reach.argtypes = [ctypes.c_int64, i64p, i64p,
+                               ctypes.c_int64, i64p, i64p, u8p]
+        _lib = L
+        return _lib
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+def _csr(n: int, adj: list[list[int]]) -> tuple[np.ndarray, np.ndarray] | None:
+    """CSR arrays, or None if any column index is out of [0, n) — the
+    C++ kernel does no bounds checks, so invalid graphs must take the
+    Python path (which raises a clean IndexError instead of corrupting
+    memory)."""
+    counts = np.fromiter((len(a) for a in adj), np.int64, count=n)
+    row_ptr = np.zeros(n + 1, np.int64)
+    np.cumsum(counts, out=row_ptr[1:])
+    col = np.fromiter((w for a in adj for w in a), np.int64,
+                      count=int(row_ptr[-1]))
+    if col.size and (col.min() < 0 or col.max() >= n):
+        return None
+    return row_ptr, col
+
+
+def _p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def tarjan_scc(n: int, adj: list[list[int]]) -> list[int] | None:
+    """SCC ids per node via the C++ kernel, or None if unavailable."""
+    L = lib()
+    if L is None or n == 0:
+        return None if L is None else []
+    csr = _csr(n, adj)
+    if csr is None:
+        return None
+    row_ptr, col = csr
+    out = np.empty(n, np.int64)
+    L.jt_tarjan_scc(n, _p(row_ptr), _p(col), _p(out))
+    return out.tolist()
+
+
+def reach(n: int, adj: list[list[int]],
+          queries: list[tuple[int, int]]) -> list[bool] | None:
+    """Batch src->dst reachability via the C++ kernel, or None."""
+    L = lib()
+    if L is None:
+        return None
+    if not queries:
+        return []
+    csr = _csr(n, adj)
+    if csr is None:
+        return None
+    row_ptr, col = csr
+    src = np.asarray([q[0] for q in queries], np.int64)
+    dst = np.asarray([q[1] for q in queries], np.int64)
+    out = np.zeros(len(queries), np.uint8)
+    L.jt_reach(n, _p(row_ptr), _p(col), len(queries),
+               _p(src), _p(dst),
+               out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    return [bool(x) for x in out]

@@ -1,0 +1,112 @@
+// Native graph kernels for the Elle dependency-graph analysis.
+//
+// The TPU kernels handle the batched/bounded closure work; these C++
+// routines are the host-side fallback for pathological graphs where a
+// sequential algorithm beats any vectorized formulation (the role the
+// JVM's Tarjan-over-bifurcan plays in the reference's elle; see
+// SURVEY.md §2.4 "TPU-build mapping").
+//
+// Interface is C ABI over CSR arrays so Python can drive it with ctypes
+// and numpy without any binding generator.
+//
+// Build: make -C native  (produces libjepsen_graph.so)
+
+#include <cstddef>
+#include <cstdint>
+#include <vector>
+
+extern "C" {
+
+// Strongly connected components (iterative Tarjan).
+//   n        node count
+//   row_ptr  CSR row offsets, length n+1
+//   col      CSR column indices, length row_ptr[n]
+//   scc_out  out: component id per node (ids arbitrary), length n
+// Returns the number of components.
+int64_t jt_tarjan_scc(int64_t n, const int64_t* row_ptr,
+                      const int64_t* col, int64_t* scc_out) {
+  std::vector<int64_t> index(n, -1), low(n, 0);
+  std::vector<char> on_stack(n, 0);
+  std::vector<int64_t> stack;
+  // Explicit DFS frames: (node, next-edge-offset).
+  std::vector<std::pair<int64_t, int64_t>> work;
+  int64_t counter = 0, scc_count = 0;
+  for (int64_t i = 0; i < n; ++i) scc_out[i] = -1;
+
+  for (int64_t root = 0; root < n; ++root) {
+    if (index[root] != -1) continue;
+    work.clear();
+    work.emplace_back(root, row_ptr[root]);
+    while (!work.empty()) {
+      auto& frame = work.back();
+      int64_t v = frame.first;
+      if (frame.second == row_ptr[v] && index[v] == -1) {
+        index[v] = low[v] = counter++;
+        stack.push_back(v);
+        on_stack[v] = 1;
+      }
+      bool descended = false;
+      while (frame.second < row_ptr[v + 1]) {
+        int64_t w = col[frame.second++];
+        if (index[w] == -1) {
+          work.emplace_back(w, row_ptr[w]);
+          descended = true;
+          break;
+        } else if (on_stack[w] && index[w] < low[v]) {
+          low[v] = index[w];
+        }
+      }
+      if (descended) continue;
+      // v is finished.
+      if (low[v] == index[v]) {
+        int64_t w;
+        do {
+          w = stack.back();
+          stack.pop_back();
+          on_stack[w] = 0;
+          scc_out[w] = scc_count;
+        } while (w != v);
+        ++scc_count;
+      }
+      work.pop_back();
+      if (!work.empty()) {
+        int64_t parent = work.back().first;
+        if (low[v] < low[parent]) low[parent] = low[v];
+      }
+    }
+  }
+  return scc_count;
+}
+
+// Batch reachability: for each query q, BFS from src[q] looking for
+// dst[q]; out[q] = 1 if reachable. Used for the per-rw-edge
+// "can we get back" probes of the G-single/G2 classification.
+void jt_reach(int64_t n, const int64_t* row_ptr, const int64_t* col,
+              int64_t n_queries, const int64_t* src, const int64_t* dst,
+              uint8_t* out) {
+  std::vector<int64_t> visited(n, -1);  // stamp = query id
+  std::vector<int64_t> queue;
+  queue.reserve(n);
+  for (int64_t q = 0; q < n_queries; ++q) {
+    int64_t s = src[q], t = dst[q];
+    out[q] = 0;
+    if (s < 0 || s >= n || t < 0 || t >= n) continue;
+    if (s == t) { out[q] = 1; continue; }
+    queue.clear();
+    queue.push_back(s);
+    visited[s] = q;
+    for (std::size_t head = 0; head < queue.size() && !out[q]; ++head) {
+      int64_t v = queue[head];
+      for (int64_t e = row_ptr[v]; e < row_ptr[v + 1]; ++e) {
+        int64_t w = col[e];
+        if (w == t) { out[q] = 1; break; }
+        if (visited[w] != q) {
+          visited[w] = q;
+          queue.push_back(w);
+        }
+      }
+    }
+  }
+}
+
+}  // extern "C"
