@@ -57,8 +57,12 @@ def lib() -> ctypes.CDLL | None:
         _tried = True
         if os.environ.get("JEPSEN_TPU_NO_NATIVE"):
             return None
-        if not _SO.exists() and not _build():
-            return None
+        src = _NATIVE_DIR / "graph_algo.cc"
+        stale = (_SO.exists() and src.exists()
+                 and src.stat().st_mtime > _SO.stat().st_mtime)
+        if (not _SO.exists() or stale) and not _build():
+            if not _SO.exists():
+                return None  # a stale lib still loads; no lib doesn't
         try:
             L = ctypes.CDLL(str(_SO))
         except OSError as e:
