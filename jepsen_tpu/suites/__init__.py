@@ -1,0 +1,112 @@
+"""Per-database test suites.
+
+Counterpart of the reference's per-DB subprojects (SURVEY.md §2.6): each
+suite module exposes
+
+    workloads        {name: fn(opts) -> {"generator", "checker", ...}}
+    <db>_test(opts)  a full test map for one workload
+    main()           CLI entry (test / analyze / serve subcommands)
+
+following the etcd template (etcd/src/jepsen/etcd.clj:154-191). Suites
+with workload matrices (tidb/core.clj:32-100, yugabyte/core.clj:74-110,
+cockroachdb, dgraph) build their maps from the shared workload library;
+`all_tests` expands the sweep the way the reference's test-all does.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from .. import generator as gen
+from ..workloads import (adya, append, bank, causal_reverse, long_fork,
+                         monotonic, register, set_workload, wr)
+
+
+def base_opts(**kw) -> dict:
+    """Default CLI-ish options (cli.clj:18,78-99)."""
+    opts = {
+        "nodes": ["n1", "n2", "n3", "n4", "n5"],
+        "concurrency": 5,
+        "time-limit": 60,
+        "ssh": {},
+    }
+    opts.update(kw)
+    return opts
+
+
+def standard_workloads(opts: dict | None = None) -> dict[str, Callable]:
+    """The workload registry shared by the matrix suites. Each entry
+    returns a {"generator", "checker"} package."""
+    opts = opts or {}
+    nodes = opts.get("nodes") or ["n1", "n2", "n3", "n4", "n5"]
+    return {
+        "register": lambda: _register_pkg(),
+        "bank": lambda: _pkg(bank.test()),
+        "set": lambda: _pkg(set_workload.test(n=opts.get("set-size", 100))),
+        "append": lambda: _pkg(append.test()),
+        "wr": lambda: _pkg(wr.test()),
+        "long-fork": lambda: long_fork.workload(
+            opts.get("long-fork-group", 2)),
+        "monotonic": lambda: monotonic.workload(),
+        "sequential": lambda: causal_reverse.workload(nodes),
+        "g2": lambda: adya.workload(),
+    }
+
+
+def _pkg(test_map: dict) -> dict:
+    return {"generator": test_map.get("generator"),
+            "checker": test_map.get("checker")}
+
+
+def _register_pkg() -> dict:
+    t = register.test()
+    return {"generator": t.get("generator"), "checker": t.get("checker")}
+
+
+def nemesis_cycle(interval: float = 10) -> Any:
+    """The standard start/stop nemesis schedule
+    (etcd.clj:174-178, combined.clj:26-28)."""
+    return gen.repeat_gen([gen.sleep(interval),
+                           {"type": "info", "f": "start"},
+                           gen.sleep(interval),
+                           {"type": "info", "f": "stop"}])
+
+
+def suite_test(name: str, workload_name: str, opts: dict,
+               workloads: dict[str, Callable],
+               db=None, client=None, nemesis=None,
+               os_setup=None) -> dict:
+    """Assemble a full test map from a workload registry entry, the way
+    each suite's <db>-test does (etcd.clj:154-180)."""
+    if workload_name not in workloads:
+        raise ValueError(
+            f"unknown workload {workload_name!r}; "
+            f"have {sorted(workloads)}")
+    wl = workloads[workload_name]()
+    g = wl["generator"]
+    test = {
+        "name": f"{name} {workload_name}",
+        "nodes": opts.get("nodes"),
+        "concurrency": opts.get("concurrency", 5),
+        "ssh": opts.get("ssh", {}),
+        "generator": gen.time_limit(
+            opts.get("time-limit", 60),
+            gen.clients(g, nemesis_cycle(opts.get("nemesis-interval", 10)))),
+        "checker": wl["checker"],
+        "workload": workload_name,
+    }
+    # Omit unset roles so core.run's defaults (noop db/os/...) apply.
+    for key, val in (("db", db), ("client", client),
+                     ("nemesis", nemesis), ("os", os_setup)):
+        if val is not None:
+            test[key] = val
+    test.update(opts.get("extra", {}))
+    return test
+
+
+def all_tests(name: str, opts: dict, workloads: dict[str, Callable],
+              **kw) -> list[dict]:
+    """One test map per workload — the suite sweep (tidb/core.clj:32-100,
+    cli.clj test-all)."""
+    return [suite_test(name, w, opts, workloads, **kw)
+            for w in sorted(workloads)]

@@ -1,0 +1,103 @@
+"""CockroachDB suite — config #3 of the north star.
+
+Counterpart of cockroachdb/src/jepsen/cockroach.clj and its workloads
+(register, bank, monotonic, sequential, sets, comments/g2; SURVEY.md
+§2.6): a single-binary tarball install with a multi-node --join cluster,
+and a workload matrix built from the shared library. SQL access is
+driver-pluggable: pass ``connect_fn`` (a psycopg2-compatible connect)
+into the client; the workload/checker layer is complete without it (the
+analyze path for stored histories needs no driver at all).
+"""
+
+from __future__ import annotations
+
+from .. import checker as jchecker
+from .. import cli as jcli
+from .. import control
+from .. import db as jdb
+from .. import nemesis as jnemesis, os_setup
+from ..control import util as cutil
+from . import base_opts, standard_workloads, suite_test
+
+VERSION = "v19.1.5"
+DIR = "/opt/cockroach"
+BINARY = f"{DIR}/cockroach"
+LOGFILE = f"{DIR}/cockroach.log"
+PIDFILE = f"{DIR}/cockroach.pid"
+
+
+class CockroachDB(jdb.DB, jdb.LogFiles):
+    """Tarball install + `cockroach start --join` cluster
+    (cockroachdb/src/jepsen/cockroach.clj's db)."""
+
+    def __init__(self, version: str = VERSION):
+        self.version = version
+
+    def setup(self, test, node):
+        sess = control.current_session().su()
+        url = (f"https://binaries.cockroachdb.com/"
+               f"cockroach-{self.version}.linux-amd64.tgz")
+        cutil.install_archive(sess, url, DIR)
+        join = ",".join(f"{n}:26257" for n in test.get("nodes", []))
+        cutil.start_daemon(
+            sess, BINARY, "start", "--insecure",
+            "--store", f"{DIR}/data",
+            "--listen-addr", f"{node}:26257",
+            "--http-addr", f"{node}:8080",
+            "--join", join,
+            logfile=LOGFILE, pidfile=PIDFILE, chdir=DIR)
+        if node == (test.get("nodes") or [node])[0]:
+            # The daemon launch returns before the server listens; retry
+            # init until it connects. "already been initialized" (from a
+            # prior cycle) also counts as success.
+            import time
+            last = None
+            for _ in range(30):
+                res = sess.exec_ok(BINARY, "init", "--insecure",
+                                   "--host", f"{node}:26257")
+                if res.exit == 0 or "already been initialized" in res.err:
+                    break
+                last = res
+                time.sleep(1)
+            else:
+                raise control.CommandError(
+                    "cockroach init", last.exit if last else -1,
+                    last.out if last else "", last.err if last else "",
+                    node)
+
+    def teardown(self, test, node):
+        sess = control.current_session().su()
+        cutil.stop_daemon(sess, PIDFILE)
+        sess.exec("rm", "-rf", DIR)
+
+    def log_files(self, test, node):
+        return [LOGFILE]
+
+
+def workloads(opts: dict | None = None) -> dict:
+    std = standard_workloads(opts)
+    # cockroach's matrix: register, bank, monotonic, sequential, sets,
+    # comments (a G2 variant) — all from the shared library.
+    return {k: std[k] for k in
+            ("register", "bank", "monotonic", "sequential", "set", "g2")}
+
+
+def cockroach_test(opts: dict | None = None) -> dict:
+    opts = base_opts(**(opts or {}))
+    return suite_test(
+        "cockroach", opts.get("workload", "register"), opts,
+        workloads(opts),
+        db=CockroachDB(opts.get("version", VERSION)),
+        client=opts.get("client"),
+        nemesis=jnemesis.partition_random_halves(),
+        os_setup=os_setup.debian())
+
+
+def main(argv=None) -> int:
+    return jcli.run_cli(
+        lambda tmap, args: cockroach_test(
+            {**tmap, "workload": getattr(args, "workload", "register")}),
+        name="cockroach",
+        opt_fn=lambda p: p.add_argument(
+            "--workload", default="register", choices=sorted(workloads())),
+        argv=argv)

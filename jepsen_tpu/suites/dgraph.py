@@ -1,0 +1,91 @@
+"""Dgraph suite — part of config #5.
+
+Counterpart of dgraph/src/jepsen/dgraph (SURVEY.md §2.6): zero + alpha
+daemons and a matrix of bank, long-fork, linearizable-register,
+sequential, set, and upsert (predicate uniqueness ≈ the adya G2
+workload). Clients speak Dgraph's HTTP API when driven live; the
+workload/checker matrix and analyze path are complete without a live
+cluster.
+"""
+
+from __future__ import annotations
+
+from .. import cli as jcli
+from .. import control
+from .. import db as jdb
+from .. import nemesis as jnemesis, os_setup
+from ..control import util as cutil
+from . import base_opts, standard_workloads, suite_test
+
+VERSION = "v1.0.17"
+DIR = "/opt/dgraph"
+
+
+class DgraphDB(jdb.DB, jdb.LogFiles):
+    """dgraph zero + alpha daemons (dgraph/src/jepsen/dgraph/support.clj)."""
+
+    def __init__(self, version: str = VERSION):
+        self.version = version
+
+    def setup(self, test, node):
+        sess = control.current_session().su()
+        url = (f"https://github.com/dgraph-io/dgraph/releases/download/"
+               f"{self.version}/dgraph-linux-amd64.tar.gz")
+        cutil.install_archive(sess, url, DIR)
+        nodes = test.get("nodes", [])
+        zero = nodes[0] if nodes else node
+        if node == zero:
+            cutil.start_daemon(
+                sess, f"{DIR}/dgraph", "zero",
+                "--my", f"{node}:5080",
+                "--wal", f"{DIR}/zw",
+                logfile=f"{DIR}/zero.log", pidfile=f"{DIR}/zero.pid",
+                chdir=DIR)
+        cutil.start_daemon(
+            sess, f"{DIR}/dgraph", "alpha",
+            "--my", f"{node}:7080",
+            "--zero", f"{zero}:5080",
+            "--postings", f"{DIR}/p", "--wal", f"{DIR}/w",
+            logfile=f"{DIR}/alpha.log", pidfile=f"{DIR}/alpha.pid",
+            chdir=DIR)
+
+    def teardown(self, test, node):
+        sess = control.current_session().su()
+        for pid in ("alpha.pid", "zero.pid"):
+            cutil.stop_daemon(sess, f"{DIR}/{pid}")
+        sess.exec("rm", "-rf", DIR)
+
+    def log_files(self, test, node):
+        return [f"{DIR}/zero.log", f"{DIR}/alpha.log"]
+
+
+def workloads(opts: dict | None = None) -> dict:
+    std = standard_workloads(opts)
+    return {
+        "bank": std["bank"],
+        "long-fork": std["long-fork"],
+        "register": std["register"],      # linearizable-register
+        "sequential": std["sequential"],
+        "set": std["set"],
+        "upsert": std["g2"],              # predicate-uniqueness races
+    }
+
+
+def dgraph_test(opts: dict | None = None) -> dict:
+    opts = base_opts(**(opts or {}))
+    return suite_test(
+        "dgraph", opts.get("workload", "bank"), opts, workloads(opts),
+        db=DgraphDB(opts.get("version", VERSION)),
+        client=opts.get("client"),
+        nemesis=jnemesis.partition_random_halves(),
+        os_setup=os_setup.debian())
+
+
+def main(argv=None) -> int:
+    return jcli.run_cli(
+        lambda tmap, args: dgraph_test(
+            {**tmap, "workload": getattr(args, "workload", "bank")}),
+        name="dgraph",
+        opt_fn=lambda p: p.add_argument(
+            "--workload", default="bank", choices=sorted(workloads())),
+        argv=argv)

@@ -1,0 +1,97 @@
+"""TiDB suite — config #4 of the north star.
+
+Counterpart of tidb/src/tidb (workload registry with option sweeps,
+tidb/core.clj:32-100; SURVEY.md §2.6): the pd / tikv / tidb daemon trio
+installed from the release tarball, and a workload matrix of bank,
+long-fork, append/wr (Elle), register, set, sequential, monotonic.
+SQL access is driver-pluggable as in the cockroach suite.
+"""
+
+from __future__ import annotations
+
+from .. import cli as jcli
+from .. import control
+from .. import db as jdb
+from .. import nemesis as jnemesis, os_setup
+from ..control import util as cutil
+from . import base_opts, standard_workloads, suite_test
+
+VERSION = "v3.0.3"
+DIR = "/opt/tidb"
+LOGDIR = f"{DIR}/logs"
+
+class TiDB(jdb.DB, jdb.LogFiles):
+    """pd + tikv + tidb daemons (tidb/src/tidb/db.clj's install)."""
+
+    def __init__(self, version: str = VERSION):
+        self.version = version
+
+    def _pd_cluster(self, test) -> str:
+        return ",".join(f"{n}=http://{n}:2380"
+                        for n in test.get("nodes", []))
+
+    def setup(self, test, node):
+        sess = control.current_session().su()
+        url = (f"https://download.pingcap.org/"
+               f"tidb-{self.version}-linux-amd64.tar.gz")
+        cutil.install_archive(sess, url, DIR)
+        sess.exec("mkdir", "-p", LOGDIR)
+        cutil.start_daemon(
+            sess, f"{DIR}/bin/pd-server",
+            "--name", node,
+            "--client-urls", f"http://{node}:2379",
+            "--peer-urls", f"http://{node}:2380",
+            "--initial-cluster", self._pd_cluster(test),
+            logfile=f"{LOGDIR}/pd.log", pidfile=f"{DIR}/pd.pid", chdir=DIR)
+        pds = ",".join(f"{n}:2379" for n in test.get("nodes", []))
+        cutil.start_daemon(
+            sess, f"{DIR}/bin/tikv-server",
+            "--pd", pds,
+            "--addr", f"{node}:20160",
+            "--data-dir", f"{DIR}/tikv",
+            logfile=f"{LOGDIR}/tikv.log", pidfile=f"{DIR}/tikv.pid",
+            chdir=DIR)
+        cutil.start_daemon(
+            sess, f"{DIR}/bin/tidb-server",
+            "--store", "tikv",
+            "--path", pds,
+            "--host", node,
+            logfile=f"{LOGDIR}/tidb.log", pidfile=f"{DIR}/tidb.pid",
+            chdir=DIR)
+
+    def teardown(self, test, node):
+        sess = control.current_session().su()
+        for pid in ("tidb.pid", "tikv.pid", "pd.pid"):
+            cutil.stop_daemon(sess, f"{DIR}/{pid}")
+        sess.exec("rm", "-rf", DIR)
+
+    def log_files(self, test, node):
+        return [f"{LOGDIR}/pd.log", f"{LOGDIR}/tikv.log",
+                f"{LOGDIR}/tidb.log"]
+
+
+def workloads(opts: dict | None = None) -> dict:
+    std = standard_workloads(opts)
+    return {k: std[k] for k in
+            ("bank", "long-fork", "append", "wr", "register", "set",
+             "sequential", "monotonic")}
+
+
+def tidb_test(opts: dict | None = None) -> dict:
+    opts = base_opts(**(opts or {}))
+    return suite_test(
+        "tidb", opts.get("workload", "append"), opts, workloads(opts),
+        db=TiDB(opts.get("version", VERSION)),
+        client=opts.get("client"),
+        nemesis=jnemesis.partition_random_halves(),
+        os_setup=os_setup.debian())
+
+
+def main(argv=None) -> int:
+    return jcli.run_cli(
+        lambda tmap, args: tidb_test(
+            {**tmap, "workload": getattr(args, "workload", "append")}),
+        name="tidb",
+        opt_fn=lambda p: p.add_argument(
+            "--workload", default="append", choices=sorted(workloads())),
+        argv=argv)

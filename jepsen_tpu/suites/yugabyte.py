@@ -1,0 +1,103 @@
+"""YugabyteDB suite — config #5's serializability sweep.
+
+Counterpart of yugabyte/src/yugabyte (dual-API workload matrix,
+yugabyte/core.clj:74-110; SURVEY.md §2.6): master + tserver daemons and
+a matrix of counter-ish (monotonic), set, bank, long-fork, append, wr,
+register workloads, optionally swept across both APIs the way the
+reference sweeps YCQL/YSQL (the `api` opt tags the test; client adapters
+are pluggable per API).
+"""
+
+from __future__ import annotations
+
+from .. import cli as jcli
+from .. import control
+from .. import db as jdb
+from .. import nemesis as jnemesis, os_setup
+from ..control import util as cutil
+from . import base_opts, standard_workloads, suite_test
+
+VERSION = "1.3.1.0"
+DIR = "/opt/yugabyte"
+
+APIS = ("ysql", "ycql")
+
+
+class YugaByteDB(jdb.DB, jdb.LogFiles):
+    """yb-master + yb-tserver daemons (yugabyte/src/yugabyte/db.clj)."""
+
+    def __init__(self, version: str = VERSION):
+        self.version = version
+
+    def setup(self, test, node):
+        sess = control.current_session().su()
+        url = (f"https://downloads.yugabyte.com/"
+               f"yugabyte-ce-{self.version}-linux.tar.gz")
+        cutil.install_archive(sess, url, DIR)
+        masters = ",".join(f"{n}:7100" for n in test.get("nodes", [])[:3])
+        if node in test.get("nodes", [])[:3]:
+            cutil.start_daemon(
+                sess, f"{DIR}/bin/yb-master",
+                "--master_addresses", masters,
+                "--rpc_bind_addresses", f"{node}:7100",
+                "--fs_data_dirs", f"{DIR}/data/master",
+                logfile=f"{DIR}/master.log", pidfile=f"{DIR}/master.pid",
+                chdir=DIR)
+        cutil.start_daemon(
+            sess, f"{DIR}/bin/yb-tserver",
+            "--tserver_master_addrs", masters,
+            "--rpc_bind_addresses", f"{node}:9100",
+            "--fs_data_dirs", f"{DIR}/data/tserver",
+            logfile=f"{DIR}/tserver.log", pidfile=f"{DIR}/tserver.pid",
+            chdir=DIR)
+
+    def teardown(self, test, node):
+        sess = control.current_session().su()
+        for pid in ("tserver.pid", "master.pid"):
+            cutil.stop_daemon(sess, f"{DIR}/{pid}")
+        sess.exec("rm", "-rf", DIR)
+
+    def log_files(self, test, node):
+        return [f"{DIR}/master.log", f"{DIR}/tserver.log"]
+
+
+def workloads(opts: dict | None = None) -> dict:
+    std = standard_workloads(opts)
+    return {k: std[k] for k in
+            ("register", "set", "bank", "long-fork", "append", "wr",
+             "monotonic")}
+
+
+def yugabyte_test(opts: dict | None = None) -> dict:
+    opts = base_opts(**(opts or {}))
+    api = opts.get("api", "ysql")
+    test = suite_test(
+        f"yugabyte-{api}", opts.get("workload", "bank"), opts,
+        workloads(opts),
+        db=YugaByteDB(opts.get("version", VERSION)),
+        client=opts.get("client"),
+        nemesis=jnemesis.partition_random_halves(),
+        os_setup=os_setup.debian())
+    test["api"] = api
+    return test
+
+
+def all_tests(opts: dict | None = None) -> list[dict]:
+    """The full api × workload sweep (yugabyte/core.clj:74-110,
+    run-jepsen.py's sweep)."""
+    opts = base_opts(**(opts or {}))
+    return [yugabyte_test({**opts, "api": api, "workload": w})
+            for api in APIS for w in sorted(workloads(opts))]
+
+
+def main(argv=None) -> int:
+    def opt_fn(p):
+        p.add_argument("--workload", default="bank",
+                       choices=sorted(workloads()))
+        p.add_argument("--api", default="ysql", choices=APIS)
+
+    return jcli.run_cli(
+        lambda tmap, args: yugabyte_test(
+            {**tmap, "workload": getattr(args, "workload", "bank"),
+             "api": getattr(args, "api", "ysql")}),
+        name="yugabyte", opt_fn=opt_fn, argv=argv)

@@ -1,0 +1,230 @@
+"""Per-DB suite tests: test-map assembly, DB command routing against the
+dummy remote, the etcd HTTP client against an in-process fake etcd, and
+a full matrix-workload run with an in-process client (SURVEY.md §4.2's
+fake-backend strategy)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import pytest
+
+from jepsen_tpu import control, core, generator as gen, independent
+from jepsen_tpu import client as jclient, net as jnet
+from jepsen_tpu.store import Store
+from jepsen_tpu.suites import (base_opts, cockroach, dgraph, etcd,
+                               standard_workloads, suite_test, tidb,
+                               yugabyte)
+
+
+# --------------------------------------------------------------------------
+# registry / assembly
+# --------------------------------------------------------------------------
+
+def test_standard_workloads_resolve():
+    for name, fn in standard_workloads(base_opts()).items():
+        pkg = fn()
+        assert pkg.get("generator") is not None, name
+        assert pkg.get("checker") is not None, name
+
+
+@pytest.mark.parametrize("mod,default", [
+    (cockroach, "register"), (tidb, "append"),
+    (yugabyte, "bank"), (dgraph, "bank")])
+def test_suite_test_maps(mod, default):
+    t = getattr(mod, f"{mod.__name__.split('.')[-1]}_test")({})
+    assert t["db"] is not None
+    assert t["generator"] is not None
+    assert t["checker"] is not None
+    assert t["workload"] == default
+
+
+def test_unknown_workload_raises():
+    with pytest.raises(ValueError, match="unknown workload"):
+        suite_test("x", "nope", base_opts(), standard_workloads())
+
+
+def test_yugabyte_sweep_covers_apis_and_workloads():
+    tests = yugabyte.all_tests({})
+    names = {(t["api"], t["workload"]) for t in tests}
+    assert len(names) == len(yugabyte.APIS) * len(yugabyte.workloads())
+
+
+# --------------------------------------------------------------------------
+# DB lifecycle against the dummy remote
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dbf,needle", [
+    (lambda: etcd.EtcdDB(), "--initial-cluster"),
+    (lambda: cockroach.CockroachDB(), "--join"),
+    (lambda: tidb.TiDB(), "tikv-server"),
+    (lambda: yugabyte.YugaByteDB(), "yb-tserver"),
+    (lambda: dgraph.DgraphDB(), "alpha"),
+])
+def test_db_setup_commands(dbf, needle):
+    test = {"nodes": ["n1", "n2", "n3"], "ssh": {"dummy": True}}
+    remote = control.remote_for(test)
+    db = dbf()
+    control.on_nodes(test, db.setup)
+    cmds = " || ".join(str(p) for _, k, p in remote.actions
+                       if k == "execute")
+    assert needle in cmds
+    remote.actions.clear()
+    control.on_nodes(test, db.teardown)
+    assert any("rm -rf" in str(p) for _, k, p in remote.actions
+               if k == "execute")
+    assert db.log_files(test, "n1")
+
+
+# --------------------------------------------------------------------------
+# etcd client against a fake in-process etcd (v2 HTTP API)
+# --------------------------------------------------------------------------
+
+class FakeEtcd(BaseHTTPRequestHandler):
+    store = {}
+    lock = threading.Lock()
+
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, code, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        key = urlparse(self.path).path.rsplit("/", 1)[-1]
+        with self.lock:
+            if key not in self.store:
+                return self._reply(404, {"errorCode": 100})
+            return self._reply(200, {"node": {"value": str(self.store[key])}})
+
+    def do_PUT(self):
+        u = urlparse(self.path)
+        key = u.path.rsplit("/", 1)[-1]
+        q = parse_qs(u.query)
+        n = int(self.headers.get("Content-Length", 0))
+        form = parse_qs(self.rfile.read(n).decode())
+        value = form.get("value", [None])[0]
+        with self.lock:
+            if "prevValue" in q:
+                cur = self.store.get(key)
+                if cur is None:
+                    return self._reply(404, {"errorCode": 100})
+                if str(cur) != q["prevValue"][0]:
+                    return self._reply(412, {"errorCode": 101})
+            self.store[key] = value
+            return self._reply(200, {"node": {"value": value}})
+
+
+@pytest.fixture()
+def fake_etcd():
+    FakeEtcd.store = {}
+    srv = HTTPServer(("127.0.0.1", 0), FakeEtcd)
+    th = threading.Thread(target=srv.serve_forever, daemon=True)
+    th.start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+def test_etcd_client_read_write_cas(fake_etcd, monkeypatch):
+    monkeypatch.setattr(etcd, "client_url",
+                        lambda node: f"http://127.0.0.1:{fake_etcd}")
+    c = etcd.EtcdClient().open({}, "n1")
+    kv = independent.tuple_
+    # read of missing key -> fail not-found
+    out = c.invoke({}, {"type": "invoke", "f": "read", "value": kv(1, None)})
+    assert out["type"] == "fail" and out["error"] == "not-found"
+    # write then read
+    assert c.invoke({}, {"type": "invoke", "f": "write",
+                         "value": kv(1, 3)})["type"] == "ok"
+    out = c.invoke({}, {"type": "invoke", "f": "read", "value": kv(1, None)})
+    assert out["type"] == "ok" and out["value"].value == 3
+    # cas success and failure
+    assert c.invoke({}, {"type": "invoke", "f": "cas",
+                         "value": kv(1, [3, 4])})["type"] == "ok"
+    assert c.invoke({}, {"type": "invoke", "f": "cas",
+                         "value": kv(1, [3, 5])})["type"] == "fail"
+    # connection refused -> info for writes, fail for reads
+    monkeypatch.setattr(etcd, "client_url",
+                        lambda node: "http://127.0.0.1:1")
+    c2 = etcd.EtcdClient(timeout=0.2).open({}, "n1")
+    assert c2.invoke({}, {"type": "invoke", "f": "write",
+                          "value": kv(1, 1)})["type"] == "info"
+    assert c2.invoke({}, {"type": "invoke", "f": "read",
+                          "value": kv(1, None)})["type"] == "fail"
+
+
+def test_etcd_test_map():
+    t = etcd.etcd_test({"time-limit": 5})
+    assert t["name"] == "etcd"
+    assert t["db"] is not None and t["client"] is not None
+    assert t["generator"] is not None
+
+
+# --------------------------------------------------------------------------
+# full matrix run with an in-process client (monotonic workload)
+# --------------------------------------------------------------------------
+
+def test_monotonic_workload_full_run(tmp_path):
+    counter = {"v": 0}
+    lock = threading.Lock()
+
+    class CounterClient(jclient.Client):
+        def open(self, test, node):
+            return self
+
+        def invoke(self, test, op):
+            with lock:
+                if op["f"] == "inc":
+                    counter["v"] += 1
+                    return {**op, "type": "ok", "value": counter["v"]}
+                return {**op, "type": "ok", "value": counter["v"]}
+
+    t = suite_test("itest", "monotonic",
+                   base_opts(nodes=["n1"], concurrency=4,
+                             **{"time-limit": 2}),
+                   standard_workloads(),
+                   db=None, client=CounterClient())
+    t.update({"ssh": {"dummy": True}, "net": jnet.noop(),
+              "store": Store(tmp_path / "store"),
+              "generator": gen.clients(gen.limit(
+                  300, standard_workloads()["monotonic"]()["generator"]))})
+    from jepsen_tpu import db as jdb
+    t["db"] = jdb.noop()
+    t = core.run(t)
+    assert t["results"]["valid?"] is True
+    assert t["results"]["error-count"] == 0
+
+
+def test_monotonic_checker_catches_regression():
+    from jepsen_tpu.workloads import monotonic
+    h = [
+        {"type": "invoke", "process": 0, "f": "inc", "value": None},
+        {"type": "ok", "process": 0, "f": "inc", "value": 5},
+        {"type": "invoke", "process": 1, "f": "read", "value": None},
+        {"type": "ok", "process": 1, "f": "read", "value": 3},  # regression
+    ]
+    res = monotonic.checker().check({}, h, {})
+    assert res["valid?"] is False
+    assert res["errors"][0]["expected-min"] == 5
+
+
+def test_monotonic_checker_catches_lost_increment():
+    from jepsen_tpu.workloads import monotonic
+    h = [
+        {"type": "invoke", "process": 0, "f": "inc", "value": None},
+        {"type": "ok", "process": 0, "f": "inc", "value": 5},
+        {"type": "invoke", "process": 1, "f": "inc", "value": None},
+        {"type": "ok", "process": 1, "f": "inc", "value": 5},  # lost update
+    ]
+    res = monotonic.checker().check({}, h, {})
+    assert res["valid?"] is False
+    # a read equal to the floor is fine
+    h[2] = {"type": "invoke", "process": 1, "f": "read", "value": None}
+    h[3] = {"type": "ok", "process": 1, "f": "read", "value": 5}
+    assert monotonic.checker().check({}, h, {})["valid?"] is True
