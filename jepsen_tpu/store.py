@@ -183,11 +183,29 @@ class Store:
         raise FileNotFoundError(f"no history in {d}")
 
     def load_test(self, run_dir: str | os.PathLike) -> dict:
-        d = Path(run_dir)
+        """Load a run dir — ours (test.json) or the reference's
+        (test.fressian, store.clj:372-383)."""
+        # Resolve symlinks (latest/current) so the dir name below is the
+        # real timestamp, not "latest" — re-linking against the link name
+        # would create a self-loop.
+        d = Path(run_dir).resolve()
         test: dict = {}
         tj = d / "test.json"
+        tf = d / "test.fressian"
         if tj.exists():
             test = json.loads(tj.read_text())
+        elif tf.exists():
+            from . import fressian
+            raw = fressian.load_test(tf)
+            if isinstance(raw, dict):
+                # Map keys are edn.Keyword, which subclasses str and
+                # equals its bare name — str() normalizes them.
+                test = {str(k): v for k, v in raw.items()}
+        # The run dir is authoritative for name/start-time so re-analysis
+        # writes back into the SAME dir (cli.clj analyze, :381-411),
+        # whatever form the serialized test map stored them in.
+        test["start-time"] = d.name
+        test.setdefault("name", d.parent.name)
         test["history"] = self.load_history(d)
         rj = d / "results.json"
         if rj.exists():

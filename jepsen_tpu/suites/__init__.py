@@ -40,7 +40,7 @@ def standard_workloads(opts: dict | None = None) -> dict[str, Callable]:
     opts = opts or {}
     nodes = opts.get("nodes") or ["n1", "n2", "n3", "n4", "n5"]
     return {
-        "register": lambda: _register_pkg(),
+        "register": lambda: _pkg(register.test()),
         "bank": lambda: _pkg(bank.test()),
         "set": lambda: _pkg(set_workload.test(n=opts.get("set-size", 100))),
         "append": lambda: _pkg(append.test()),
@@ -58,9 +58,12 @@ def _pkg(test_map: dict) -> dict:
             "checker": test_map.get("checker")}
 
 
-def _register_pkg() -> dict:
-    t = register.test()
-    return {"generator": t.get("generator"), "checker": t.get("checker")}
+def resolve_workload(args, tmap: dict, default: str) -> str:
+    """--workload wins when given explicitly; a stored run's workload
+    wins over the suite default so `analyze` re-checks with the right
+    model (cli.clj:381-411)."""
+    return (getattr(args, "workload", None) or tmap.get("workload")
+            or default)
 
 
 def nemesis_cycle(interval: float = 10) -> Any:
@@ -101,6 +104,13 @@ def suite_test(name: str, workload_name: str, opts: dict,
         if val is not None:
             test[key] = val
     test.update(opts.get("extra", {}))
+    # Carry every other opt through (store, start-time, ssh details...)
+    # so `analyze` on a stored run writes back into the SAME run dir.
+    for k, v in opts.items():
+        if k != "extra":
+            test.setdefault(k, v)
+    if "start-time" in opts and opts.get("name"):
+        test["name"] = opts["name"]
     return test
 
 

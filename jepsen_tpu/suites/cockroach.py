@@ -94,10 +94,12 @@ def cockroach_test(opts: dict | None = None) -> dict:
 
 
 def main(argv=None) -> int:
+    from . import resolve_workload
     return jcli.run_cli(
         lambda tmap, args: cockroach_test(
-            {**tmap, "workload": getattr(args, "workload", "register")}),
+            {**tmap,
+             "workload": resolve_workload(args, tmap, "register")}),
         name="cockroach",
         opt_fn=lambda p: p.add_argument(
-            "--workload", default="register", choices=sorted(workloads())),
+            "--workload", default=None, choices=sorted(workloads())),
         argv=argv)

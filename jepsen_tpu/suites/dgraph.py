@@ -82,10 +82,11 @@ def dgraph_test(opts: dict | None = None) -> dict:
 
 
 def main(argv=None) -> int:
+    from . import resolve_workload
     return jcli.run_cli(
         lambda tmap, args: dgraph_test(
-            {**tmap, "workload": getattr(args, "workload", "bank")}),
+            {**tmap, "workload": resolve_workload(args, tmap, "bank")}),
         name="dgraph",
         opt_fn=lambda p: p.add_argument(
-            "--workload", default="bank", choices=sorted(workloads())),
+            "--workload", default=None, choices=sorted(workloads())),
         argv=argv)

@@ -89,12 +89,18 @@ class EtcdClient(jclient.Client):
     """CAS register over etcd's v2 HTTP API (client, etcd.clj:93-143).
     Ops take independent-lifted values [k, v]."""
 
-    def __init__(self, node: str | None = None, timeout: float = 5.0):
+    def __init__(self, node: str | None = None, timeout: float = 5.0,
+                 quorum: bool = False):
+        # quorum=False matches the reference client (etcd.clj:108) — the
+        # non-quorum reads are exactly what lets the linearizability
+        # checker expose etcd's stale reads. Pass quorum=True for a
+        # configuration the checker should find valid.
         self.node = node
         self.timeout = timeout
+        self.quorum = quorum
 
     def open(self, test, node):
-        return EtcdClient(node, self.timeout)
+        return EtcdClient(node, self.timeout, self.quorum)
 
     def _url(self, k) -> str:
         return f"{client_url(self.node)}/v2/keys/r{k}"
@@ -115,7 +121,8 @@ class EtcdClient(jclient.Client):
         crash = "fail" if op["f"] == "read" else "info"
         try:
             if op["f"] == "read":
-                out = self._request(self._url(k) + "?quorum=false")
+                q = "true" if self.quorum else "false"
+                out = self._request(self._url(k) + f"?quorum={q}")
                 read = out.get("node", {}).get("value")
                 read = int(read) if read is not None else None
                 return {**op, "type": "ok",
@@ -166,7 +173,7 @@ def etcd_test(opts: dict | None = None) -> dict:
         "name": "etcd",
         "os": os_setup.debian(),
         "db": EtcdDB(opts.get("version", VERSION)),
-        "client": EtcdClient(),
+        "client": EtcdClient(quorum=bool(opts.get("quorum", False))),
         "nemesis": jnemesis.partition_random_halves(),
         "checker": jchecker.compose({
             "perf": jchecker.perf_checker(),

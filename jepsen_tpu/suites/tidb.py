@@ -88,10 +88,11 @@ def tidb_test(opts: dict | None = None) -> dict:
 
 
 def main(argv=None) -> int:
+    from . import resolve_workload
     return jcli.run_cli(
         lambda tmap, args: tidb_test(
-            {**tmap, "workload": getattr(args, "workload", "append")}),
+            {**tmap, "workload": resolve_workload(args, tmap, "append")}),
         name="tidb",
         opt_fn=lambda p: p.add_argument(
-            "--workload", default="append", choices=sorted(workloads())),
+            "--workload", default=None, choices=sorted(workloads())),
         argv=argv)

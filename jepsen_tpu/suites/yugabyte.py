@@ -91,13 +91,16 @@ def all_tests(opts: dict | None = None) -> list[dict]:
 
 
 def main(argv=None) -> int:
+    from . import resolve_workload
+
     def opt_fn(p):
-        p.add_argument("--workload", default="bank",
+        p.add_argument("--workload", default=None,
                        choices=sorted(workloads()))
-        p.add_argument("--api", default="ysql", choices=APIS)
+        p.add_argument("--api", default=None, choices=APIS)
 
     return jcli.run_cli(
         lambda tmap, args: yugabyte_test(
-            {**tmap, "workload": getattr(args, "workload", "bank"),
-             "api": getattr(args, "api", "ysql")}),
+            {**tmap, "workload": resolve_workload(args, tmap, "bank"),
+             "api": (getattr(args, "api", None) or tmap.get("api")
+                     or "ysql")}),
         name="yugabyte", opt_fn=opt_fn, argv=argv)

@@ -1,0 +1,113 @@
+"""Fressian codec tests: roundtrips over the store subset, packed-int
+zone boundaries, wire-level spot checks against the published code
+table, cache behavior, and store.load_test over a test.fressian."""
+
+import datetime
+
+import pytest
+
+from jepsen_tpu import fressian as f
+from jepsen_tpu.edn import Keyword, Symbol
+from jepsen_tpu.store import Store
+
+
+def rt(v):
+    return f.loads(f.dumps(v))
+
+
+@pytest.mark.parametrize("v", [
+    None, True, False, 0, 1, 63, -1, 100, -100, 4095, -4096, 4096,
+    2 ** 20, -(2 ** 20), 2 ** 30, -(2 ** 30), 2 ** 45, -(2 ** 45),
+    2 ** 62, -(2 ** 62),
+    0.0, 1.0, 3.5, -2.25,
+    "", "hi", "x" * 200, "snowman ☃",
+    b"", b"abc", b"y" * 40,
+    [], [1, 2, 3], list(range(20)),
+    {"a": 1}, {Keyword("type"): Keyword("ok")},
+    frozenset([1, 2, 3]),
+])
+def test_roundtrip(v):
+    assert rt(v) == v
+
+
+def test_roundtrip_keyword_symbol_types():
+    assert isinstance(rt(Keyword("valid?")), Keyword)
+    assert rt(Keyword("ns/name")) == Keyword("ns/name")
+    assert isinstance(rt(Symbol("foo")), Symbol)
+
+
+def test_roundtrip_datetime():
+    d = datetime.datetime(2020, 5, 1, 12, 0, 0,
+                          tzinfo=datetime.timezone.utc)
+    assert rt(d) == d
+
+
+def test_roundtrip_nested_test_map():
+    test = {Keyword("name"): "etcd",
+            Keyword("nodes"): ["n1", "n2", "n3"],
+            Keyword("concurrency"): 10,
+            Keyword("valid?"): True,
+            Keyword("stats"): {Keyword("count"): 300,
+                               Keyword("latencies"): [1.5, 2.5, 100.0]}}
+    assert rt(test) == test
+
+
+def test_packed_int_boundaries_wire():
+    # one byte for -1..63 (spec: small ints are the code itself)
+    assert f.dumps(0) == b"\x00"
+    assert f.dumps(63) == b"\x3f"
+    assert f.dumps(-1) == b"\xff"
+    # two-byte zone 0x40-0x5F with bias 0x50
+    assert f.dumps(64) == bytes([0x50, 64])
+    assert f.dumps(-2) == bytes([0x4F, 0xFE])
+    assert f.dumps(4095) == bytes([0x5F, 0xFF])
+    assert f.dumps(-4096) == bytes([0x40, 0x00])
+
+
+def test_wire_codes_for_simple_values():
+    assert f.dumps(None) == bytes([f.NULL])
+    assert f.dumps(True) == bytes([f.TRUE])
+    assert f.dumps("abc") == bytes([f.STRING_PACKED_START + 3]) + b"abc"
+    assert f.dumps([1, 2]) == bytes([f.LIST_PACKED_START + 2, 1, 2])
+
+
+def test_keyword_caching_shrinks_and_roundtrips():
+    ops = [{Keyword("type"): Keyword("ok")} for _ in range(50)]
+    data = f.dumps(ops)
+    back = f.loads(data)
+    assert back == ops
+    # cached keywords must be far smaller than 50 copies of the text
+    assert len(data) < 50 * 8
+
+
+def test_tagged_value_roundtrip_and_conversions():
+    tv = f.TaggedValue("weird", [1, "x"])
+    assert rt(tv) == tv
+    assert f.convert_tagged("atom", [42]) == 42
+    assert f.convert_tagged("multiset", [{"a": 2, "b": 1}]) == \
+        ["a", "a", "b"]
+    assert f.convert_tagged("map-entry", [1, 2]) == (1, 2)
+
+
+def test_reader_rejects_garbage():
+    with pytest.raises(f.FressianError):
+        f.loads(b"")
+    with pytest.raises(f.FressianError):
+        f.loads(bytes([0xF1]))  # META unsupported
+
+
+def test_store_loads_reference_style_run(tmp_path):
+    # Synthesize a reference-shaped run dir: test.fressian + history.edn
+    run = tmp_path / "store" / "etcd" / "20200101T000000"
+    run.mkdir(parents=True)
+    tmap = {Keyword("name"): "etcd", Keyword("concurrency"): 5}
+    (run / "test.fressian").write_bytes(f.dumps(tmap))
+    (run / "history.edn").write_text(
+        '{:type :invoke, :process 0, :f :read, :value nil}\n'
+        '{:type :ok, :process 0, :f :read, :value 3}\n')
+    st = Store(tmp_path / "store")
+    test = st.load_test(run)
+    assert test["name"] == "etcd"
+    assert test["concurrency"] == 5
+    assert len(test["history"]) == 2
+    assert test["history"][1]["value"] == 3

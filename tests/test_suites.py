@@ -228,3 +228,31 @@ def test_monotonic_checker_catches_lost_increment():
     h[2] = {"type": "invoke", "process": 1, "f": "read", "value": None}
     h[3] = {"type": "ok", "process": 1, "f": "read", "value": 5}
     assert monotonic.checker().check({}, h, {})["valid?"] is True
+
+
+def test_analyze_uses_stored_workload(tmp_path):
+    """`analyze` must re-check with the run's stored workload, not the
+    CLI default (review regression)."""
+    import argparse
+    from jepsen_tpu.suites import resolve_workload
+    args = argparse.Namespace(workload=None)
+    assert resolve_workload(args, {"workload": "bank"}, "append") == "bank"
+    assert resolve_workload(args, {}, "append") == "append"
+    args = argparse.Namespace(workload="set")
+    assert resolve_workload(args, {"workload": "bank"}, "append") == "set"
+
+
+def test_suite_test_preserves_stored_run_identity():
+    """Stored name/start-time must survive suite_test so analyze writes
+    into the original run dir (review regression)."""
+    opts = base_opts(**{"start-time": "20200101T000000",
+                        "name": "tidb bank", "workload": "bank"})
+    t = suite_test("tidb", "bank", opts, standard_workloads())
+    assert t["start-time"] == "20200101T000000"
+    assert t["name"] == "tidb bank"
+
+
+def test_etcd_quorum_option():
+    t = etcd.etcd_test({"quorum": True})
+    assert t["client"].quorum is True
+    assert etcd.etcd_test({})["client"].quorum is False
