@@ -89,3 +89,51 @@ def _pad_axis(a: np.ndarray, n: int, fill: int = 0) -> np.ndarray:
     out = np.full((B, n), fill, a.dtype)
     out[:, :t] = a
     return out
+
+
+def synth_append_history(T: int, K: int, seed: int = 0,
+                         g1c: bool = False,
+                         concurrency: int = 5) -> list[dict]:
+    """A serial (anomaly-free) list-append history as op DICTS — the
+    dict-level sibling of synth_valid_batch, for paths that start from
+    encode_history (long-history checking, dry runs, tests). With
+    ``g1c``, two mutually-observing txns on fresh keys are appended,
+    forming a wr/wr cycle."""
+    import random
+
+    rng = random.Random(seed)
+    hist: list[dict] = []
+    state: dict[int, list[int]] = {}
+    for i in range(T):
+        k = rng.randrange(K)
+        if rng.random() < 0.5:
+            v = len(state.setdefault(k, [])) + 1
+            state[k].append(v)
+            val = [["append", k, v]]
+        else:
+            val = [["r", k, list(state.get(k, []))]]
+        hist.append({"type": "invoke", "process": i % concurrency,
+                     "f": "txn",
+                     "value": [[m[0], m[1], None] for m in val],
+                     "time": i * 1000, "index": 2 * i})
+        hist.append({"type": "ok", "process": i % concurrency, "f": "txn",
+                     "value": val, "time": i * 1000 + 500,
+                     "index": 2 * i + 1})
+    if g1c:
+        t = T * 1000 + 1000
+        ka, kb = K, K + 1
+        hist += [
+            {"type": "invoke", "process": 0, "f": "txn",
+             "value": [["append", ka, None], ["r", kb, None]],
+             "time": t, "index": len(hist)},
+            {"type": "ok", "process": 0, "f": "txn",
+             "value": [["append", ka, 1], ["r", kb, [1]]],
+             "time": t + 2, "index": len(hist) + 1},
+            {"type": "invoke", "process": 1, "f": "txn",
+             "value": [["append", kb, None], ["r", ka, None]],
+             "time": t + 1, "index": len(hist) + 2},
+            {"type": "ok", "process": 1, "f": "txn",
+             "value": [["append", kb, 1], ["r", ka, [1]]],
+             "time": t + 3, "index": len(hist) + 3},
+        ]
+    return hist

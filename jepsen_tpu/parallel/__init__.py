@@ -53,7 +53,17 @@ def sharded_check_fn(mesh: Mesh | None, shape: K.BatchShape, *,
     """Build a jitted batched checker around kernels.check_batched_impl.
     With a mesh, inputs are expected sharded over 'dp' and the closure
     matrices are constrained to P('dp', None, 'mp'); without one, it's a
-    plain single-device jit."""
+    plain single-device jit. Memoized per (mesh, shape, flags) so
+    repeated same-shape dispatches (bucketed sweeps, per-key loops)
+    compile once."""
+    return _sharded_check_fn_cached(mesh, shape, classify, realtime,
+                                    process_order)
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_check_fn_cached(mesh: Mesh | None, shape: K.BatchShape,
+                             classify: bool, realtime: bool,
+                             process_order: bool):
     if mesh is not None:
         spec = P("dp", None, "mp")
 
@@ -86,3 +96,95 @@ def shard_batch(mesh: Mesh | None, packed: dict) -> tuple:
         s = NamedSharding(mesh, P("dp"))
         args = [jax.device_put(a, s) for a in args]
     return tuple(args)
+
+
+# ---------------------------------------------------------------------------
+# Long single histories: sequence-parallel checking (SURVEY.md §5.7).
+# ---------------------------------------------------------------------------
+
+def sp_mesh(devices: Sequence | None = None) -> Mesh:
+    """A 1×N mesh dedicating the WHOLE slice to one history: dp is
+    trivial, and the [T,T] adjacency/closure matrices are column-sharded
+    over every device, so each closure matmul is a distributed dense
+    matmul with XLA moving the halo over ICI — the context-parallel
+    analogue for op-axis sharding."""
+    devices = list(devices if devices is not None else default_devices())
+    return Mesh(np.asarray(devices).reshape(1, len(devices)), ("dp", "mp"))
+
+
+def check_long_history(enc, mesh: Mesh | None = None, *,
+                       classify: bool = True, realtime: bool = False,
+                       process_order: bool = False) -> dict:
+    """Check ONE long encoded history with its op axis sharded across
+    the mesh; returns {anomaly: True} flags. Dense closure means HBM
+    bounds T — beyond ~32k txns on a v5e-8 slice, fall back to the
+    host-side graph path (native Tarjan), mirroring the reference's
+    key-decomposition pragmatism (independent.clj:1-7)."""
+    mesh = mesh if mesh is not None else sp_mesh()
+    shape = K.BatchShape.plan([enc])
+    packed = K.pack_batch([enc], shape)
+    fn = sharded_check_fn(mesh, shape, classify=classify,
+                          realtime=realtime, process_order=process_order)
+    args = shard_batch(mesh, packed)
+    flags = np.asarray(jax.block_until_ready(fn(*args)))
+    return K.flags_to_names(int(flags[0]))
+
+
+# ---------------------------------------------------------------------------
+# Device-memory-aware batch scheduling (SURVEY.md §2.5): histories are
+# bucketed by padded length so each dispatch's B·T² closure footprint
+# stays under a budget, instead of padding everything to the longest.
+# ---------------------------------------------------------------------------
+
+def bucket_by_length(encs: Sequence, *, multiple: int = 128,
+                     budget_cells: int = 1 << 27) -> list[list[int]]:
+    """Partition history indices into buckets of similar padded txn
+    count. Each bucket satisfies B * T_pad² <= budget_cells (T_pad the
+    bucket max, rounded up to `multiple`). Returns buckets of indices
+    into encs, longest histories first."""
+    order = sorted(range(len(encs)), key=lambda i: -encs[i].n)
+    buckets: list[list[int]] = []
+    cur: list[int] = []
+    cur_tpad = 0
+    for i in order:
+        tpad = max(K.pad_to(max(encs[i].n, 1), multiple), 1)
+        t = max(cur_tpad, tpad)
+        if cur and (len(cur) + 1) * t * t > budget_cells:
+            buckets.append(cur)
+            cur, cur_tpad = [], 0
+            t = tpad
+        cur.append(i)
+        cur_tpad = t
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def check_bucketed(encs: Sequence, mesh: Mesh | None = None, *,
+                   classify: bool = True, realtime: bool = False,
+                   process_order: bool = False,
+                   budget_cells: int = 1 << 27) -> list[dict]:
+    """Check many encoded histories bucketed by length: one device
+    dispatch per bucket, results returned in input order."""
+    if not len(encs):
+        return []
+    out: list[dict | None] = [None] * len(encs)
+    for bucket in bucket_by_length(encs, budget_cells=budget_cells):
+        group = [encs[i] for i in bucket]
+        if mesh is not None:
+            # Pad ragged buckets to a dp multiple by replicating the
+            # last history (results dropped below) so the dispatch still
+            # shards across the mesh instead of falling to one device.
+            dp = mesh.devices.shape[0]
+            while len(group) % dp:
+                group.append(group[-1])
+        shape = K.BatchShape.plan(group)
+        packed = K.pack_batch(group, shape)
+        fn = sharded_check_fn(mesh, shape, classify=classify,
+                              realtime=realtime,
+                              process_order=process_order)
+        args = shard_batch(mesh, packed)
+        flags = np.asarray(jax.block_until_ready(fn(*args)))
+        for i, w in zip(bucket, flags):
+            out[i] = K.flags_to_names(int(w))
+    return out  # type: ignore[return-value]

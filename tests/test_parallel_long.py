@@ -1,0 +1,74 @@
+"""Sequence-parallel long-history checking and the memory-aware bucket
+scheduler (SURVEY.md §5.7, §2.5), run on the virtual 8-device CPU mesh."""
+
+from jepsen_tpu import parallel
+from jepsen_tpu.checker.elle import encode as elle_encode
+from jepsen_tpu.checker.elle import graph as G
+from jepsen_tpu.checker.elle.synth import synth_append_history
+
+
+def make_history(T=200, K=8, seed=0, g1c=False):
+    return synth_append_history(T=T, K=K, seed=seed, g1c=g1c)
+
+
+def test_sp_mesh_shape():
+    m = parallel.sp_mesh()
+    assert m.devices.shape[0] == 1
+    assert m.axis_names == ("dp", "mp")
+
+
+def test_long_history_valid():
+    enc = elle_encode.encode_history(make_history(T=300, seed=1))
+    verdict = parallel.check_long_history(enc, parallel.sp_mesh())
+    assert verdict == {}
+
+
+def test_long_history_flags_g1c():
+    enc = elle_encode.encode_history(make_history(T=120, seed=2, g1c=True))
+    verdict = parallel.check_long_history(enc, parallel.sp_mesh())
+    assert verdict.get("G1c") is True
+
+
+def test_long_history_matches_cpu_oracle():
+    for seed in range(3):
+        hist = make_history(T=150, seed=10 + seed, g1c=(seed == 1))
+        enc = elle_encode.encode_history(hist)
+        dev = parallel.check_long_history(enc, parallel.sp_mesh())
+        edges = G.build_edges(enc)
+        cpu = G.classify_cycles(enc.n, edges, want_witnesses=False)
+        assert set(dev) == {k for k in cpu if k in
+                            ("G0", "G1c", "G-single", "G2-item")}, seed
+
+
+def test_bucket_by_length_respects_budget():
+    class E:
+        def __init__(self, n):
+            self.n = n
+    encs = [E(n) for n in (10, 500, 20, 1000, 30, 600)]
+    buckets = parallel.bucket_by_length(encs, multiple=128,
+                                        budget_cells=2 * 1024 * 1024)
+    seen = sorted(i for b in buckets for i in b)
+    assert seen == list(range(len(encs)))
+    from jepsen_tpu.checker.elle.kernels import pad_to
+    for b in buckets:
+        tpad = pad_to(max(encs[i].n for i in b), 128)
+        assert len(b) * tpad * tpad <= 2 * 1024 * 1024
+
+
+def test_check_bucketed_matches_order_and_oracle():
+    hists = [make_history(T=60 + 40 * i, seed=20 + i, g1c=(i == 2))
+             for i in range(4)]
+    encs = [elle_encode.encode_history(h) for h in hists]
+    out = parallel.check_bucketed(encs, parallel.make_mesh(),
+                                  budget_cells=1 << 18)
+    assert len(out) == 4
+    for i, (enc, verdict) in enumerate(zip(encs, out)):
+        cpu = G.classify_cycles(enc.n, G.build_edges(enc),
+                                want_witnesses=False)
+        assert set(verdict) == {k for k in cpu if k in
+                                ("G0", "G1c", "G-single", "G2-item")}, i
+    assert out[2].get("G1c") is True
+
+
+def test_check_bucketed_empty():
+    assert parallel.check_bucketed([]) == []
