@@ -543,7 +543,18 @@ class Linearizable(Checker):
                                 algorithm=self.algorithm)
 
     def check(self, test, history, opts):
-        return self.check_batch(test, [history], opts)[0]
+        res = self.check_batch(test, [history], opts)[0]
+        if res.get("valid?") is False and test.get("store") is not None:
+            # Render the failure like the reference's linear.svg
+            # (checker.clj:209-213, knossos.linear.report).
+            try:
+                from . import linear_svg
+                linear_svg.render_analysis(test, res, history, opts)
+            except Exception:  # rendering must never mask the verdict
+                import logging
+                logging.getLogger(__name__).warning(
+                    "linear.svg render failed", exc_info=True)
+        return res
 
     def check_batch(self, test, histories: list[list], opts) -> list[dict]:
         """Check many histories at once — the TPU batch path used by

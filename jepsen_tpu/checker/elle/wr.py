@@ -378,6 +378,31 @@ ANOMALY_EXPANSION = {
 }
 
 
+def render_wr_verdict(enc: WrEncoded, cycles: dict,
+                      prohibited: frozenset) -> dict:
+    """Combine host-detected and cycle anomalies into the rw-register
+    verdict (shared by WrChecker and the batch analyze-store path)."""
+    anomalies: dict = dict(enc.anomalies)
+    for name, witness in cycles.items():
+        if witness is True:
+            anomalies[name] = True
+        else:
+            anomalies[name] = [{"cycle-txns": [
+                enc.txn_ops[r] if 0 <= r < len(enc.txn_ops) else r
+                for r in witness]}]
+    bad = {a for a in anomalies
+           if a in prohibited or a in ALWAYS_INVALID}
+    if enc.n == 0:
+        return {"valid?": "unknown",
+                "anomaly-types": ["empty-transaction-graph"],
+                "anomalies": {}, "txn-count": 0}
+    return {"valid?": not bad,
+            "anomaly-types": sorted(anomalies),
+            "anomalies": anomalies,
+            "txn-count": enc.n,
+            "key-count": enc.key_count}
+
+
 class WrChecker(Checker):
     """Checker for rw-register histories (wr.clj:16-56 equivalent).
 
@@ -406,25 +431,7 @@ class WrChecker(Checker):
                 else cycle_anomalies_cpu)
         cycles = find(enc, realtime=self.realtime,
                       process_order=self.process_order)
-        anomalies: dict = dict(enc.anomalies)
-        for name, witness in cycles.items():
-            if witness is True:
-                anomalies[name] = True
-            else:
-                anomalies[name] = [{"cycle-txns": [
-                    enc.txn_ops[r] if 0 <= r < len(enc.txn_ops) else r
-                    for r in witness]}]
-        bad = {a for a in anomalies
-               if a in self.prohibited or a in ALWAYS_INVALID}
-        if enc.n == 0:
-            return {"valid?": "unknown",
-                    "anomaly-types": ["empty-transaction-graph"],
-                    "anomalies": {}, "txn-count": 0}
-        return {"valid?": not bad,
-                "anomaly-types": sorted(anomalies),
-                "anomalies": anomalies,
-                "txn-count": enc.n,
-                "key-count": enc.key_count}
+        return render_wr_verdict(enc, cycles, self.prohibited)
 
 
 def rw_register_checker(anomalies: Iterable[str] = ("G2", "G1a", "G1b",

@@ -77,9 +77,13 @@ def test_map_from_args(args: argparse.Namespace) -> dict:
 
 def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
             name: str = "jepsen-tpu", opt_fn=None,
-            argv: list[str] | None = None) -> int:
+            argv: list[str] | None = None,
+            tests_fn: Callable[[dict, argparse.Namespace], list] | None
+            = None) -> int:
     """Build and dispatch the CLI. `test_fn(base_test, args)` returns the
-    full test map; `opt_fn(parser)` may add suite-specific options."""
+    full test map; `opt_fn(parser)` may add suite-specific options;
+    `tests_fn(base_test, args)` returns the list of test maps run by the
+    `test-all` subcommand (defaults to the single test_fn test)."""
     parser = argparse.ArgumentParser(prog=name)
     sub = parser.add_subparsers(dest="command", required=True)
 
@@ -97,6 +101,26 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
     add_test_opts(p_an)
     if opt_fn:
         opt_fn(p_an)
+
+    p_all = sub.add_parser(
+        "test-all",
+        help="run a whole suite of tests (cli.clj:413-491's test-all)")
+    add_test_opts(p_all)
+    if opt_fn:
+        opt_fn(p_all)
+
+    p_batch = sub.add_parser(
+        "analyze-store",
+        help="batch re-check every stored run on the device mesh "
+             "(the north-star batch path)")
+    p_batch.add_argument("--store", default="store")
+    p_batch.add_argument("--checker", default="append",
+                         choices=["append", "wr", "stored"],
+                         help="append/wr: encode histories and batch-"
+                              "check on the mesh; stored: re-run each "
+                              "run's own checker")
+    p_batch.add_argument("--name", default=None,
+                         help="only runs of this test name")
 
     p_serve = sub.add_parser("serve", help="serve the store over HTTP")
     p_serve.add_argument("--port", type=int, default=8080)
@@ -139,6 +163,29 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
             test = core.analyze(test)
             print(json.dumps({"valid?": test["results"].get("valid?")}))
             return validity_exit_code(test["results"])
+        if args.command == "test-all":
+            tests = (tests_fn(test_map_from_args(args), args)
+                     if tests_fn is not None
+                     else [test_fn(test_map_from_args(args), args)])
+            worst = 0
+            for test in tests:
+                try:
+                    test = core.run(test)
+                    code = validity_exit_code(test.get("results"))
+                    print(json.dumps(
+                        {"name": test.get("name"),
+                         "valid?": test["results"].get("valid?"),
+                         "dir": str(test["store"].test_dir(test))}))
+                except Exception as e:
+                    log.exception("test %s crashed", test.get("name"))
+                    print(json.dumps({"name": test.get("name"),
+                                      "error": str(e)}))
+                    code = 255
+                worst = max(worst, code)
+            return worst
+        if args.command == "analyze-store":
+            return analyze_store(Store(args.store), checker=args.checker,
+                                 name=args.name)
         if args.command == "serve":
             from . import web
             web.serve(Store(args.store), host=args.host, port=args.port)
@@ -149,3 +196,122 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
     except Exception:
         log.exception("fatal error")
         return 255
+
+
+def analyze_store(store: Store, checker: str = "append",
+                  name: str | None = None) -> int:
+    """Batch re-check every stored run — the north-star batch path
+    (SURVEY.md §3.4, §7 stage 8): encodable histories are packed,
+    length-bucketed, and dispatched across the device mesh in one sweep;
+    the rest (or --checker stored) re-run their own checker host-side.
+
+    Writes `results.json`/`results.edn` into each run dir and prints one
+    JSON summary line per run. Exit code: worst validity across runs."""
+    run_dirs = sorted(store.all_run_dirs())
+    if name is not None:
+        run_dirs = [d for d in run_dirs if d.parent.name == name]
+    if not run_dirs:
+        print("no stored runs", file=sys.stderr)
+        return 254
+
+    def stored_check(d) -> dict:
+        stored = store.load_test(d)
+        test = dict(stored)
+        test["store"] = store
+        return core.analyze(test)["results"]
+
+    def emit(d, res) -> int:
+        from . import edn as edn_mod
+        from .store import _results_to_edn
+        (d / "results.json").write_text(
+            json.dumps(_json_safe(res), indent=2))
+        (d / "results.edn").write_text(
+            edn_mod.dumps(_results_to_edn(_json_safe(res))) + "\n")
+        print(json.dumps({"dir": str(d), "valid?": res.get("valid?"),
+                          "anomalies": res.get("anomaly-types", [])}))
+        return validity_exit_code(res)
+
+    worst = 0
+    if checker == "stored":
+        for d in run_dirs:
+            res = stored_check(d)
+            print(json.dumps({"dir": str(d),
+                              "valid?": res.get("valid?")}))
+            worst = max(worst, validity_exit_code(res))
+        return worst
+
+    from . import parallel
+    from .checker import elle
+    from .checker.elle import encode as elle_encode
+    from .checker.elle import kernels as elle_kernels
+    from .checker.elle import wr as elle_wr
+
+    # Encodable histories get the batched device sweep; the rest fall
+    # back to their own stored checker host-side.
+    encs, mapping, fallback = [], [], []
+    for d in run_dirs:
+        try:
+            hist = store.load_history(d)
+            if checker == "append":
+                enc = elle_encode.encode_history(hist)
+            else:
+                enc = elle_wr.encode_wr_history(hist)
+            if enc.n == 0:  # no txn ops at all: not a txn workload
+                fallback.append(d)
+                continue
+            encs.append(enc)
+            mapping.append(d)
+        except Exception:
+            log.info("run %s not encodable as %s; using stored checker",
+                     d, checker, exc_info=True)
+            fallback.append(d)
+
+    if encs:
+        if checker == "append":
+            mesh = None
+            try:
+                mesh = parallel.make_mesh()
+            except Exception:
+                pass
+            cycles_per_run = parallel.check_bucketed(encs, mesh)
+            prohibited = elle.expand_anomalies(("G1", "G2"))
+            for d, enc, cycles in zip(mapping, encs, cycles_per_run):
+                res = elle.render_verdict(enc, cycles, prohibited)
+                worst = max(worst, emit(d, res))
+        else:  # wr: edge lists are host-built; one device dispatch
+            live = [i for i, e in enumerate(encs) if e.n > 0]
+            live_cycles = elle_kernels.check_edge_batch(
+                [{"n": encs[i].n, "edges": encs[i].edges,
+                  "invoke_index": encs[i].invoke_index,
+                  "complete_index": encs[i].complete_index,
+                  "process": encs[i].process} for i in live])
+            cycles_per_run = [{} for _ in encs]
+            for i, cyc in zip(live, live_cycles):
+                cycles_per_run[i] = cyc
+            prohibited = frozenset().union(
+                *(elle_wr.ANOMALY_EXPANSION.get(a, {a})
+                  for a in ("G2", "G1a", "G1b", "internal")))
+            for d, enc, cycles in zip(mapping, encs, cycles_per_run):
+                res = elle_wr.render_wr_verdict(enc, cycles, prohibited)
+                worst = max(worst, emit(d, res))
+
+    for d in fallback:
+        try:
+            res = stored_check(d)
+            print(json.dumps({"dir": str(d),
+                              "valid?": res.get("valid?")}))
+            worst = max(worst, validity_exit_code(res))
+        except Exception as e:
+            print(json.dumps({"dir": str(d), "error": str(e)}))
+            worst = max(worst, 254)
+    return worst
+
+
+def _json_safe(v):
+    if isinstance(v, dict):
+        return {str(k): _json_safe(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return repr(v)

@@ -102,4 +102,8 @@ def main(argv=None) -> int:
         name="cockroach",
         opt_fn=lambda p: p.add_argument(
             "--workload", default=None, choices=sorted(workloads())),
+        tests_fn=lambda tmap, args: [
+            cockroach_test({**tmap, "workload": w})
+            for w in ([args.workload] if getattr(
+                args, "workload", None) else sorted(workloads()))],
         argv=argv)

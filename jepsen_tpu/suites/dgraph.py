@@ -89,4 +89,8 @@ def main(argv=None) -> int:
         name="dgraph",
         opt_fn=lambda p: p.add_argument(
             "--workload", default=None, choices=sorted(workloads())),
+        tests_fn=lambda tmap, args: [
+            dgraph_test({**tmap, "workload": w})
+            for w in ([args.workload] if getattr(
+                args, "workload", None) else sorted(workloads()))],
         argv=argv)

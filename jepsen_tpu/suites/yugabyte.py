@@ -103,4 +103,5 @@ def main(argv=None) -> int:
             {**tmap, "workload": resolve_workload(args, tmap, "bank"),
              "api": (getattr(args, "api", None) or tmap.get("api")
                      or "ysql")}),
-        name="yugabyte", opt_fn=opt_fn, argv=argv)
+        name="yugabyte", opt_fn=opt_fn,
+        tests_fn=lambda tmap, args: all_tests(tmap), argv=argv)
