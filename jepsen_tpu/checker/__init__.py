@@ -544,17 +544,24 @@ class Linearizable(Checker):
 
     def check(self, test, history, opts):
         res = self.check_batch(test, [history], opts)[0]
-        if res.get("valid?") is False and test.get("store") is not None:
-            # Render the failure like the reference's linear.svg
-            # (checker.clj:209-213, knossos.linear.report).
-            try:
-                from . import linear_svg
-                linear_svg.render_analysis(test, res, history, opts)
-            except Exception:  # rendering must never mask the verdict
-                import logging
-                logging.getLogger(__name__).warning(
-                    "linear.svg render failed", exc_info=True)
+        if res.get("valid?") is False:
+            self.render_failure(test, history, res, opts)
         return res
+
+    def render_failure(self, test, history, res, opts) -> None:
+        """Render linear.svg for an invalid analysis (checker.clj:209-213,
+        knossos.linear.report). Called directly from check(), and by
+        independent.checker per failing key with that key's
+        subdirectory opts."""
+        if test.get("store") is None:
+            return
+        try:
+            from . import linear_svg
+            linear_svg.render_analysis(test, res, history, opts)
+        except Exception:  # rendering must never mask the verdict
+            import logging
+            logging.getLogger(__name__).warning(
+                "linear.svg render failed", exc_info=True)
 
     def check_batch(self, test, histories: list[list], opts) -> list[dict]:
         """Check many histories at once — the TPU batch path used by

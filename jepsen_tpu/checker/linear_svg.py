@@ -28,28 +28,36 @@ INFO_COLOR = "#FFAA26"
 BAD_COLOR = "#FF1E90"
 
 
+def _same_op(a: dict | None, b: dict | None) -> bool:
+    """Identify the analysis' failing op within the raw history. The
+    analyzer's 'index' comes from its own reduced history, so match on
+    the stable identity fields instead."""
+    if a is None or b is None:
+        return False
+    return (a.get("process") == b.get("process")
+            and a.get("time") == b.get("time")
+            and a.get("f") == b.get("f"))
+
+
 def _window(history: Sequence[dict], bad_op: dict | None,
-            radius: int = 40) -> list[tuple[dict, dict | None]]:
-    """Invoke/complete pairs within `radius` ops of the failing op."""
+            radius: int = 40) -> tuple[list[tuple[dict, dict | None]], int]:
+    """(invoke/complete pairs within `radius` positions of the failing
+    op, position of the failing pair or -1)."""
     pairs = list(h.pairs(h.index(list(history))))
-    if bad_op is None:
-        return pairs[:radius]
-    bad_idx = bad_op.get("index")
-    if bad_idx is None:
-        return pairs[:radius]
-    out = []
-    for inv, comp in pairs:
-        lo = inv.get("index", 0)
-        hi = (comp or inv).get("index", lo)
-        if hi >= bad_idx - radius and lo <= bad_idx + radius:
-            out.append((inv, comp))
-    return out
+    bad_pos = next((i for i, (inv, comp) in enumerate(pairs)
+                    if _same_op(inv, bad_op) or _same_op(comp, bad_op)),
+                   -1)
+    if bad_pos < 0:
+        return pairs[:radius], -1
+    lo = max(0, bad_pos - radius // 2)
+    window = pairs[lo: bad_pos + radius // 2]
+    return window, bad_pos - lo
 
 
 def render_svg(analysis: dict, history: Sequence[dict]) -> str:
     """SVG document for a (usually invalid) wgl/linear analysis."""
     bad = analysis.get("op")
-    pairs = _window(history, bad)
+    pairs, bad_pos = _window(history, bad)
     procs = []
     for inv, _ in pairs:
         if inv.get("process") not in procs:
@@ -64,7 +72,7 @@ def render_svg(analysis: dict, history: Sequence[dict]) -> str:
     px = (width - LABEL_W - 100) / span
 
     elems = []
-    for inv, comp in pairs:
+    for pos, (inv, comp) in enumerate(pairs):
         p = inv.get("process")
         if p not in lane:
             continue
@@ -74,8 +82,7 @@ def render_svg(analysis: dict, history: Sequence[dict]) -> str:
         op = comp or inv
         color = {"ok": OK_COLOR, "fail": FAIL_COLOR}.get(
             op.get("type"), INFO_COLOR)
-        is_bad = bad is not None and inv.get("index") == bad.get("index")
-        if is_bad:
+        if pos == bad_pos:
             color = BAD_COLOR
         label = f"{op.get('f')} {op.get('value')}"
         tooltip = _html.escape(repr(op))

@@ -274,23 +274,19 @@ def analyze_store(store: Store, checker: str = "append",
             except Exception:
                 pass
             cycles_per_run = parallel.check_bucketed(encs, mesh)
-            prohibited = elle.expand_anomalies(("G1", "G2"))
+            # The checker class's own defaults, so batch verdicts match
+            # single-run verdicts for the same history.
+            prohibited = elle.AppendChecker().prohibited
             for d, enc, cycles in zip(mapping, encs, cycles_per_run):
                 res = elle.render_verdict(enc, cycles, prohibited)
                 worst = max(worst, emit(d, res))
         else:  # wr: edge lists are host-built; one device dispatch
-            live = [i for i, e in enumerate(encs) if e.n > 0]
-            live_cycles = elle_kernels.check_edge_batch(
-                [{"n": encs[i].n, "edges": encs[i].edges,
-                  "invoke_index": encs[i].invoke_index,
-                  "complete_index": encs[i].complete_index,
-                  "process": encs[i].process} for i in live])
-            cycles_per_run = [{} for _ in encs]
-            for i, cyc in zip(live, live_cycles):
-                cycles_per_run[i] = cyc
-            prohibited = frozenset().union(
-                *(elle_wr.ANOMALY_EXPANSION.get(a, {a})
-                  for a in ("G2", "G1a", "G1b", "internal")))
+            cycles_per_run = elle_kernels.check_edge_batch(
+                [{"n": e.n, "edges": e.edges,
+                  "invoke_index": e.invoke_index,
+                  "complete_index": e.complete_index,
+                  "process": e.process} for e in encs])
+            prohibited = elle_wr.WrChecker().prohibited
             for d, enc, cycles in zip(mapping, encs, cycles_per_run):
                 res = elle_wr.render_wr_verdict(enc, cycles, prohibited)
                 worst = max(worst, emit(d, res))

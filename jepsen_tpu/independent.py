@@ -299,6 +299,18 @@ class IndependentChecker(Checker):
                 lambda ks_: check_safe(self.sub, test, ks_[1],
                                        self._sub_opts(opts, ks_[0])),
                 list(zip(ks, subs)))
+        # Batch-dispatched sub-checkers never see per-key opts, so any
+        # per-failure artifact (e.g. linear.svg) is rendered here, where
+        # the per-key subdirectory is known.
+        render = getattr(self.sub, "render_failure", None)
+        if render is not None:
+            for k, s, r in zip(ks, subs, results):
+                if r.get("valid?") is False:
+                    try:
+                        render(test, s, r, self._sub_opts(opts, k))
+                    except Exception:
+                        log.warning("failure render for key %r failed",
+                                    k, exc_info=True)
         for k, s, r in zip(ks, subs, results):
             try:
                 self._persist_key(test, opts, k, s, r)

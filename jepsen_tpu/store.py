@@ -84,6 +84,13 @@ class Store:
     def _relink(self, link: Path, target: Path) -> None:
         link.parent.mkdir(parents=True, exist_ok=True)
         if link.is_symlink() or link.exists():
+            # Only move forward: re-analyzing an OLD run (analyze-store's
+            # sweep) must not steal latest/current from a newer run.
+            try:
+                if link.resolve().name > target.name:
+                    return
+            except OSError:
+                pass
             link.unlink()
         link.symlink_to(os.path.relpath(target, link.parent))
 

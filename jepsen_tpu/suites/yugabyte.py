@@ -104,4 +104,10 @@ def main(argv=None) -> int:
              "api": (getattr(args, "api", None) or tmap.get("api")
                      or "ysql")}),
         name="yugabyte", opt_fn=opt_fn,
-        tests_fn=lambda tmap, args: all_tests(tmap), argv=argv)
+        tests_fn=lambda tmap, args: [
+            yugabyte_test({**tmap, "api": api, "workload": w})
+            for api in ([args.api] if getattr(args, "api", None)
+                        else APIS)
+            for w in ([args.workload] if getattr(args, "workload", None)
+                      else sorted(workloads(tmap)))],
+        argv=argv)

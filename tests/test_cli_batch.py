@@ -173,3 +173,41 @@ def test_analyze_store_unencodable_falls_back(tmp_path):
     (d / "test.json").write_text(json.dumps({"name": "reg"}))
     rc = cli.analyze_store(store)
     assert rc == 0  # stored-checker fallback, not an error
+
+
+def test_linear_svg_rendered_per_key_through_independent(tmp_path):
+    """The per-key (independent) path must render linear.svg for failing
+    keys even though Linearizable dispatches via check_batch."""
+    from jepsen_tpu import independent
+    store = Store(tmp_path / "store")
+    test = {"name": "indep-lin", "store": store}
+    kv = independent.tuple_
+    h = [
+        {"type": "invoke", "process": 0, "f": "read",
+         "value": kv(1, None), "time": 0},
+        {"type": "ok", "process": 0, "f": "read", "value": kv(1, 0),
+         "time": 10},
+        {"type": "invoke", "process": 1, "f": "read",
+         "value": kv(2, None), "time": 20},
+        {"type": "ok", "process": 1, "f": "read", "value": kv(2, 7),
+         "time": 30},  # key 2 reads 7 from a 0-register: invalid
+    ]
+    res = independent.checker(
+        c.linearizable(models.cas_register(0))).check(test, h, {})
+    assert res["valid?"] is False
+    d = store.test_dir(test)
+    assert (d / "independent" / "2" / "linear.svg").exists()
+    assert not (d / "independent" / "1" / "linear.svg").exists()
+    svg = (d / "independent" / "2" / "linear.svg").read_text()
+    assert "cannot linearize" in svg
+
+
+def test_symlinks_only_move_forward(tmp_path):
+    store = Store(tmp_path / "store")
+    new = {"name": "t", "start-time": "20260101T000000"}
+    old = {"name": "t", "start-time": "20200101T000000"}
+    store.test_dir(new).mkdir(parents=True)
+    store.test_dir(old).mkdir(parents=True)
+    store.update_symlinks(new)
+    store.update_symlinks(old)  # re-analysis of an old run
+    assert store.latest().name == "20260101T000000"
