@@ -311,3 +311,9 @@ def _json_safe(v):
     if isinstance(v, (str, int, float, bool)) or v is None:
         return v
     return repr(v)
+
+
+if __name__ == "__main__":
+    # `python -m jepsen_tpu.cli analyze-store ...` — the suite-agnostic
+    # entry (test/analyze with no suite run a noop test map).
+    sys.exit(run_cli(lambda tmap, args: tmap))

@@ -47,14 +47,18 @@ class DebianOS(OS):
     def __init__(self, extra_packages: tuple = ()):
         self.packages = DEBIAN_PACKAGES + tuple(extra_packages)
 
-    def setup(self, test, node):
-        sess = control.current_session().su()
-        log.info("%s setting up debian", node)
+    def _install(self, sess) -> None:
+        """Install the toolbox, retrying once after a cache refresh."""
         sess.exec(control.Lit(
             "DEBIAN_FRONTEND=noninteractive apt-get install -y -q "
             + " ".join(self.packages)
             + " || (apt-get update && DEBIAN_FRONTEND=noninteractive "
               "apt-get install -y -q " + " ".join(self.packages) + ")"))
+
+    def setup(self, test, node):
+        sess = control.current_session().su()
+        log.info("%s setting up %s", node, type(self).__name__)
+        self._install(sess)
         self._setup_hostfile(sess, test)
         # Heal leftover partitions from crashed prior runs.
         sess.exec_ok("iptables", "-F", "-w")
@@ -78,3 +82,59 @@ class DebianOS(OS):
 
 def debian(extra_packages: tuple = ()) -> OS:
     return DebianOS(extra_packages)
+
+
+CENTOS_PACKAGES = (
+    # os/centos.clj's toolbox (same roles as the debian list).
+    "curl", "wget", "unzip", "iptables", "iputils", "iproute",
+    "logrotate", "man-db", "net-tools", "ntpdate", "psmisc", "rsyslog",
+    "tar", "vim", "gcc", "glibc-devel", "tcpdump",
+)
+
+
+class CentOS(DebianOS):
+    """yum-based setup (jepsen/src/jepsen/os/centos.clj): same toolbox
+    and hostfile/heal steps as Debian, different package manager."""
+
+    def __init__(self, extra_packages: tuple = ()):
+        self.packages = CENTOS_PACKAGES + tuple(extra_packages)
+
+    def _install(self, sess) -> None:
+        sess.exec(control.Lit(
+            "yum install -y -q " + " ".join(self.packages)
+            + " || (yum makecache -y -q && yum install -y -q "
+            + " ".join(self.packages) + ")"))
+
+
+def centos(extra_packages: tuple = ()) -> OS:
+    return CentOS(extra_packages)
+
+
+class UbuntuOS(DebianOS):
+    """Ubuntu is Debian with the same apt toolbox
+    (jepsen/src/jepsen/os/ubuntu.clj wraps debian's installer)."""
+
+
+def ubuntu(extra_packages: tuple = ()) -> OS:
+    return UbuntuOS(extra_packages)
+
+
+SMARTOS_PACKAGES = ("curl", "wget", "unzip", "gtar", "gcc", "vim")
+
+
+class SmartOS(OS):
+    """pkgin-based setup (jepsen/src/jepsen/os/smartos.clj): minimal
+    toolbox; no iptables (SmartOS uses ipfilter, see net.ipfilter)."""
+
+    def __init__(self, extra_packages: tuple = ()):
+        self.packages = SMARTOS_PACKAGES + tuple(extra_packages)
+
+    def setup(self, test, node):
+        sess = control.current_session().su()
+        log.info("%s setting up smartos", node)
+        sess.exec(control.Lit(
+            "pkgin -y install " + " ".join(self.packages)))
+
+
+def smartos(extra_packages: tuple = ()) -> OS:
+    return SmartOS(extra_packages)

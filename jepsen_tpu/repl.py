@@ -1,0 +1,38 @@
+"""Interactive helpers for poking at stored runs.
+
+Counterpart of jepsen.repl (jepsen/src/jepsen/repl.clj:6-13) plus the
+report/codec odds and ends (report.clj, codec.clj)."""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any
+
+from . import edn
+from .store import Store
+
+
+def last_test(store: Store | str = "store") -> dict | None:
+    """Load the most recently run test (repl.clj:6-13)."""
+    st = store if isinstance(store, Store) else Store(store)
+    d = st.latest()
+    return None if d is None else st.load_test(d)
+
+
+@contextlib.contextmanager
+def to_file(path):
+    """Redirect stdout into a file — the reference's report/to-file
+    macro (report.clj:9-16)."""
+    with open(path, "w") as f, contextlib.redirect_stdout(f):
+        yield f
+
+
+# codec.clj:9-29: EDN <-> bytes.
+def encode(value: Any) -> bytes:
+    return edn.dumps(value).encode("utf-8")
+
+
+def decode(data: bytes | None) -> Any:
+    if data is None:
+        return None
+    return edn.loads(data.decode("utf-8"))

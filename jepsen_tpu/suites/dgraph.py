@@ -94,3 +94,8 @@ def main(argv=None) -> int:
             for w in ([args.workload] if getattr(
                 args, "workload", None) else sorted(workloads()))],
         argv=argv)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -200,3 +200,8 @@ def etcd_test(opts: dict | None = None) -> dict:
 def main(argv=None) -> int:
     """CLI entry: test / analyze / serve (etcd.clj:182-191)."""
     return jcli.run_cli(lambda tmap, args: etcd_test(tmap), argv=argv)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

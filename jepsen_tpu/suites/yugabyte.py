@@ -111,3 +111,8 @@ def main(argv=None) -> int:
             for w in ([args.workload] if getattr(args, "workload", None)
                       else sorted(workloads(tmap)))],
         argv=argv)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

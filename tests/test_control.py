@@ -211,3 +211,34 @@ def test_os_debian_setup_commands():
     assert any("apt-get install" in c for c in cmds)
     assert any("/etc/hosts" in c for c in cmds)
     assert any("iptables -F -w" in c for c in cmds)
+
+
+def test_os_variants_commands():
+    from jepsen_tpu import control, os_setup
+    for factory, needle in ((os_setup.centos, "yum install"),
+                            (os_setup.ubuntu, "apt-get install"),
+                            (os_setup.smartos, "pkgin -y install")):
+        test = {"nodes": ["n1"], "ssh": {"dummy": True}}
+        remote = control.remote_for(test)
+        control.on_nodes(test, factory().setup)
+        cmds = " || ".join(str(p) for _, k, p in remote.actions
+                           if k == "execute")
+        assert needle in cmds, needle
+
+
+def test_repl_last_test_and_codec(tmp_path):
+    from jepsen_tpu import repl
+    from jepsen_tpu.store import Store
+    assert repl.last_test(Store(tmp_path / "empty")) is None
+    st = Store(tmp_path / "store")
+    d = st.base / "t" / "20200101T000000"
+    d.mkdir(parents=True)
+    (d / "history.edn").write_text(
+        '{:type :ok, :process 0, :f :read, :value 1}\n')
+    t = repl.last_test(st)
+    assert t["history"][0]["value"] == 1
+    assert repl.decode(repl.encode({"a": [1, 2]})) == {"a": [1, 2]}
+    out = tmp_path / "r.txt"
+    with repl.to_file(out):
+        print("hello report")
+    assert "hello report" in out.read_text()
