@@ -180,6 +180,15 @@ def _closure_batched(m: jnp.ndarray, steps: int, constrain) -> jnp.ndarray:
     return m
 
 
+# NOTE: an iterated-peeling cycle test (live = adj·live > 0 to fixpoint,
+# O(diameter·T²) matvecs instead of O(log T) T³ matmuls) was prototyped
+# for detect mode but showed no robust end-to-end win on 5k-txn
+# histories: wr/rw edges chain across keys, so real dependency graphs
+# have diameters in the hundreds, and peeling's linear dependence on
+# diameter cancels its cheaper rounds against the closure's logarithmic
+# round count. Keep the fixpoint closure for both modes.
+
+
 def check_batched_impl(appends, reads, invoke_index, complete_index, process,
                        n_live, *, n_keys: int, max_pos: int, n_txns: int,
                        steps: int, classify: bool, realtime: bool,
