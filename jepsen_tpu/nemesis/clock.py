@@ -146,3 +146,43 @@ def clock_gen():
     """Mix of resets, bumps, strobes (time.clj:193-201)."""
     from .. import generator as gen
     return gen.mix([reset_gen, bump_gen, strobe_gen])
+
+
+def set_time(t: float) -> str:
+    """Set the current session's node clock to POSIX time t
+    (nemesis.clj:313-316)."""
+    return control.current_session().su().exec(
+        "date", "+%s", "-s", f"@{int(t)}")
+
+
+class ClockScrambler(Nemesis):
+    """Randomizes every node's clock within a ±dt-second window on each
+    invoke; teardown snaps them back to true time
+    (nemesis.clj:318-333)."""
+
+    fs = frozenset({"scramble"})
+
+    def __init__(self, dt: float):
+        self.dt = dt
+
+    def invoke(self, test, op):
+        import time as _time
+
+        def scramble(t, node):
+            return set_time(_time.time()
+                            + random.randint(-int(self.dt), int(self.dt)))
+
+        value = control.on_nodes(test, scramble)
+        return {**op, "type": "info", "value": value}
+
+    def teardown(self, test):
+        import time as _time
+        try:
+            control.on_nodes(test,
+                             lambda t, n: set_time(_time.time()))
+        except Exception:
+            log.warning("clock scrambler teardown failed", exc_info=True)
+
+
+def clock_scrambler(dt: float) -> Nemesis:
+    return ClockScrambler(dt)

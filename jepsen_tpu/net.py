@@ -100,6 +100,39 @@ class IptablesNet(Net):
         control.on_nodes(test, fast1)
 
 
+class IPFilterNet(IptablesNet):
+    """ipfilter rules for SmartOS nodes (net.clj:116-148): same tc netem
+    slow/flaky/fast as iptables, different drop/heal commands."""
+
+    @staticmethod
+    def _block_rules(sess, srcs) -> str:
+        return "\\n".join(f"block in from {cnet.ip(sess, s)} to any"
+                          for s in srcs)
+
+    def drop(self, test, src, dst):
+        sess = self._sess(test, dst)
+        sess.exec(Lit(
+            f"printf '%b\\n' \"{self._block_rules(sess, [src])}\""
+            f" | ipf -f -"))
+
+    def drop_all(self, test, grudge):
+        # The whole grudge lands in ONE ipf invocation per node so the
+        # partition applies atomically, like the iptables fast path.
+        def apply1(t, node):
+            sess = control.current_session().su()
+            rules = self._block_rules(sess, sorted(grudge.get(node) or ()))
+            sess.exec(Lit(f"printf '%b\\n' \"{rules}\" | ipf -f -"))
+
+        control.on_nodes(test, apply1,
+                         [n for n in grudge if grudge.get(n)])
+
+    def heal(self, test):
+        def heal1(t, node):
+            control.current_session().su().exec("ipf", "-Fa")
+
+        control.on_nodes(test, heal1)
+
+
 class NoopNet(Net):
     """For tests and dummy runs: records grudges on itself."""
 
@@ -124,6 +157,10 @@ class NoopNet(Net):
 
     def fast(self, test):
         pass
+
+
+def ipfilter() -> Net:
+    return IPFilterNet()
 
 
 def iptables() -> Net:

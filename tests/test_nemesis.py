@@ -230,3 +230,31 @@ def test_strobe_time_usage_errors(built_helpers):
     r = subprocess.run([built_helpers["strobe_time"], "10", "5"],
                        capture_output=True)
     assert r.returncode == 2
+
+
+def test_ipfilter_net_commands():
+    from jepsen_tpu import control, net as jnet
+    test = {"nodes": ["n1", "n2"], "ssh": {"dummy": True}}
+    remote = control.remote_for(test)
+    n = jnet.ipfilter()
+    n.drop_all(test, {"n1": ["n2"]})
+    n.heal(test)
+    cmds = [str(p) for _, k, p in remote.actions if k == "execute"]
+    blocks = [c for c in cmds if "ipf -f -" in c and "block in from" in c]
+    assert len(blocks) == 1  # whole grudge in one atomic exec
+    assert any("ipf -Fa" in c for c in cmds)
+
+
+def test_clock_scrambler():
+    from jepsen_tpu import control
+    from jepsen_tpu.nemesis import clock as nclock
+    test = {"nodes": ["n1", "n2"], "ssh": {"dummy": True}}
+    remote = control.remote_for(test)
+    nem = nclock.clock_scrambler(60)
+    op = nem.invoke(test, {"type": "info", "f": "scramble"})
+    assert op["type"] == "info"
+    assert set(op["value"]) == {"n1", "n2"}
+    nem.teardown(test)
+    dates = [str(p) for _, k, p in remote.actions
+             if k == "execute" and "date +%s -s" in str(p)]
+    assert len(dates) == 4  # 2 nodes scrambled + 2 reset
