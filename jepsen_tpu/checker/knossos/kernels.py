@@ -30,6 +30,20 @@ reference applies to Knossos memory blowups
 
 Verdict parity with the CPU WGL engine (`__init__.wgl`) is the
 acceptance criterion; `tests/test_knossos.py` checks it differentially.
+
+Performance characteristics (measured, v5 lite single chip, etcd-shaped
+CAS histories at concurrency 10): the CPU WGL engine wins on *valid*
+histories by an order of magnitude — its depth-first greedy path rarely
+backtracks, while this kernel pays the full frontier cost at every
+completion, and the frontier arena genuinely needs to be large
+(2^concurrency-ish) to avoid overflow. What the device path buys is
+*shape-bound, predictable* cost: WGL degenerates exponentially on
+highly-concurrent or invalid histories (the reference caps its output
+because "writing these can take *hours*", checker.clj:216-219), while
+the frontier walk costs the same whether the history is valid,
+invalid, or adversarial. Hence the checker defaults to CPU and
+`Linearizable(backend="tpu")` is the opt-in bounded-latency engine;
+overflow degrades to "unknown" and re-routes to the CPU oracle.
 """
 
 from __future__ import annotations
