@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...devices import default_devices
+from ...util import pad_to_multiple
 from .encode import EncodedHistory, effective_complete_index
 
 # Flag bit positions in the kernel's output word.
@@ -360,19 +361,23 @@ def check_encoded_batch(encs: list[EncodedHistory],
     """Check a batch of encoded histories on device; returns per-history
     dicts {anomaly-name: True} for the cycle anomalies.
 
-    When several addressable devices exist and divide the batch, the batch
-    axis is sharded across a 1-D mesh — the analysis data plane
-    (SURVEY.md §5.8)."""
+    With several addressable devices the batch axis is sharded across a
+    1-D mesh — the analysis data plane (SURVEY.md §5.8). Ragged batches
+    are padded to a device multiple by replicating the last history (the
+    extra results are dropped), so sharding never silently degrades to
+    one device."""
     if not encs:
         return []
+    n = len(encs)
+    devices = devices if devices is not None else default_devices()
+    encs = pad_to_multiple(encs, len(devices))
     batch = pack_batch(encs)
     shape: BatchShape = batch["shape"]
     names = ("appends", "reads", "invoke_index", "complete_index",
              "process", "n_txns")
     args = [jnp.asarray(batch[k]) for k in names]
 
-    devices = devices if devices is not None else default_devices()
-    if len(devices) > 1 and len(encs) % len(devices) == 0:
+    if len(devices) > 1:
         mesh = jax.sharding.Mesh(np.asarray(devices), ("dp",))
         sharding = jax.sharding.NamedSharding(
             mesh, jax.sharding.PartitionSpec("dp"))
@@ -382,4 +387,4 @@ def check_encoded_batch(encs: list[EncodedHistory],
         *args, n_keys=shape.n_keys, max_pos=shape.max_pos,
         n_txns=shape.n_txns, steps=closure_steps(shape.n_txns),
         classify=classify, realtime=realtime, process_order=process_order)
-    return [flags_to_names(int(w)) for w in np.asarray(flags)]
+    return [flags_to_names(int(w)) for w in np.asarray(flags)[:n]]

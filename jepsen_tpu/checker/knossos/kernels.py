@@ -55,6 +55,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...devices import default_devices
+from ...util import pad_to_multiple
 from .encode import (CAS, COMPLETE_EV, INVOKE_EV, READ, WRITE,
                      EncodedRegisterHistory, RegisterBatchShape,
                      pack_register_batch)
@@ -218,16 +219,20 @@ def check_encoded_batch(encs: list[EncodedRegisterHistory],
     """Check encoded register histories on device. Returns knossos-shaped
     verdicts: {"valid?": True|False|"unknown", "analyzer": "tpu-jit"}.
 
-    Batches shard across addressable devices on a 1-D dp mesh when the
-    batch divides evenly (the analysis data plane, SURVEY.md §5.8)."""
+    Batches shard across addressable devices on a 1-D dp mesh (the
+    analysis data plane, SURVEY.md §5.8); ragged batches are padded to a
+    device multiple by replicating the last history (extras dropped) so
+    sharding never silently degrades to one device."""
     if not encs:
         return []
+    n = len(encs)
+    devices = devices if devices is not None else default_devices()
+    encs = pad_to_multiple(encs, len(devices))
     batch = pack_register_batch(encs)
     shape: RegisterBatchShape = batch["shape"]
     events = jnp.asarray(batch["events"])
 
-    devices = devices if devices is not None else default_devices()
-    if len(devices) > 1 and len(encs) % len(devices) == 0:
+    if len(devices) > 1:
         mesh = jax.sharding.Mesh(np.asarray(devices), ("dp",))
         sharding = jax.sharding.NamedSharding(
             mesh, jax.sharding.PartitionSpec("dp"))
@@ -238,7 +243,7 @@ def check_encoded_batch(encs: list[EncodedRegisterHistory],
     valid = np.asarray(valid)
     overflow = np.asarray(overflow)
     out = []
-    for i, e in enumerate(encs):
+    for i, e in enumerate(encs[:n]):
         if overflow[i]:
             out.append({"valid?": "unknown", "analyzer": "tpu-jit",
                         "cause": ":frontier-overflow"})

@@ -62,7 +62,15 @@ def render_svg(analysis: dict, history: Sequence[dict]) -> str:
     for inv, _ in pairs:
         if inv.get("process") not in procs:
             procs.append(inv.get("process"))
-    procs = procs[:MAX_LANES]
+    if len(procs) > MAX_LANES:
+        keep = procs[:MAX_LANES]
+        if 0 <= bad_pos < len(pairs):
+            # The failing op's lane must survive truncation — it carries
+            # the BAD_COLOR highlight the whole render exists for.
+            bad_proc = pairs[bad_pos][0].get("process")
+            if bad_proc in procs and bad_proc not in keep:
+                keep[-1] = bad_proc
+        procs = keep
     lane = {p: i for i, p in enumerate(procs)}
     idxs = [i.get("index", 0) for i, _ in pairs] + \
         [(c or i).get("index", 0) for i, c in pairs]

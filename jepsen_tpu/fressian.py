@@ -351,6 +351,11 @@ class Writer:
             return self.buf.write(b) and None
         if isinstance(v, datetime.datetime):
             self._w(INST)
+            if v.tzinfo is None:
+                # Codec convention: naive datetimes are UTC wall-clock,
+                # so fields round-trip identically through the UTC-aware
+                # value the reader returns, independent of host tz.
+                v = v.replace(tzinfo=datetime.timezone.utc)
             return self._write_int(int(v.timestamp() * 1000))
         if isinstance(v, (set, frozenset)):
             self._w(SET)

@@ -28,6 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..checker.elle import kernels as K
 from ..devices import default_devices
+from ..util import pad_to_multiple
 
 
 def factor2(n: int) -> tuple[int, int]:
@@ -137,11 +138,15 @@ def check_long_history(enc, mesh: Mesh | None = None, *,
 # ---------------------------------------------------------------------------
 
 def bucket_by_length(encs: Sequence, *, multiple: int = 128,
-                     budget_cells: int = 1 << 27) -> list[list[int]]:
+                     budget_cells: int = 1 << 27,
+                     dp: int = 1) -> list[list[int]]:
     """Partition history indices into buckets of similar padded txn
-    count. Each bucket satisfies B * T_pad² <= budget_cells (T_pad the
-    bucket max, rounded up to `multiple`). Returns buckets of indices
-    into encs, longest histories first."""
+    count. Each bucket satisfies B_pad * T_pad² <= budget_cells, where
+    T_pad is the bucket max rounded up to `multiple` and B_pad is the
+    bucket size rounded up to a multiple of `dp` (check_bucketed pads
+    ragged buckets to a dp multiple, so that headroom must be budgeted
+    here, not discovered at dispatch). Returns buckets of indices into
+    encs, longest histories first."""
     order = sorted(range(len(encs)), key=lambda i: -encs[i].n)
     buckets: list[list[int]] = []
     cur: list[int] = []
@@ -149,7 +154,8 @@ def bucket_by_length(encs: Sequence, *, multiple: int = 128,
     for i in order:
         tpad = max(K.pad_to(max(encs[i].n, 1), multiple), 1)
         t = max(cur_tpad, tpad)
-        if cur and (len(cur) + 1) * t * t > budget_cells:
+        b_pad = -(-(len(cur) + 1) // dp) * dp
+        if cur and b_pad * t * t > budget_cells:
             buckets.append(cur)
             cur, cur_tpad = [], 0
             t = tpad
@@ -169,21 +175,29 @@ def check_bucketed(encs: Sequence, mesh: Mesh | None = None, *,
     if not len(encs):
         return []
     out: list[dict | None] = [None] * len(encs)
-    for bucket in bucket_by_length(encs, budget_cells=budget_cells):
+    dp = mesh.devices.shape[0] if mesh is not None else 1
+    for bucket in bucket_by_length(encs, budget_cells=budget_cells, dp=dp):
         group = [encs[i] for i in bucket]
+        bucket_mesh = mesh
         if mesh is not None:
             # Pad ragged buckets to a dp multiple by replicating the
             # last history (results dropped below) so the dispatch still
-            # shards across the mesh instead of falling to one device.
-            dp = mesh.devices.shape[0]
-            while len(group) % dp:
-                group.append(group[-1])
+            # shards across the mesh instead of falling to one device —
+            # unless the padding itself would blow the budget (a single
+            # history bigger than budget/dp), in which case dispatch
+            # unsharded rather than 8x over budget.
+            tpad = max(K.pad_to(max(e.n for e in group), 128), 1)
+            padded = pad_to_multiple(group, dp)
+            if len(padded) * tpad * tpad <= budget_cells:
+                group = padded
+            else:
+                bucket_mesh = None
         shape = K.BatchShape.plan(group)
         packed = K.pack_batch(group, shape)
-        fn = sharded_check_fn(mesh, shape, classify=classify,
+        fn = sharded_check_fn(bucket_mesh, shape, classify=classify,
                               realtime=realtime,
                               process_order=process_order)
-        args = shard_batch(mesh, packed)
+        args = shard_batch(bucket_mesh, packed)
         flags = np.asarray(jax.block_until_ready(fn(*args)))
         for i, w in zip(bucket, flags):
             out[i] = K.flags_to_names(int(w))

@@ -163,6 +163,16 @@ def longest_common_prefix(seqs: Sequence[Sequence]) -> list:
     return list(shortest)
 
 
+def pad_to_multiple(xs: Sequence[T], k: int) -> list[T]:
+    """xs extended to a multiple of k by replicating its last element —
+    the dp-sharding pad for ragged device batches (callers drop the
+    replica results past len(xs))."""
+    xs = list(xs)
+    if k > 1 and xs and len(xs) % k:
+        xs += [xs[-1]] * (-len(xs) % k)
+    return xs
+
+
 def chunk_vec(n: int, xs: Sequence[T]) -> list[list[T]]:
     """Split xs into chunks of at most n elements."""
     return [list(xs[i : i + n]) for i in range(0, len(xs), n)]

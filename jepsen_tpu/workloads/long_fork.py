@@ -36,43 +36,47 @@ class LongForkGen(gen.Generator):
     """Single inserts followed by group reads from the same worker, mixed
     with reads of other in-flight groups (Generator long_fork.clj:116-151).
 
-    State: next_key counter + {worker: last-written-key}."""
+    State: next_key counter + {worker: last-written-key} + a step seed.
+    Randomness is derived afresh from the seed each op() so a re-invoked
+    op() on the same state yields the same op (pure-generator contract);
+    every successor state carries seed+1."""
 
     def __init__(self, n: int, next_key: int = 0,
-                 workers: dict | None = None, seed: int | None = None,
-                 rng: random.Random | None = None):
+                 workers: dict | None = None, seed: int = 0):
         self.n = n
         self.next_key = next_key
         self.workers = workers or {}
-        self.rng = rng or random.Random(seed)
+        self.seed = seed
 
     def op(self, test, ctx):
         worker = next((t for t in ctx.free_threads if t != gen.NEMESIS), None)
         if worker is None:
             return gen.PENDING, self
+        rng = random.Random(f"long-fork:{self.seed}:{worker}")
         process = ctx.thread_to_process(worker)
         k = self.workers.get(worker)
         if k is not None:
             # We wrote a key: read its group and clear our slot.
             o = gen.fill_in_op(
                 {"process": process, "f": "read",
-                 "value": read_txn_for(self.n, k, self.rng)}, ctx)
+                 "value": read_txn_for(self.n, k, rng)}, ctx)
             return o, LongForkGen(self.n, self.next_key,
                                   {**self.workers, worker: None},
-                                  rng=self.rng)
+                                  seed=self.seed + 1)
         active = [v for v in self.workers.values() if v is not None]
-        if active and self.rng.random() < 0.5:
-            k = self.rng.choice(active)
+        if active and rng.random() < 0.5:
+            k = rng.choice(active)
             o = gen.fill_in_op(
                 {"process": process, "f": "read",
-                 "value": read_txn_for(self.n, k, self.rng)}, ctx)
-            return o, self
+                 "value": read_txn_for(self.n, k, rng)}, ctx)
+            return o, LongForkGen(self.n, self.next_key, self.workers,
+                                  seed=self.seed + 1)
         o = gen.fill_in_op(
             {"process": process, "f": "write",
              "value": [["w", self.next_key, 1]]}, ctx)
         return o, LongForkGen(self.n, self.next_key + 1,
                               {**self.workers, worker: self.next_key},
-                              rng=self.rng)
+                              seed=self.seed + 1)
 
 
 def generator(n: int = 2) -> gen.Generator:

@@ -200,7 +200,10 @@ static int ff_utimens(const char* path, const struct timespec tv[2]) {
 }
 
 static int ff_open(const char* path, struct fuse_file_info* fi) {
-  if (is_ctl(path)) return 0;
+  if (is_ctl(path)) {
+    fi->fh = static_cast<uint64_t>(-1);
+    return 0;
+  }
   FAULT_GATE();
   int fd = open(real_path(path).c_str(), fi->flags);
   if (fd < 0) return -errno;
@@ -210,7 +213,10 @@ static int ff_open(const char* path, struct fuse_file_info* fi) {
 
 static int ff_create(const char* path, mode_t mode,
                      struct fuse_file_info* fi) {
-  if (is_ctl(path)) return 0;
+  if (is_ctl(path)) {
+    fi->fh = static_cast<uint64_t>(-1);
+    return 0;
+  }
   FAULT_GATE();
   int fd = open(real_path(path).c_str(), fi->flags, mode);
   if (fd < 0) return -errno;
@@ -258,6 +264,7 @@ static int ff_release(const char* path, struct fuse_file_info* fi) {
 
 static int ff_fsync(const char* path, int datasync,
                     struct fuse_file_info* fi) {
+  if (is_ctl(path)) return 0;
   FAULT_GATE();
   int fd = static_cast<int>(fi->fh);
   int r = datasync ? fdatasync(fd) : fsync(fd);
