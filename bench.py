@@ -1,18 +1,23 @@
-"""Benchmark: Elle list-append cycle checking throughput on device.
+"""Benchmark: the north-star metrics (BASELINE.json) on real hardware.
 
-Measures the north-star metric (BASELINE.json): histories checked per
-second for 10k-op (≈5k-txn) list-append histories. The device phase under
-test is the full dependency-edge build + transitive-closure cycle
-detection (detect mode: one closure per history — the common all-valid
-path; classification of cyclic histories is a second pass over the rare
-positives).
+Two device phases are timed:
 
-Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": "histories/sec", "vs_baseline": N}
+1. Elle list-append: histories checked per second for 10k-op (≈5k-txn)
+   histories — dependency-edge build + transitive-closure cycle
+   detection (detect mode: one closure per history, the common
+   all-valid path; classification of cyclic histories is a second pass
+   over the rare positives).
+2. Knossos CAS: wall-clock for a batch of etcd-shaped 1k-op CAS
+   register subhistories (concurrency 10) through the dense-bitset
+   linearizability kernel, vs the CPU WGL engine on the same batch —
+   BASELINE.json's "Knossos CAS wall-clock".
 
-vs_baseline is measured against the north-star rate of 10,000 histories /
-60 s = 166.7 hist/s on a v5e-8; on a single chip the fair share is 1/8 of
-that (20.8 hist/s). Scale via BENCH_B / BENCH_T / BENCH_K env vars.
+Prints exactly ONE JSON line. The primary metric is the Elle rate
+(vs_baseline = measured / north-star fair-share rate); the Knossos
+numbers ride along under "knossos" with their own speedup-vs-CPU.
+
+Scale via env vars: BENCH_B/BENCH_T/BENCH_K (elle), BENCH_KN_B/
+BENCH_KN_OPS/BENCH_KN_CONC (knossos), BENCH_REPS.
 """
 
 from __future__ import annotations
@@ -23,22 +28,16 @@ import sys
 import time
 
 
-def main() -> int:
+def bench_elle(n_dev: int, devices, reps: int) -> dict:
     import jax
     import numpy as np
 
     from jepsen_tpu import parallel
     from jepsen_tpu.checker.elle import synth
-    from jepsen_tpu.devices import default_devices
 
-    devices = default_devices()
-    n_dev = len(devices)
-    # Default shape: 10k-op histories (5k txns) like the north-star config;
-    # batch sized to amortize dispatch while fitting one chip's HBM.
     B = int(os.environ.get("BENCH_B", 8 * max(1, n_dev)))
     T = int(os.environ.get("BENCH_T", 5000))
     K = int(os.environ.get("BENCH_K", 64))
-    reps = int(os.environ.get("BENCH_REPS", 3))
 
     batch = synth.synth_valid_batch(B=B, T=T, K=K, seed=0)
     shape = batch["shape"]
@@ -46,7 +45,6 @@ def main() -> int:
     fn = parallel.sharded_check_fn(mesh, shape, classify=False)
     args = parallel.shard_batch(mesh, batch)
 
-    # Compile + warmup.
     flags = np.asarray(jax.block_until_ready(fn(*args)))
     assert (flags == 0).all(), "valid histories flagged cyclic"
 
@@ -57,13 +55,62 @@ def main() -> int:
         best = min(best, time.perf_counter() - t0)
 
     rate = B / best
-    target = 10_000 / 60.0 * (n_dev / 8.0)  # north-star scaled to chip count
-    print(json.dumps({
+    target = 10_000 / 60.0 * (n_dev / 8.0)  # north-star, chip-scaled
+    return {
         "metric": f"elle-append histories/sec ({T}-txn, {n_dev} dev)",
         "value": round(rate, 2),
         "unit": "histories/sec",
         "vs_baseline": round(rate / target, 3),
-    }))
+    }
+
+
+def bench_knossos(reps: int) -> dict:
+    from jepsen_tpu.checker import models
+    from jepsen_tpu.checker.knossos import analysis, dense, synth
+
+    B = int(os.environ.get("BENCH_KN_B", 100))
+    OPS = int(os.environ.get("BENCH_KN_OPS", 1000))
+    CONC = int(os.environ.get("BENCH_KN_CONC", 10))
+
+    hists = synth.synth_register_batch(
+        B=B, n_ops=OPS, n_procs=CONC, info_prob=0.0, seed=1)
+    encs = [dense.encode_dense_history(h) for h in hists]
+
+    res = dense.check_encoded_dense_batch(encs)  # compile + warmup
+    assert all(r["valid?"] is True for r in res), "synth histories invalid"
+    best_tpu = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        dense.check_encoded_dense_batch(encs)
+        best_tpu = min(best_tpu, time.perf_counter() - t0)
+
+    t0 = time.perf_counter()
+    for h in hists:
+        analysis(models.cas_register(), h)
+    t_cpu = time.perf_counter() - t0
+
+    return {
+        "metric": f"knossos-cas histories/sec ({OPS}-op, conc {CONC})",
+        "tpu": round(B / best_tpu, 2),
+        "cpu_wgl": round(B / t_cpu, 2),
+        "unit": "histories/sec",
+        "speedup_vs_cpu": round(t_cpu / best_tpu, 3),
+    }
+
+
+def main() -> int:
+    from jepsen_tpu.devices import default_devices
+
+    devices = default_devices()
+    n_dev = len(devices)
+    reps = int(os.environ.get("BENCH_REPS", 3))
+
+    out = bench_elle(n_dev, devices, reps)
+    try:
+        out["knossos"] = bench_knossos(reps)
+    except Exception as e:  # elle metric must still report
+        out["knossos"] = {"error": repr(e)[:200]}
+    print(json.dumps(out))
     return 0
 
 

@@ -524,18 +524,17 @@ class Linearizable(Checker):
     `model` is a `models.Model` (immutable; step returns a successor).
     `algorithm` mirrors knossos: "wgl" | "linear" | "competition"; on
     this build all CPU routes share the WGL engine and the `linear`
-    config-space search is the TPU kernel, selected with backend="tpu"
-    (register/cas histories only; anything unencodable falls back to
-    CPU, as does a frontier overflow — verdicts only ever degrade to
-    the oracle, never diverge from it)."""
+    config-space search is the TPU dense-bitset kernel, selected with
+    backend="tpu". The device route is taken only for the model it
+    implements (a fresh CAS register) on histories that fit its
+    slot/value grid; everything else falls back to the CPU engine, so
+    verdicts only ever degrade to the oracle, never diverge from it."""
 
     def __init__(self, m: model.Model | None = None,
-                 algorithm: str = "competition", backend: str = "cpu",
-                 frontier: int = 512):
+                 algorithm: str = "competition", backend: str = "cpu"):
         self.model = m if m is not None else model.cas_register()
         self.algorithm = algorithm
         self.backend = backend
-        self.frontier = frontier
 
     def _cpu(self, history: list) -> dict:
         from . import knossos
@@ -566,29 +565,34 @@ class Linearizable(Checker):
     def check_batch(self, test, histories: list[list], opts) -> list[dict]:
         """Check many histories at once — the TPU batch path used by
         `independent.checker` to shard per-key subhistories across the
-        device mesh instead of pmapping JVM threads."""
-        if self.backend != "tpu":
+        device mesh instead of pmapping JVM threads.
+
+        The device engine is the dense-bitset config-grid kernel
+        (`.knossos.dense`) — exact verdicts, no frontier overflow;
+        histories that exceed its slot/value grid budget (or aren't
+        register-shaped at all) fall back to the CPU WGL oracle. The
+        kernel implements CAS-register semantics from a nil initial
+        state, so any other model routes to CPU wholesale."""
+        if self.backend != "tpu" or not (
+                type(self.model) is model.CASRegister
+                and self.model.value is None):
             return [self._cpu(hs) for hs in histories]
-        from . import knossos
+        from .knossos import dense
         from .knossos import encode as kenc
-        from .knossos import kernels as kker
         encs = []
         cpu_idx = []
         enc_idx = []
         for i, hs in enumerate(histories):
             try:
-                encs.append(kenc.encode_register_history(hs))
+                encs.append(dense.encode_dense_history(hs))
                 enc_idx.append(i)
             except kenc.EncodingError:
                 cpu_idx.append(i)
         results: list[dict | None] = [None] * len(histories)
         if encs:
-            for i, r in zip(enc_idx, kker.check_encoded_batch(
-                    encs, frontier=self.frontier)):
-                if r["valid?"] == "unknown":
-                    cpu_idx.append(i)
-                else:
-                    results[i] = r
+            for i, r in zip(enc_idx,
+                            dense.check_encoded_dense_batch(encs)):
+                results[i] = r
         for i in cpu_idx:
             results[i] = self._cpu(histories[i])
         return results  # type: ignore[return-value]

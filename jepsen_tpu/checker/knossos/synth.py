@@ -1,0 +1,104 @@
+"""Synthetic register histories for benchmarks and differential tests.
+
+Simulates a real atomic register: each operation takes effect at one
+instant between its invocation and completion, so generated histories
+are linearizable by construction — the Knossos analogue of
+`..elle.synth` for list-append. `corrupt` flips one ok-read's value,
+which (almost always) breaks linearizability.
+
+Shapes mirror the etcd suite's independent CAS registers
+(etcd/src/jepsen/etcd.clj:149-180: 10 threads/key, a few hundred ops
+per key) so benchmark batches look like real per-key subhistories.
+"""
+
+from __future__ import annotations
+
+import random
+
+
+def _op(type_: str, process: int, f: str, value=None) -> dict:
+    return {"type": type_, "process": process, "f": f, "value": value}
+
+
+def synth_register_history(n_ops: int = 100, n_procs: int = 10,
+                           n_values: int = 5, info_prob: float = 0.02,
+                           seed: int = 0) -> list[dict]:
+    """One linearizable register history: `n_ops` read/write/cas ops
+    from `n_procs` concurrent processes."""
+    rng = random.Random(f"knossos-synth:{seed}")
+    hist: list[dict] = []
+    value = None
+    free = list(range(n_procs))
+    pending: list[list] = []  # [process, op, applied?, result]
+    ops_left = n_ops
+    while ops_left > 0 or pending:
+        choices = []
+        if free and ops_left > 0:
+            choices.append("invoke")
+        if any(not p[2] for p in pending):
+            choices.append("apply")
+        if any(p[2] for p in pending):
+            choices.append("complete")
+        action = rng.choice(choices)
+        if action == "invoke":
+            p = free.pop(rng.randrange(len(free)))
+            f = rng.choice(["read", "write", "cas"])
+            if f == "read":
+                o = _op("invoke", p, "read")
+            elif f == "write":
+                o = _op("invoke", p, "write", rng.randrange(n_values))
+            else:
+                o = _op("invoke", p, "cas",
+                        [rng.randrange(n_values), rng.randrange(n_values)])
+            hist.append(o)
+            pending.append([p, o, False, None])
+            ops_left -= 1
+        elif action == "apply":
+            ent = rng.choice([p for p in pending if not p[2]])
+            f, v = ent[1]["f"], ent[1]["value"]
+            if f == "read":
+                ent[3] = ("ok", value)
+            elif f == "write":
+                value = v
+                ent[3] = ("ok", v)
+            else:
+                old, new = v
+                if old == value:
+                    value = new
+                    ent[3] = ("ok", v)
+                else:
+                    ent[3] = ("fail", v)
+            ent[2] = True
+        else:
+            ent = rng.choice([p for p in pending if p[2]])
+            pending.remove(ent)
+            p, o = ent[0], ent[1]
+            if rng.random() < info_prob:
+                hist.append(_op("info", p, o["f"], o["value"]))
+            else:
+                t, rv = ent[3]
+                hist.append(_op(t, p, o["f"], rv))
+            free.append(p)
+    return hist
+
+
+def corrupt(hist: list[dict], seed: int = 0) -> list[dict]:
+    """Flip one ok read's value — usually breaking linearizability."""
+    rng = random.Random(f"knossos-corrupt:{seed}")
+    hist = [dict(o) for o in hist]
+    reads = [o for o in hist if o["type"] == "ok" and o["f"] == "read"]
+    if reads:
+        o = rng.choice(reads)
+        o["value"] = (o["value"] or 0) + 7
+    return hist
+
+
+def synth_register_batch(B: int = 100, n_ops: int = 500,
+                         n_procs: int = 10, n_values: int = 5,
+                         info_prob: float = 0.02,
+                         seed: int = 0) -> list[list[dict]]:
+    """B independent per-key subhistories, etcd-shaped."""
+    return [synth_register_history(n_ops=n_ops, n_procs=n_procs,
+                                   n_values=n_values, info_prob=info_prob,
+                                   seed=seed * 10_000 + i)
+            for i in range(B)]

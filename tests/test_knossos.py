@@ -13,6 +13,7 @@ from jepsen_tpu.checker import linearizable, models
 from jepsen_tpu.checker import knossos
 from jepsen_tpu.checker.knossos import encode as kenc
 from jepsen_tpu.checker.knossos import kernels as kker
+from jepsen_tpu.checker.knossos import synth as ksynth
 
 
 def op(type, process, f, value=None, **kw):
@@ -121,76 +122,15 @@ class TestWGL:
 
 def random_register_history(rng: random.Random, n_ops=25, n_procs=4,
                             n_values=4, info_prob=0.08):
-    """Simulate a real atomic register: each op takes effect at one
-    instant between invoke and complete, so the history is linearizable
-    by construction."""
-    hist = []
-    value = None
-    free = list(range(n_procs))
-    pending = []  # [process, op, applied?, result]
-    ops_left = n_ops
-    while ops_left > 0 or pending:
-        choices = []
-        if free and ops_left > 0:
-            choices.append("invoke")
-        if any(not p[2] for p in pending):
-            choices.append("apply")
-        if any(p[2] for p in pending):
-            choices.append("complete")
-        action = rng.choice(choices)
-        if action == "invoke":
-            p = free.pop(rng.randrange(len(free)))
-            f = rng.choice(["read", "write", "cas"])
-            if f == "read":
-                o = op("invoke", p, "read")
-            elif f == "write":
-                o = op("invoke", p, "write", rng.randrange(n_values))
-            else:
-                o = op("invoke", p, "cas",
-                       [rng.randrange(n_values), rng.randrange(n_values)])
-            hist.append(o)
-            pending.append([p, o, False, None])
-            ops_left -= 1
-        elif action == "apply":
-            cand = [p for p in pending if not p[2]]
-            ent = rng.choice(cand)
-            f, v = ent[1]["f"], ent[1]["value"]
-            if f == "read":
-                ent[3] = ("ok", value)
-            elif f == "write":
-                value = v
-                ent[3] = ("ok", v)
-            else:
-                old, new = v
-                if old == value:
-                    value = new
-                    ent[3] = ("ok", v)
-                else:
-                    ent[3] = ("fail", v)
-            ent[2] = True
-        else:
-            cand = [p for p in pending if p[2]]
-            ent = rng.choice(cand)
-            pending.remove(ent)
-            p, o = ent[0], ent[1]
-            if rng.random() < info_prob:
-                hist.append(op("info", p, o["f"], o["value"]))
-            else:
-                t, rv = ent[3]
-                hist.append(op(t, p, o["f"], rv))
-            free.append(p)
-    return hist
+    """Thin adapter over the package simulator (knossos.synth) so test
+    call sites can keep threading one rng."""
+    return ksynth.synth_register_history(
+        n_ops=n_ops, n_procs=n_procs, n_values=n_values,
+        info_prob=info_prob, seed=rng.randrange(1 << 30))
 
 
 def corrupt(rng: random.Random, hist):
-    """Flip one ok read's value — usually breaking linearizability."""
-    hist = [dict(o) for o in hist]
-    reads = [o for o in hist
-             if o["type"] == "ok" and o["f"] == "read"]
-    if reads:
-        o = rng.choice(reads)
-        o["value"] = (o["value"] or 0) + 7
-    return hist
+    return ksynth.corrupt(hist, seed=rng.randrange(1 << 30))
 
 
 class TestRandomHistories:
@@ -358,3 +298,70 @@ class TestIndependentGenerators:
         assert len(r_tpu["results"]) == 3
         assert {k: v["valid?"] for k, v in r_tpu["results"].items()} == \
                {k: v["valid?"] for k, v in r_cpu["results"].items()}
+
+
+# ---------------------------------------------------------------------------
+# Dense-bitset kernel (the default TPU engine): exact verdicts over the
+# full configuration grid — differential vs the WGL oracle.
+# ---------------------------------------------------------------------------
+
+class TestDenseKernel:
+    def test_golden_verdicts(self):
+        from jepsen_tpu.checker.knossos import dense
+        encs = [dense.encode_dense_history(h)
+                for h, _ in TestKernelParity.GOLDENS]
+        results = dense.check_encoded_dense_batch(encs)
+        for (h, expect), r in zip(TestKernelParity.GOLDENS, results):
+            assert r["valid?"] is expect, (h, r)
+            assert r["analyzer"] == "tpu-dense"
+
+    def test_differential_random_with_infos(self):
+        from jepsen_tpu.checker.knossos import dense
+        rng = random.Random(41)
+        hists = [random_register_history(rng, n_ops=25, n_procs=4,
+                                         info_prob=0.15)
+                 for _ in range(10)]
+        hists += [corrupt(rng, random_register_history(
+            rng, n_ops=25, n_procs=4, info_prob=0.0)) for _ in range(10)]
+        cpu = [knossos.wgl(CASR, h)["valid?"] for h in hists]
+        encs = [dense.encode_dense_history(h) for h in hists]
+        tpu = [r["valid?"] for r in dense.check_encoded_dense_batch(encs)]
+        assert cpu == tpu
+
+    def test_info_reads_are_dropped(self):
+        from jepsen_tpu.checker.knossos import dense
+        h = [op("invoke", 0, "write", 1), op("ok", 0, "write", 1),
+             op("invoke", 1, "read"), op("info", 1, "read"),
+             op("invoke", 2, "read"), op("ok", 2, "read", 1)]
+        e = dense.encode_dense_history(h)
+        assert e.n_ops == 2          # the info read contributes no slot
+        assert e.n_slots <= 2
+        assert dense.check_encoded_dense_batch([e])[0]["valid?"] is True
+
+    def test_slot_buckets_mixed_concurrency(self):
+        from jepsen_tpu.checker.knossos import dense
+        rng = random.Random(5)
+        lo = [random_register_history(rng, n_ops=12, n_procs=2)
+              for _ in range(3)]
+        hi = [random_register_history(rng, n_ops=12, n_procs=6)
+              for _ in range(3)]
+        hists = [h for pair in zip(lo, hi) for h in pair]
+        encs = [dense.encode_dense_history(h) for h in hists]
+        assert len({e.n_slots for e in encs}) > 1
+        res = dense.check_encoded_dense_batch(encs)
+        assert [r["valid?"] for r in res] == \
+               [knossos.wgl(CASR, h)["valid?"] for h in hists]
+
+    def test_slot_budget_exceeded_raises(self):
+        from jepsen_tpu.checker.knossos import dense
+        h = [op("invoke", p, "write", p) for p in range(6)]
+        h += [op("ok", p, "write", p) for p in range(6)]
+        with pytest.raises(kenc.EncodingError):
+            dense.encode_dense_history(h, max_slots=4)
+
+    def test_checker_tpu_backend_uses_dense(self):
+        good = pairs_history((0, "write", 1, "ok"), (0, "read", 1, "ok"))
+        c = linearizable(CASR, backend="tpu")
+        r = c.check_batch({}, [good], {})[0]
+        assert r["valid?"] is True
+        assert r["analyzer"] == "tpu-dense"
