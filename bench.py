@@ -98,6 +98,37 @@ def bench_knossos(reps: int) -> dict:
     }
 
 
+def bench_long_history(reps: int) -> dict:
+    """100k-op single-history path (BASELINE config #5): SCC-condensed
+    check of a 50k-txn history — valid (the common case, pure host) and
+    with an injected cycle (device classify over the SCC)."""
+    from jepsen_tpu import parallel
+    from jepsen_tpu.checker.elle import synth
+
+    T = int(os.environ.get("BENCH_LONG_T", 50_000))
+    enc = synth.synth_encoded_history(T, K=64)
+    enc_bad = synth.synth_encoded_history(T, K=64, inject_cycle=True)
+
+    best = float("inf")
+    for _ in range(max(reps, 2)):
+        t0 = time.perf_counter()
+        flags = parallel.check_long_history(enc, realtime=True,
+                                            process_order=True)
+        best = min(best, time.perf_counter() - t0)
+    assert flags == {}, flags
+    flags = parallel.check_long_history(enc_bad)  # compile+classify
+    assert "G1c" in flags, flags
+    t0 = time.perf_counter()
+    parallel.check_long_history(enc_bad)
+    t_bad = time.perf_counter() - t0
+    return {
+        "metric": f"single {T}-txn history wall-clock (condensed)",
+        "valid_secs": round(best, 4),
+        "cyclic_secs": round(t_bad, 4),
+        "unit": "seconds",
+    }
+
+
 def main() -> int:
     from jepsen_tpu.devices import default_devices
 
@@ -110,6 +141,10 @@ def main() -> int:
         out["knossos"] = bench_knossos(reps)
     except Exception as e:  # elle metric must still report
         out["knossos"] = {"error": repr(e)[:200]}
+    try:
+        out["long_history"] = bench_long_history(reps)
+    except Exception as e:
+        out["long_history"] = {"error": repr(e)[:200]}
     print(json.dumps(out))
     return 0
 

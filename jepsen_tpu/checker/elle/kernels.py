@@ -327,19 +327,33 @@ def pack_edge_matrices(per_history: list[dict], multiple: int = 128) -> dict:
 
 def check_edge_batch(per_history: list[dict], realtime: bool = False,
                      process_order: bool = False,
-                     classify: bool = True) -> list[dict]:
+                     classify: bool = True, devices=None) -> list[dict]:
     """Device cycle check over host-built edge lists: per-history
-    {anomaly-name: True} dicts (the rw-register device path)."""
+    {anomaly-name: True} dicts (the rw-register device path, and the
+    per-SCC classify stage of the condensed long-history path).
+
+    With several devices the batch axis shards over a 1-D dp mesh,
+    ragged batches padded by replicating the last entry."""
     if not per_history:
         return []
+    n = len(per_history)
+    devices = devices if devices is not None else default_devices()
+    per_history = pad_to_multiple(per_history, len(devices))
     p = pack_edge_matrices(per_history)
+    names = ("ww", "wr", "rw", "invoke_index", "complete_index",
+             "process", "n_txns")
+    args = [jnp.asarray(p[k]) for k in names]
+    if len(devices) > 1:
+        mesh = jax.sharding.Mesh(np.asarray(devices), ("dp",))
+        sharding = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec("dp"))
+        args = [jax.device_put(a, sharding) for a in args]
+    elif devices:
+        args = [jax.device_put(a, devices[0]) for a in args]
     flags = classify_matrices_device(
-        jnp.asarray(p["ww"]), jnp.asarray(p["wr"]), jnp.asarray(p["rw"]),
-        jnp.asarray(p["invoke_index"]), jnp.asarray(p["complete_index"]),
-        jnp.asarray(p["process"]), jnp.asarray(p["n_txns"]),
-        steps=closure_steps(p["T"]), classify=classify, realtime=realtime,
-        process_order=process_order)
-    return [flags_to_names(int(w)) for w in np.asarray(flags)]
+        *args, steps=closure_steps(p["T"]), classify=classify,
+        realtime=realtime, process_order=process_order)
+    return [flags_to_names(int(w)) for w in np.asarray(flags)[:n]]
 
 
 def flags_to_names(word: int) -> dict:

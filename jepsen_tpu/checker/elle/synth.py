@@ -91,6 +91,35 @@ def _pad_axis(a: np.ndarray, n: int, fill: int = 0) -> np.ndarray:
     return out
 
 
+def synth_encoded_history(T: int, K: int = 64, concurrency: int = 10,
+                          inject_cycle: bool = False):
+    """A T-txn serial EncodedHistory straight from numpy — the
+    100k-op-scale sibling of synth_append_history (no per-op dicts):
+    txn i appends (key i%K, pos i//K+1) and externally reads a key it
+    has seen. With ``inject_cycle``, one read observes its key one
+    position ahead, creating a ww/wr (G1c) cycle."""
+    from .encode import EncodedHistory
+
+    i = np.arange(T, dtype=np.int32)
+    appends = np.stack([i, i % K, i // K + 1], axis=-1)
+    r_key = (i * 7 + 3) % K
+    first = r_key.astype(np.int64)
+    r_pos = np.where(i.astype(np.int64) > first,
+                     (i - 1 - first) // K + 1, 0).astype(np.int32)
+    reads = np.stack([i, r_key, r_pos], axis=-1)
+    if inject_cycle:
+        a = T // 2
+        reads[a, 1] = appends[a, 1]
+        reads[a, 2] = appends[a, 2] + 1
+    return EncodedHistory(
+        n=T, n_keys=K, max_pos=int(appends[:, 2].max()) + 1,
+        appends=appends.astype(np.int32), reads=reads.astype(np.int32),
+        status=np.zeros(T, np.int32),
+        process=(i % concurrency).astype(np.int32),
+        invoke_index=(2 * i).astype(np.int64),
+        complete_index=(2 * i + 1).astype(np.int64))
+
+
 def synth_append_history(T: int, K: int, seed: int = 0,
                          g1c: bool = False,
                          concurrency: int = 5) -> list[dict]:

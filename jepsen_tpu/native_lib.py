@@ -116,6 +116,29 @@ def tarjan_scc(n: int, adj: list[list[int]]) -> list[int] | None:
     return out.tolist()
 
 
+def tarjan_scc_csr(n: int, row_ptr: np.ndarray,
+                   col: np.ndarray) -> np.ndarray | None:
+    """SCC ids straight from CSR arrays (the 100k-node condensation path
+    — no Python adjacency lists in between). Returns int64 [n] or None
+    when the kernel is unavailable or the CSR is malformed."""
+    L = lib()
+    if L is None:
+        return None
+    if n == 0:
+        return np.zeros(0, np.int64)
+    row_ptr = np.ascontiguousarray(row_ptr, np.int64)
+    col = np.ascontiguousarray(col, np.int64)
+    if len(row_ptr) != n + 1 or int(row_ptr[-1]) != len(col):
+        return None
+    if int(row_ptr[0]) != 0 or np.any(np.diff(row_ptr) < 0):
+        return None
+    if col.size and (col.min() < 0 or col.max() >= n):
+        return None
+    out = np.empty(n, np.int64)
+    L.jt_tarjan_scc(n, _p(row_ptr), _p(col), _p(out))
+    return out
+
+
 def reach(n: int, adj: list[list[int]],
           queries: list[tuple[int, int]]) -> list[bool] | None:
     """Batch src->dst reachability via the C++ kernel, or None."""

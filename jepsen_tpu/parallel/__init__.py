@@ -113,14 +113,30 @@ def sp_mesh(devices: Sequence | None = None) -> Mesh:
     return Mesh(np.asarray(devices).reshape(1, len(devices)), ("dp", "mp"))
 
 
+# Above this txn count the dense [T,T] closure no longer fits a slice's
+# HBM; check_long_history switches to SCC condensation (elle.condense).
+DENSE_TXN_LIMIT = 32_768
+
+
 def check_long_history(enc, mesh: Mesh | None = None, *,
                        classify: bool = True, realtime: bool = False,
-                       process_order: bool = False) -> dict:
-    """Check ONE long encoded history with its op axis sharded across
-    the mesh; returns {anomaly: True} flags. Dense closure means HBM
-    bounds T — beyond ~32k txns on a v5e-8 slice, fall back to the
-    host-side graph path (native Tarjan), mirroring the reference's
-    key-decomposition pragmatism (independent.clj:1-7)."""
+                       process_order: bool = False,
+                       dense_limit: int = DENSE_TXN_LIMIT) -> dict:
+    """Check ONE long encoded history; returns {anomaly: True} flags.
+
+    Up to `dense_limit` txns: the dense closure with the op axis
+    column-sharded across the mesh (the CP analogue). Beyond it: host
+    SCC condensation (vectorized edge build + native Tarjan) feeding
+    the device classification kernel per nontrivial SCC — the 100k-op
+    path (BASELINE config #5), exact by SCC-locality of every anomaly
+    query (elle/condense.py module doc)."""
+    if enc.n > dense_limit:
+        from ..checker.elle import condense
+        return condense.check_condensed(
+            enc, classify=classify, realtime=realtime,
+            process_order=process_order,
+            devices=(list(mesh.devices.flat) if mesh is not None
+                     else None))
     mesh = mesh if mesh is not None else sp_mesh()
     shape = K.BatchShape.plan([enc])
     packed = K.pack_batch([enc], shape)

@@ -1,0 +1,134 @@
+"""SCC-condensation long-history path (checker/elle/condense.py).
+
+Differential against both the host oracle (graph.classify_cycles) and
+the dense device kernel, plus the >32k-txn routing and the aux-chain
+realtime sparsification.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import parallel
+from jepsen_tpu.checker.elle import condense, encode, graph, kernels
+from test_elle_append import random_history
+
+
+def flags_of_host(enc, realtime=False, process_order=False) -> set:
+    edges = graph.build_edges(enc, process_order=process_order,
+                              realtime=realtime)
+    res = graph.classify_cycles(enc.n, edges, want_witnesses=False)
+    return set(res)
+
+
+def flags_of_condensed(enc, realtime=False, process_order=False) -> set:
+    res = condense.check_condensed(enc, realtime=realtime,
+                                   process_order=process_order)
+    res.pop("cycle", None)
+    return set(res)
+
+
+class TestEdgeArrays:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_matches_host_builder(self, seed):
+        rng = random.Random(seed)
+        hist = random_history(rng, n_txns=25, corrupt=rng.randint(0, 3))
+        enc = encode.encode_history(hist)
+        want = {(s, d, c) for s, d, c in graph.build_edges(
+            enc, process_order=True, realtime=False)}
+        src, dst, cls = condense.build_edges_arrays(enc,
+                                                    process_order=True)
+        got = set(zip(src.tolist(), dst.tolist(), cls.tolist()))
+        assert got == want
+
+    def test_rt_aux_reachability_equals_dense_rt(self):
+        # SCC over sparse aux-chain == SCC over the dense rt relation.
+        rng = random.Random(3)
+        for seed in range(6):
+            hist = random_history(rng, n_txns=20,
+                                  corrupt=rng.randint(1, 3))
+            enc = encode.encode_history(hist)
+            n = enc.n
+            src, dst, _ = condense.build_edges_arrays(enc)
+            # dense rt edges from the host oracle builder
+            eff = encode.effective_complete_index(
+                enc.status, enc.complete_index)
+            rt = [(j, i) for i in range(n) for j in range(n)
+                  if j != i and eff[j] < enc.invoke_index[i]]
+            dsrc = np.concatenate([src, np.array([e[0] for e in rt],
+                                                 np.int64)])
+            ddst = np.concatenate([dst, np.array([e[1] for e in rt],
+                                                 np.int64)])
+            dense_scc = condense._scc_csr(n, dsrc, ddst)
+            asrc, adst, _ = condense.rt_aux_edges(enc)
+            aux_scc = condense._scc_csr(
+                2 * n, np.concatenate([src, asrc]),
+                np.concatenate([dst, adst]))[:n]
+
+            def groups(scc):
+                g: dict = {}
+                for i, s in enumerate(scc.tolist()):
+                    g.setdefault(s, set()).add(i)
+                return {frozenset(v) for v in g.values()}
+
+            assert groups(np.asarray(dense_scc)) == groups(aux_scc)
+
+
+class TestCondensedVerdicts:
+    @pytest.mark.parametrize("seed", range(10))
+    @pytest.mark.parametrize("realtime,process_order",
+                             [(False, False), (True, False), (True, True)])
+    def test_differential_vs_host_oracle(self, seed, realtime,
+                                         process_order):
+        rng = random.Random(seed * 7 + 1)
+        hist = random_history(rng, n_txns=25, corrupt=rng.randint(0, 4))
+        for o in hist:
+            if o["type"] == "ok" and rng.random() < 0.08:
+                o["type"] = "info"
+                o["value"] = None
+        enc = encode.encode_history(hist)
+        assert flags_of_condensed(enc, realtime, process_order) == \
+            flags_of_host(enc, realtime, process_order)
+
+    def test_valid_history_no_device_work(self):
+        rng = random.Random(5)
+        enc = encode.encode_history(random_history(rng, n_txns=40))
+        members, _ = condense.condense(enc, realtime=True)
+        assert members == []
+        assert condense.check_condensed(enc, realtime=True) == {}
+
+    def test_detect_only(self):
+        rng = random.Random(6)
+        enc = encode.encode_history(
+            random_history(rng, n_txns=25, corrupt=3))
+        if flags_of_host(enc):
+            assert condense.check_condensed(enc, classify=False) == \
+                {"cycle": True}
+
+
+def big_encoded(T: int, inject_cycle: bool = False) -> encode.EncodedHistory:
+    from jepsen_tpu.checker.elle import synth
+    return synth.synth_encoded_history(T, K=64, inject_cycle=inject_cycle)
+
+
+class TestLongHistoryRouting:
+    def test_50k_valid_routes_to_condensation(self):
+        enc = big_encoded(50_000)
+        flags = parallel.check_long_history(enc, realtime=True,
+                                            process_order=True)
+        assert flags == {}
+
+    def test_50k_injected_cycle_detected_and_classified(self):
+        enc = big_encoded(50_000, inject_cycle=True)
+        flags = parallel.check_long_history(enc)
+        assert "G1c" in flags, flags
+        host = flags_of_host(enc)
+        assert "G1c" in host
+
+    def test_dense_route_still_used_below_limit(self):
+        enc = big_encoded(600)
+        flags = parallel.check_long_history(enc, dense_limit=32_768)
+        assert flags == {}
