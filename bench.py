@@ -26,6 +26,7 @@ import json
 import os
 import sys
 import time
+from pathlib import Path
 
 
 def bench_elle(n_dev: int, devices, reps: int) -> dict:
@@ -129,6 +130,53 @@ def bench_long_history(reps: int) -> dict:
     }
 
 
+def bench_end_to_end(n_dev: int, devices) -> dict:
+    """Store -> verdict, ingest included: write B histories as
+    history.jsonl run dirs, then time process-pool encode + bucketed
+    device check (the analyze-store pipeline's core)."""
+    import shutil
+    import tempfile
+
+    from jepsen_tpu import ingest, parallel
+    from jepsen_tpu.checker.elle import synth
+
+    B = int(os.environ.get("BENCH_E2E_B", 64))
+    T = int(os.environ.get("BENCH_E2E_T", 1000))
+    root = Path(tempfile.mkdtemp(prefix="bench-e2e-"))
+    try:
+        import json as _json
+        dirs = []
+        for i in range(B):
+            hist = synth.synth_append_history(T=T, K=32, seed=i)
+            d = root / f"run-{i:04d}"
+            d.mkdir()
+            with open(d / "history.jsonl", "w") as f:
+                for o in hist:
+                    f.write(_json.dumps(o) + "\n")
+            dirs.append(d)
+
+        mesh = parallel.make_mesh(devices) if n_dev > 1 else None
+        t0 = time.perf_counter()
+        encs = ingest.parallel_encode(dirs, checker="append")
+        t_ingest = time.perf_counter() - t0
+        assert not any(isinstance(e, Exception) for e in encs)
+        t0 = time.perf_counter()
+        out = parallel.check_bucketed(encs, mesh)
+        t_check = time.perf_counter() - t0
+        assert all(o == {} for o in out)
+        total = t_ingest + t_check
+        return {
+            "metric": f"store->verdict histories/sec ({T}-txn, "
+                      f"ingest+check)",
+            "value": round(B / total, 2),
+            "ingest_secs": round(t_ingest, 3),
+            "check_secs": round(t_check, 3),
+            "unit": "histories/sec",
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def main() -> int:
     from jepsen_tpu.devices import default_devices
 
@@ -145,6 +193,10 @@ def main() -> int:
         out["long_history"] = bench_long_history(reps)
     except Exception as e:
         out["long_history"] = {"error": repr(e)[:200]}
+    try:
+        out["end_to_end"] = bench_end_to_end(n_dev, devices)
+    except Exception as e:
+        out["end_to_end"] = {"error": repr(e)[:200]}
     print(json.dumps(out))
     return 0
 

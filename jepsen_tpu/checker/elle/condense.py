@@ -171,16 +171,57 @@ def condense(enc: EncodedHistory, realtime: bool = False,
     return members, (src, dst, cls)
 
 
+# An SCC bigger than this goes to the host classifier instead of the
+# dense device kernel (whose [T,T] matrices are what the condensation
+# exists to avoid at full history size).
+DEVICE_SCC_LIMIT = 8_192
+
+
+def _classify_scc_host(enc: EncodedHistory, rows: np.ndarray,
+                       src, dst, cls, keep, local,
+                       realtime: bool) -> dict:
+    """Host classification of one oversized SCC: graph.classify_cycles
+    over the subgraph, with the realtime order carried by a
+    member-local completion-rank aux chain (exact rt reachability, no
+    dense [m,m] relation)."""
+    m = len(rows)
+    edges = list(zip(local[src[keep]].tolist(), local[dst[keep]].tolist(),
+                     cls[keep].tolist()))
+    n_nodes = m
+    if realtime:
+        eff = effective_complete_index(enc.status, enc.complete_index)[rows]
+        inv = np.asarray(enc.invoke_index)[rows]
+        order = np.argsort(eff, kind="stable")
+        sorted_eff = eff[order]
+        rank = np.empty(m, np.int64)
+        rank[order] = np.arange(m)
+        aux0 = m
+        for j in range(m):
+            edges.append((j, aux0 + int(rank[j]), G.RT))
+        for k in range(m - 1):
+            edges.append((aux0 + k, aux0 + k + 1, G.RT))
+        k_i = np.searchsorted(sorted_eff, inv) - 1
+        for i in range(m):
+            if k_i[i] >= 0:
+                edges.append((aux0 + int(k_i[i]), i, G.RT))
+        n_nodes = 2 * m
+    res = G.classify_cycles(n_nodes, edges, want_witnesses=False)
+    return {name: True for name in res}
+
+
 def check_condensed(enc: EncodedHistory, *, classify: bool = True,
                     realtime: bool = False, process_order: bool = False,
-                    devices=None) -> dict:
+                    devices=None,
+                    device_scc_limit: int = DEVICE_SCC_LIMIT) -> dict:
     """Check ONE long history via SCC condensation. Returns the same
     {anomaly: True} flag dict as the dense device path.
 
     Valid histories (no nontrivial SCC) cost one numpy edge build plus
     one native Tarjan — no device dispatch at all. Anomalous ones ship
     each SCC subgraph to the batched classification kernel; restriction
-    to the SCC is exact (module docstring)."""
+    to the SCC is exact (module docstring). SCCs beyond
+    `device_scc_limit` rows classify on the host instead (their dense
+    [m,m] matrices are the very thing condensation avoids)."""
     members, (src, dst, cls) = condense(enc, realtime=realtime,
                                         process_order=process_order)
     if not members:
@@ -190,11 +231,24 @@ def check_condensed(enc: EncodedHistory, *, classify: bool = True,
 
     from . import kernels as K
     eff = effective_complete_index(enc.status, enc.complete_index)
-    per_scc = []
-    for rows in members:
-        local = np.full(enc.n, -1, np.int64)
+    # One global local-id map + one edge-membership pass for ALL SCCs
+    # (not O(edges) per SCC): edges are grouped by the SCC id of their
+    # (same-SCC) endpoints.
+    local = np.full(enc.n, -1, np.int64)
+    scc_of = np.full(enc.n, -1, np.int64)
+    for b, rows in enumerate(members):
         local[rows] = np.arange(len(rows))
-        keep = (local[src] >= 0) & (local[dst] >= 0)
+        scc_of[rows] = b
+    same = (scc_of[src] >= 0) & (scc_of[src] == scc_of[dst])
+
+    flags: dict = {}
+    per_scc = []
+    for b, rows in enumerate(members):
+        keep = same & (scc_of[src] == b)
+        if len(rows) > device_scc_limit:
+            flags.update(_classify_scc_host(
+                enc, rows, src, dst, cls, keep, local, realtime))
+            continue
         # PROC edges ride along as WW-class on device (same role:
         # cycle-strengthening order edges, kernels.py module doc).
         sub_cls = np.where(cls[keep] == G.PROC, G.WW, cls[keep])
@@ -207,9 +261,10 @@ def check_condensed(enc: EncodedHistory, *, classify: bool = True,
             "complete_index": eff[rows],
             "process": np.asarray(enc.process)[rows],
         })
-    flags: dict = {}
-    for res in K.check_edge_batch(per_scc, classify=True,
-                                  realtime=realtime, process_order=False,
-                                  devices=devices):
-        flags.update(res)
+    if per_scc:
+        for res in K.check_edge_batch(per_scc, classify=True,
+                                      realtime=realtime,
+                                      process_order=False,
+                                      devices=devices):
+            flags.update(res)
     return flags

@@ -342,14 +342,17 @@ def check_edge_batch(per_history: list[dict], realtime: bool = False,
     p = pack_edge_matrices(per_history)
     names = ("ww", "wr", "rw", "invoke_index", "complete_index",
              "process", "n_txns")
-    args = [jnp.asarray(p[k]) for k in names]
+    # device_put straight from numpy: going through jnp.asarray first
+    # would commit each [B,T,T] matrix whole onto device 0 before the
+    # dp sharding ever applied.
     if len(devices) > 1:
         mesh = jax.sharding.Mesh(np.asarray(devices), ("dp",))
         sharding = jax.sharding.NamedSharding(
             mesh, jax.sharding.PartitionSpec("dp"))
-        args = [jax.device_put(a, sharding) for a in args]
-    elif devices:
-        args = [jax.device_put(a, devices[0]) for a in args]
+        args = [jax.device_put(p[k], sharding) for k in names]
+    else:
+        args = [jax.device_put(p[k], devices[0] if devices else None)
+                for k in names]
     flags = classify_matrices_device(
         *args, steps=closure_steps(p["T"]), classify=classify,
         realtime=realtime, process_order=process_order)

@@ -242,29 +242,25 @@ def analyze_store(store: Store, checker: str = "append",
 
     from . import parallel
     from .checker import elle
-    from .checker.elle import encode as elle_encode
     from .checker.elle import kernels as elle_kernels
     from .checker.elle import wr as elle_wr
 
     # Encodable histories get the batched device sweep; the rest fall
-    # back to their own stored checker host-side.
+    # back to their own stored checker host-side. Ingest shards run
+    # dirs across a process pool (ingest.py, SURVEY.md §5.7).
+    from . import ingest
     encs, mapping, fallback = [], [], []
-    for d in run_dirs:
-        try:
-            hist = store.load_history(d)
-            if checker == "append":
-                enc = elle_encode.encode_history(hist)
-            else:
-                enc = elle_wr.encode_wr_history(hist)
-            if enc.n == 0:  # no txn ops at all: not a txn workload
-                fallback.append(d)
-                continue
+    for d, enc in zip(run_dirs,
+                      ingest.parallel_encode(run_dirs, checker=checker)):
+        if isinstance(enc, Exception):
+            log.info("run %s not encodable as %s (%r); using stored "
+                     "checker", d, checker, enc)
+            fallback.append(d)
+        elif enc.n == 0:  # no txn ops at all: not a txn workload
+            fallback.append(d)
+        else:
             encs.append(enc)
             mapping.append(d)
-        except Exception:
-            log.info("run %s not encodable as %s; using stored checker",
-                     d, checker, exc_info=True)
-            fallback.append(d)
 
     if encs:
         if checker == "append":

@@ -1,0 +1,98 @@
+"""Sharded store -> tensor ingest (SURVEY.md §5.7).
+
+The analysis phase is device-bound only if the host can feed it:
+encoding one 10k-op list-append history costs ~50ms of dict parsing,
+so a single-core loop would throttle a TPU slice checking hundreds of
+histories per second. This module shards the ingest the way the batch
+sweep shards the checking: run directories are encoded by a process
+pool, each worker reading its own history file from disk (nothing but
+compact arrays crosses the process boundary — no op-dict pickling),
+and the parent batches the results straight onto the mesh.
+
+The reference's analogues are the chunked parallel history writer
+(jepsen/src/jepsen/util.clj:203-225) and bounded-pmap over independent
+keys (independent.clj:472-492); here the unit is a whole stored run.
+"""
+
+from __future__ import annotations
+
+import logging
+import multiprocessing as mp
+import os
+from pathlib import Path
+from typing import Sequence
+
+log = logging.getLogger(__name__)
+
+
+def load_history_dir(run_dir: str | os.PathLike) -> list[dict]:
+    """History ops from a run dir: history.jsonl preferred,
+    reference-format history.edn fallback (same rule as
+    store.Store.load_history)."""
+    import json
+
+    from . import history as h
+
+    d = Path(run_dir)
+    jl = d / "history.jsonl"
+    if jl.exists():
+        return [json.loads(line) for line in jl.read_text().splitlines()
+                if line.strip()]
+    ed = d / "history.edn"
+    if ed.exists():
+        return h.history_from_edn(ed.read_text())
+    raise FileNotFoundError(f"no history in {d}")
+
+
+def encode_run_dir(run_dir: str | os.PathLike, checker: str = "append",
+                   lean: bool = True):
+    """Load + encode one run dir. With lean=True the per-row completion
+    ops are dropped so only arrays cross process boundaries (witness
+    rendering then reports txn row numbers instead of full ops — the
+    batch sweep's flags don't carry witnesses anyway)."""
+    hist = load_history_dir(run_dir)
+    if checker == "append":
+        from .checker.elle.encode import encode_history
+        enc = encode_history(hist)
+    elif checker == "wr":
+        from .checker.elle.wr import encode_wr_history
+        enc = encode_wr_history(hist)
+    else:
+        raise ValueError(f"unknown checker {checker!r}")
+    if lean:
+        enc.txn_ops = []
+    return enc
+
+
+def _worker(args):
+    run_dir, checker = args
+    try:
+        return encode_run_dir(run_dir, checker)
+    except Exception as e:
+        return e
+
+
+def parallel_encode(run_dirs: Sequence[str | os.PathLike],
+                    checker: str = "append",
+                    processes: int | None = None) -> list:
+    """Encode many run dirs via a process pool. Returns a list aligned
+    with run_dirs: EncodedHistory / WrEncoded on success, the raised
+    Exception object on per-run failure (callers route those to their
+    fallback checker).
+
+    processes=0 forces the serial path. Workers are spawned (not
+    forked): the parent usually holds live device runtimes, and the
+    encode path needs none of that."""
+    if processes is None:
+        processes = min(len(run_dirs), os.cpu_count() or 1)
+    if processes <= 1 or len(run_dirs) <= 1:
+        return [_worker((d, checker)) for d in run_dirs]
+    ctx = mp.get_context("spawn")
+    try:
+        with ctx.Pool(processes=processes) as pool:
+            return pool.map(_worker, [(d, checker) for d in run_dirs],
+                            chunksize=max(1, len(run_dirs) // (4 * processes)))
+    except Exception:
+        log.warning("process-pool ingest failed; falling back to serial",
+                    exc_info=True)
+        return [_worker((d, checker)) for d in run_dirs]

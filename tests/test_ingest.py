@@ -1,0 +1,69 @@
+"""Sharded store->tensor ingest (jepsen_tpu/ingest.py)."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from jepsen_tpu import ingest
+from jepsen_tpu.checker.elle import encode, synth
+
+
+def write_run(tmp_path, name, hist):
+    d = tmp_path / name
+    d.mkdir()
+    with open(d / "history.jsonl", "w") as f:
+        for o in hist:
+            f.write(json.dumps(o) + "\n")
+    return d
+
+
+class TestEncodeRunDir:
+    def test_jsonl_roundtrip_matches_direct_encode(self, tmp_path):
+        hist = synth.synth_append_history(T=40, K=8, seed=1)
+        d = write_run(tmp_path, "r0", hist)
+        enc = ingest.encode_run_dir(d)
+        direct = encode.encode_history(hist)
+        assert enc.n == direct.n
+        assert (enc.appends == direct.appends).all()
+        assert (enc.reads == direct.reads).all()
+        assert enc.txn_ops == []  # lean by default
+
+    def test_missing_history_raises(self, tmp_path):
+        d = tmp_path / "empty"
+        d.mkdir()
+        with pytest.raises(FileNotFoundError):
+            ingest.encode_run_dir(d)
+
+    def test_edn_fallback(self, tmp_path):
+        d = tmp_path / "edn"
+        d.mkdir()
+        (d / "history.edn").write_text(
+            '{:type :invoke, :process 0, :f :txn, '
+            ':value [[:append 1 1]], :index 0}\n'
+            '{:type :ok, :process 0, :f :txn, '
+            ':value [[:append 1 1]], :index 1}\n')
+        enc = ingest.encode_run_dir(d)
+        assert enc.n == 1
+
+
+class TestParallelEncode:
+    def test_serial_and_pool_agree(self, tmp_path):
+        dirs = [write_run(tmp_path, f"r{i}",
+                          synth.synth_append_history(T=30, K=6, seed=i))
+                for i in range(4)]
+        serial = ingest.parallel_encode(dirs, processes=0)
+        pooled = ingest.parallel_encode(dirs, processes=2)
+        for a, b in zip(serial, pooled):
+            assert a.n == b.n
+            assert (a.appends == b.appends).all()
+
+    def test_failures_come_back_as_exceptions(self, tmp_path):
+        good = write_run(tmp_path, "good",
+                         synth.synth_append_history(T=20, K=4, seed=0))
+        bad = tmp_path / "bad"
+        bad.mkdir()
+        out = ingest.parallel_encode([good, bad], processes=0)
+        assert out[0].n == 20 // 2 or out[0].n > 0
+        assert isinstance(out[1], Exception)
