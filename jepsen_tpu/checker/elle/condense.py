@@ -102,21 +102,18 @@ def build_edges_arrays(enc: EncodedHistory, process_order: bool = False
             np.concatenate(clss))
 
 
-def rt_aux_edges(enc: EncodedHistory
-                 ) -> tuple[np.ndarray, np.ndarray, int]:
-    """Sparsify the realtime order for SCC search: O(T) edges through a
-    completion-rank aux chain instead of the dense [T,T] relation.
+def aux_chain(eff: np.ndarray, inv: np.ndarray
+              ) -> tuple[np.ndarray, np.ndarray]:
+    """Completion-rank aux chain over (complete, invoke) index arrays:
+    the O(n) sparsification of the realtime order.
 
     Aux node n+k means "after the k-th completion (in completion
     order)". Edges: txn j -> aux rank(j); aux_k -> aux_{k+1}; and
     aux_{k_i} -> txn i where k_i is the last completion rank strictly
     before i's invocation. Reachability j -> i through aux nodes is
-    then exactly complete(j) < invoke(i). Returns (src, dst, n_aux)."""
-    n = enc.n
-    if n == 0:
-        return np.zeros(0, np.int64), np.zeros(0, np.int64), 0
-    eff = effective_complete_index(enc.status, enc.complete_index)
-    inv = np.asarray(enc.invoke_index, np.int64)
+    then exactly complete(j) < invoke(i). Returns (src, dst); callers
+    add n aux node ids on top of their own node space."""
+    n = len(eff)
     order = np.argsort(eff, kind="stable")
     sorted_eff = eff[order]
     rank = np.empty(n, np.int64)
@@ -129,7 +126,20 @@ def rt_aux_edges(enc: EncodedHistory
     has = k >= 0
     srcs.append(aux[k[has]])
     dsts.append(np.arange(n, dtype=np.int64)[has])
-    return np.concatenate(srcs), np.concatenate(dsts), n
+    return np.concatenate(srcs), np.concatenate(dsts)
+
+
+def rt_aux_edges(enc: EncodedHistory
+                 ) -> tuple[np.ndarray, np.ndarray, int]:
+    """aux_chain over a whole encoded history. Returns (src, dst,
+    n_aux)."""
+    n = enc.n
+    if n == 0:
+        return np.zeros(0, np.int64), np.zeros(0, np.int64), 0
+    eff = effective_complete_index(enc.status, enc.complete_index)
+    inv = np.asarray(enc.invoke_index, np.int64)
+    src, dst = aux_chain(eff, inv)
+    return src, dst, n
 
 
 def _scc_csr(n_nodes: int, src: np.ndarray, dst: np.ndarray) -> np.ndarray:
@@ -190,20 +200,9 @@ def _classify_scc_host(enc: EncodedHistory, rows: np.ndarray,
     n_nodes = m
     if realtime:
         eff = effective_complete_index(enc.status, enc.complete_index)[rows]
-        inv = np.asarray(enc.invoke_index)[rows]
-        order = np.argsort(eff, kind="stable")
-        sorted_eff = eff[order]
-        rank = np.empty(m, np.int64)
-        rank[order] = np.arange(m)
-        aux0 = m
-        for j in range(m):
-            edges.append((j, aux0 + int(rank[j]), G.RT))
-        for k in range(m - 1):
-            edges.append((aux0 + k, aux0 + k + 1, G.RT))
-        k_i = np.searchsorted(sorted_eff, inv) - 1
-        for i in range(m):
-            if k_i[i] >= 0:
-                edges.append((aux0 + int(k_i[i]), i, G.RT))
+        inv = np.asarray(enc.invoke_index, np.int64)[rows]
+        asrc, adst = aux_chain(eff, inv)   # member-local rt chain
+        edges += [(int(s), int(d), G.RT) for s, d in zip(asrc, adst)]
         n_nodes = 2 * m
     res = G.classify_cycles(n_nodes, edges, want_witnesses=False)
     return {name: True for name in res}
@@ -231,20 +230,24 @@ def check_condensed(enc: EncodedHistory, *, classify: bool = True,
 
     from . import kernels as K
     eff = effective_complete_index(enc.status, enc.complete_index)
-    # One global local-id map + one edge-membership pass for ALL SCCs
-    # (not O(edges) per SCC): edges are grouped by the SCC id of their
-    # (same-SCC) endpoints.
+    # One global local-id map + one argsort groups every (same-SCC)
+    # edge by SCC id — O(E log E) total, independent of SCC count.
     local = np.full(enc.n, -1, np.int64)
     scc_of = np.full(enc.n, -1, np.int64)
     for b, rows in enumerate(members):
         local[rows] = np.arange(len(rows))
         scc_of[rows] = b
-    same = (scc_of[src] >= 0) & (scc_of[src] == scc_of[dst])
+    same_idx = np.flatnonzero((scc_of[src] >= 0) &
+                              (scc_of[src] == scc_of[dst]))
+    grp = scc_of[src[same_idx]]
+    order = np.argsort(grp, kind="stable")
+    by_grp = same_idx[order]
+    bounds = np.searchsorted(grp[order], np.arange(len(members) + 1))
 
     flags: dict = {}
     per_scc = []
     for b, rows in enumerate(members):
-        keep = same & (scc_of[src] == b)
+        keep = by_grp[bounds[b]:bounds[b + 1]]
         if len(rows) > device_scc_limit:
             flags.update(_classify_scc_host(
                 enc, rows, src, dst, cls, keep, local, realtime))

@@ -45,7 +45,6 @@ import numpy as np
 from ... import history as h
 from .. import Checker
 from . import graph as g
-from . import kernels
 from . import txn as t
 from .encode import INFO, OK, NEVER_COMPLETED, _note, \
     effective_complete_index
@@ -348,6 +347,8 @@ def cycle_anomalies_tpu(enc: WrEncoded, realtime: bool = False,
                         process_order: bool = False) -> dict:
     if enc.n == 0:
         return {}
+    from . import kernels  # deferred: keeps jax out of encode-only
+    # workers (ingest.parallel_encode forks encode_wr_history users)
     return kernels.check_edge_batch(
         [{"n": enc.n, "edges": enc.edges,
           "invoke_index": enc.invoke_index,

@@ -26,22 +26,10 @@ log = logging.getLogger(__name__)
 
 
 def load_history_dir(run_dir: str | os.PathLike) -> list[dict]:
-    """History ops from a run dir: history.jsonl preferred,
-    reference-format history.edn fallback (same rule as
-    store.Store.load_history)."""
-    import json
-
-    from . import history as h
-
-    d = Path(run_dir)
-    jl = d / "history.jsonl"
-    if jl.exists():
-        return [json.loads(line) for line in jl.read_text().splitlines()
-                if line.strip()]
-    ed = d / "history.edn"
-    if ed.exists():
-        return h.history_from_edn(ed.read_text())
-    raise FileNotFoundError(f"no history in {d}")
+    """History ops from a run dir (delegates to the store's loader —
+    one format rule, shared with Store.load_history)."""
+    from .store import load_history_dir as _load
+    return _load(run_dir)
 
 
 def encode_run_dir(run_dir: str | os.PathLike, checker: str = "append",

@@ -58,6 +58,22 @@ def _stringify(v: Any) -> Any:
     return repr(v)
 
 
+def load_history_dir(run_dir: str | os.PathLike) -> list[h.Op]:
+    """History ops from a run dir: history.jsonl preferred,
+    reference-format history.edn fallback. Module-level (not a Store
+    method) so encode-only worker processes can load runs without
+    constructing a store."""
+    d = Path(run_dir)
+    jl = d / "history.jsonl"
+    if jl.exists():
+        return [json.loads(line) for line in jl.read_text().splitlines()
+                if line.strip()]
+    ed = d / "history.edn"
+    if ed.exists():
+        return h.history_from_edn(ed.read_text())
+    raise FileNotFoundError(f"no history in {d}")
+
+
 class Store:
     """A store rooted at `base` (default ./store)."""
 
@@ -179,15 +195,7 @@ class Store:
     def load_history(self, run_dir: str | os.PathLike) -> list[h.Op]:
         """Load a history from a run dir: prefers history.jsonl, falls back
         to reference-format history.edn."""
-        d = Path(run_dir)
-        jl = d / "history.jsonl"
-        if jl.exists():
-            return [json.loads(line) for line in jl.read_text().splitlines()
-                    if line.strip()]
-        ed = d / "history.edn"
-        if ed.exists():
-            return h.history_from_edn(ed.read_text())
-        raise FileNotFoundError(f"no history in {d}")
+        return load_history_dir(run_dir)
 
     def load_test(self, run_dir: str | os.PathLike) -> dict:
         """Load a run dir — ours (test.json) or the reference's

@@ -17,7 +17,7 @@ from .. import control
 from .. import db as jdb
 from .. import nemesis as jnemesis, os_setup
 from ..control import util as cutil
-from . import base_opts, standard_workloads, suite_test
+from . import base_opts, sql, standard_workloads, suite_test
 
 VERSION = "v19.1.5"
 DIR = "/opt/cockroach"
@@ -82,13 +82,22 @@ def workloads(opts: dict | None = None) -> dict:
             ("register", "bank", "monotonic", "sequential", "set", "g2")}
 
 
+def default_client(workload: str, opts: dict):
+    """pg-wire client on cockroach's SQL port (the reference drives
+    cockroach through jdbc/postgres, cockroach/client.clj:1-60)."""
+    return sql.client_for(
+        sql.PGDialect(port=26257, user="root", database="defaultdb"),
+        workload, opts)
+
+
 def cockroach_test(opts: dict | None = None) -> dict:
     opts = base_opts(**(opts or {}))
+    wname = opts.get("workload", "register")
     return suite_test(
-        "cockroach", opts.get("workload", "register"), opts,
+        "cockroach", wname, opts,
         workloads(opts),
         db=CockroachDB(opts.get("version", VERSION)),
-        client=opts.get("client"),
+        client=opts.get("client") or default_client(wname, opts),
         nemesis=jnemesis.partition_random_halves(),
         os_setup=os_setup.debian())
 

@@ -1,0 +1,467 @@
+"""Generic SQL client: maps workload ops onto SQL transactions.
+
+This is the counterpart of the per-DB client namespaces in the
+reference's SQL suites (cockroachdb/src/jepsen/cockroach/client.clj:1-60
+conn management + retries; tidb/src/tidb/sql.clj; yugabyte YSQL client),
+built on the in-tree wire drivers (drivers.pgwire / drivers.mysql_wire)
+instead of jdbc.
+
+One `SQLClient` serves every workload in the shared registry. The op
+vocabulary it understands (values may be independent-lifted `[k, v]`):
+
+    read/write/cas          register ops           -> registers table
+    txn [[f k v] ...]       elle append / wr mops  -> lists / registers
+    read/transfer           bank                   -> accounts
+    add/read                set                    -> sets
+    read/inc                monotonic              -> counter
+    write/read (lifted)     causal-reverse         -> cr
+    insert (lifted [a,b])   adya g2                -> g2a / g2b
+
+Error mapping follows drivers.__init__: DBError => the statement/txn was
+definitely rejected => type "fail"; DriverError (conn loss/timeout) =>
+indeterminate => "info" (reads may safely "fail").
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable
+
+from .. import client as jclient
+from .. import independent
+from ..drivers import DBError, DriverError
+
+
+def resolve(node: str, default_port: int, test: dict) -> tuple[str, int]:
+    """Node name -> (host, port). Tests (and NATed clusters) may remap
+    via test["db-hosts"] = {node: "host" | ("host", port)}."""
+    remap = (test or {}).get("db-hosts", {}).get(node, node)
+    if isinstance(remap, (tuple, list)):
+        return remap[0], int(remap[1])
+    return remap, default_port
+
+
+class Dialect:
+    """SQL syntax + session knobs that differ across engines."""
+
+    name = "generic"
+    port = 5432
+
+    def connect(self, node: str, test: dict):
+        raise NotImplementedError
+
+    def begin(self) -> str:
+        return "BEGIN"
+
+    def commit(self) -> str:
+        return "COMMIT"
+
+    def rollback(self) -> str:
+        return "ROLLBACK"
+
+    def upsert(self, table: str, key: int, col: str, val: str) -> str:
+        raise NotImplementedError
+
+    def upsert_concat(self, table: str, key: int, val: int) -> str:
+        """Append `val` to a comma-joined list column."""
+        raise NotImplementedError
+
+    def setup_stmts(self) -> list[str]:
+        return [
+            "CREATE TABLE IF NOT EXISTS registers"
+            " (id BIGINT PRIMARY KEY, val BIGINT)",
+            "CREATE TABLE IF NOT EXISTS lists"
+            " (id BIGINT PRIMARY KEY, val TEXT)",
+            "CREATE TABLE IF NOT EXISTS accounts"
+            " (id BIGINT PRIMARY KEY, balance BIGINT)",
+            "CREATE TABLE IF NOT EXISTS sets (val BIGINT PRIMARY KEY)",
+            "CREATE TABLE IF NOT EXISTS counter"
+            " (id BIGINT PRIMARY KEY, val BIGINT)",
+            "CREATE TABLE IF NOT EXISTS cr"
+            " (k BIGINT, v BIGINT, PRIMARY KEY (k, v))",
+            "CREATE TABLE IF NOT EXISTS g2a"
+            " (id BIGINT PRIMARY KEY, k BIGINT)",
+            "CREATE TABLE IF NOT EXISTS g2b"
+            " (id BIGINT PRIMARY KEY, k BIGINT)",
+        ]
+
+
+class PGDialect(Dialect):
+    """CockroachDB (--insecure trust auth) and YugabyteDB YSQL."""
+
+    name = "pg"
+
+    def __init__(self, port: int = 26257, user: str = "root",
+                 database: str = "defaultdb", password: str | None = None,
+                 timeout: float = 10.0):
+        self.port, self.user, self.database = port, user, database
+        self.password, self.timeout = password, timeout
+
+    def connect(self, node: str, test: dict):
+        from ..drivers import pgwire
+        host, port = resolve(node, self.port, test)
+        return pgwire.connect(host, port, user=self.user,
+                              database=self.database,
+                              password=self.password,
+                              timeout=self.timeout)
+
+    def upsert(self, table, key, col, val):
+        return (f"INSERT INTO {table} (id, {col}) VALUES ({key}, {val}) "
+                f"ON CONFLICT (id) DO UPDATE SET {col} = excluded.{col}")
+
+    def upsert_concat(self, table, key, val):
+        return (f"INSERT INTO {table} (id, val) VALUES ({key}, '{val}') "
+                f"ON CONFLICT (id) DO UPDATE SET val = "
+                f"{table}.val || ',' || excluded.val")
+
+
+class MySQLDialect(Dialect):
+    """TiDB (mysql protocol, root/no password by default)."""
+
+    name = "mysql"
+
+    def __init__(self, port: int = 4000, user: str = "root",
+                 database: str = "test", password: str = "",
+                 timeout: float = 10.0):
+        self.port, self.user, self.database = port, user, database
+        self.password, self.timeout = password, timeout
+
+    def connect(self, node: str, test: dict):
+        from ..drivers import mysql_wire
+        host, port = resolve(node, self.port, test)
+        return mysql_wire.connect(host, port, user=self.user,
+                                  database=self.database,
+                                  password=self.password,
+                                  timeout=self.timeout)
+
+    def upsert(self, table, key, col, val):
+        return (f"INSERT INTO {table} (id, {col}) VALUES ({key}, {val}) "
+                f"ON DUPLICATE KEY UPDATE {col} = VALUES({col})")
+
+    def upsert_concat(self, table, key, val):
+        return (f"INSERT INTO {table} (id, val) VALUES ({key}, '{val}') "
+                f"ON DUPLICATE KEY UPDATE val = "
+                f"CONCAT(val, ',', VALUES(val))")
+
+
+def _rows(res) -> list:
+    """Normalize driver Result(s) to a row list (pg query returns a
+    list of Results, mysql a single Result)."""
+    if isinstance(res, list):
+        return res[-1].rows if res else []
+    return res.rows
+
+
+class SQLClient(jclient.Client):
+    """One connection per worker; lazy connect so a down DB surfaces as
+    op-level "info"/"fail", not a setup crash (client.clj's open!/close!
+    contract)."""
+
+    def __init__(self, dialect: Dialect, mode: str = "register",
+                 accounts: list | None = None, total: int = 100,
+                 node: str | None = None):
+        self.dialect = dialect
+        self.mode = mode
+        self.accounts = accounts if accounts is not None else list(range(8))
+        self.total = total
+        self.node = node
+        self.conn = None
+        self._setup_done = False
+
+    # -- lifecycle -----------------------------------------------------
+
+    def open(self, test, node):
+        return SQLClient(self.dialect, self.mode, self.accounts,
+                         self.total, node)
+
+    def setup(self, test):
+        pass  # schema created lazily on first invoke (first conn wins)
+
+    def _ensure_conn(self, test):
+        if self.conn is None:
+            self.conn = self.dialect.connect(self.node, test or {})
+        if not self._setup_done:
+            for stmt in self.dialect.setup_stmts():
+                self.conn.query(stmt)
+            if self.mode == "bank":
+                # Atomic insert-if-absent seeding: account 0 holds the
+                # full total, the rest 0. Concurrent seeders can't reset
+                # balances mid-run (the upsert clause never fires a
+                # write), so the sum is `total` from the first seed on.
+                d = self.dialect
+                noop = ("ON CONFLICT (id) DO NOTHING" if d.name == "pg"
+                        else "ON DUPLICATE KEY UPDATE balance = balance")
+                for a, bal in [(0, self.total)] + [
+                        (a, 0) for a in self.accounts if a != 0]:
+                    self.conn.query(
+                        f"INSERT INTO accounts (id, balance) "
+                        f"VALUES ({int(a)}, {bal}) {noop}")
+            self._setup_done = True
+
+    def close(self, test):
+        if self.conn is not None:
+            try:
+                self.conn.close()
+            finally:
+                self.conn = None
+
+    def teardown(self, test):
+        pass
+
+    # -- op dispatch ---------------------------------------------------
+
+    def invoke(self, test, op):
+        f = op.get("f")
+        # Reads never wrote anything: indeterminate errors are safe to
+        # report as definite failures (client.clj / etcd.clj:118).
+        read_only = f in ("read",) and self.mode != "monotonic"
+        try:
+            self._ensure_conn(test)
+            return self._dispatch(op)
+        except DBError as e:
+            return {**op, "type": "fail", "error": f"{self.dialect.name}-"
+                    f"{e.code}: {e.message[:120]}"}
+        except DriverError as e:
+            self.close(test)
+            return {**op, "type": "fail" if read_only else "info",
+                    "error": str(e)[:160]}
+        except OSError as e:
+            self.close(test)
+            return {**op, "type": "fail" if read_only else "info",
+                    "error": str(e)[:160]}
+
+    def _dispatch(self, op):
+        f = op.get("f")
+        mode = self.mode
+        # append/wr modes carry [f k v] micro-op lists whatever the op's
+        # f is (long-fork uses f="read"/"write" with mop values).
+        if f == "txn" or mode in ("append", "wr"):
+            return self._txn(op)
+        if mode == "bank":
+            return self._bank(op)
+        if mode == "set":
+            return self._set(op)
+        if mode == "monotonic":
+            return self._monotonic(op)
+        if mode in ("sequential", "causal-reverse"):
+            return self._causal_reverse(op)
+        if f == "insert":
+            return self._g2(op)
+        return self._register(op)
+
+    # -- register (read/write/cas) -------------------------------------
+
+    def _register(self, op):
+        v = op["value"]
+        k, val = (v.key, v.value) if independent.is_tuple(v) else (0, v)
+        lift = (lambda x: independent.tuple_(k, x)) \
+            if independent.is_tuple(v) else (lambda x: x)
+        c, d = self.conn, self.dialect
+        if op["f"] == "read":
+            rows = _rows(c.query(
+                f"SELECT val FROM registers WHERE id = {int(k)}"))
+            out = int(rows[0][0]) if rows and rows[0][0] is not None \
+                else None
+            return {**op, "type": "ok", "value": lift(out)}
+        if op["f"] == "write":
+            c.query(d.upsert("registers", int(k), "val", str(int(val))))
+            return {**op, "type": "ok"}
+        if op["f"] == "cas":
+            old, new = val
+            c.query(d.begin())
+            try:
+                rows = _rows(c.query(
+                    f"SELECT val FROM registers WHERE id = {int(k)}"))
+                cur = int(rows[0][0]) if rows and rows[0][0] is not None \
+                    else None
+                if cur != old:
+                    c.query(d.rollback())
+                    return {**op, "type": "fail", "error": "precondition"}
+                c.query(f"UPDATE registers SET val = {int(new)} "
+                        f"WHERE id = {int(k)}")
+                c.query(d.commit())
+                return {**op, "type": "ok"}
+            except DBError:
+                self._try_rollback()
+                raise
+        return {**op, "type": "fail", "error": f"unknown f {op['f']!r}"}
+
+    # -- elle txns ([f k v] micro-ops) ---------------------------------
+
+    def _txn(self, op):
+        mops = op["value"]
+        v = mops
+        k0 = None
+        if independent.is_tuple(mops):
+            k0, mops = mops.key, mops.value
+        c, d = self.conn, self.dialect
+        c.query(d.begin())
+        out = []
+        try:
+            for mop in mops:
+                mf, mk, mv = mop[0], mop[1], mop[2]
+                if mf == "append":
+                    c.query(d.upsert_concat("lists", int(mk), int(mv)))
+                    out.append([mf, mk, mv])
+                elif mf == "w":
+                    c.query(d.upsert("registers", int(mk), "val",
+                                     str(int(mv))))
+                    out.append([mf, mk, mv])
+                elif mf == "r" and self.mode == "append":
+                    rows = _rows(c.query(
+                        f"SELECT val FROM lists WHERE id = {int(mk)}"))
+                    txt = rows[0][0] if rows else None
+                    vals = [int(x) for x in txt.split(",")] if txt else []
+                    out.append([mf, mk, vals])
+                elif mf == "r":
+                    rows = _rows(c.query(
+                        f"SELECT val FROM registers WHERE id = {int(mk)}"))
+                    rv = int(rows[0][0]) if rows and rows[0][0] is not None \
+                        else None
+                    out.append([mf, mk, rv])
+                else:
+                    raise DBError("XXMOP", f"unknown micro-op {mf!r}")
+            c.query(d.commit())
+        except DBError:
+            self._try_rollback()
+            raise
+        new_v = independent.tuple_(k0, out) if k0 is not None else out
+        return {**op, "type": "ok", "value": new_v}
+
+    # -- bank ----------------------------------------------------------
+
+    def _bank(self, op):
+        c, d = self.conn, self.dialect
+        if op["f"] == "read":
+            c.query(d.begin())
+            try:
+                rows = _rows(c.query(
+                    "SELECT id, balance FROM accounts"))
+                c.query(d.commit())
+            except DBError:
+                self._try_rollback()
+                raise
+            return {**op, "type": "ok",
+                    "value": {int(r[0]): int(r[1]) for r in rows}}
+        if op["f"] == "transfer":
+            t = op["value"]
+            frm, to, amt = int(t["from"]), int(t["to"]), int(t["amount"])
+            c.query(d.begin())
+            try:
+                rows = _rows(c.query(
+                    f"SELECT balance FROM accounts WHERE id = {frm}"))
+                bal = int(rows[0][0]) if rows else 0
+                if bal < amt:
+                    c.query(d.rollback())
+                    return {**op, "type": "fail", "error": "insufficient"}
+                c.query(f"UPDATE accounts SET balance = balance - {amt} "
+                        f"WHERE id = {frm}")
+                c.query(f"UPDATE accounts SET balance = balance + {amt} "
+                        f"WHERE id = {to}")
+                c.query(d.commit())
+            except DBError:
+                self._try_rollback()
+                raise
+            return {**op, "type": "ok"}
+        return {**op, "type": "fail", "error": f"unknown f {op['f']!r}"}
+
+    # -- set -----------------------------------------------------------
+
+    def _set(self, op):
+        c = self.conn
+        if op["f"] == "add":
+            c.query(f"INSERT INTO sets (val) VALUES ({int(op['value'])})")
+            return {**op, "type": "ok"}
+        if op["f"] == "read":
+            rows = _rows(c.query("SELECT val FROM sets"))
+            return {**op, "type": "ok",
+                    "value": sorted(int(r[0]) for r in rows)}
+        return {**op, "type": "fail", "error": f"unknown f {op['f']!r}"}
+
+    # -- monotonic -----------------------------------------------------
+
+    def _monotonic(self, op):
+        c, d = self.conn, self.dialect
+        if op["f"] == "read":
+            rows = _rows(c.query("SELECT val FROM counter WHERE id = 0"))
+            v = int(rows[0][0]) if rows and rows[0][0] is not None else None
+            return {**op, "type": "ok", "value": v}
+        if op["f"] == "inc":
+            c.query(d.begin())
+            try:
+                rows = _rows(c.query(
+                    "SELECT val FROM counter WHERE id = 0"))
+                cur = int(rows[0][0]) if rows and rows[0][0] is not None \
+                    else 0
+                c.query(d.upsert("counter", 0, "val", str(cur + 1)))
+                c.query(d.commit())
+            except DBError:
+                self._try_rollback()
+                raise
+            return {**op, "type": "ok", "value": cur + 1}
+        return {**op, "type": "fail", "error": f"unknown f {op['f']!r}"}
+
+    # -- causal-reverse / sequential ----------------------------------
+
+    def _causal_reverse(self, op):
+        v = op["value"]
+        k, val = (v.key, v.value) if independent.is_tuple(v) else (0, v)
+        c = self.conn
+        if op["f"] == "write":
+            c.query(f"INSERT INTO cr (k, v) VALUES ({int(k)}, {int(val)})")
+            return {**op, "type": "ok"}
+        if op["f"] == "read":
+            rows = _rows(c.query(f"SELECT v FROM cr WHERE k = {int(k)}"))
+            out = sorted(int(r[0]) for r in rows)
+            return {**op, "type": "ok", "value": independent.tuple_(k, out)
+                    if independent.is_tuple(v) else out}
+        return {**op, "type": "fail", "error": f"unknown f {op['f']!r}"}
+
+    # -- adya g2 -------------------------------------------------------
+
+    def _g2(self, op):
+        v = op["value"]
+        k, pair = (v.key, v.value) if independent.is_tuple(v) else (0, v)
+        a_id, b_id = pair
+        c, d = self.conn, self.dialect
+        c.query(d.begin())
+        try:
+            ra = _rows(c.query(f"SELECT id FROM g2a WHERE k = {int(k)}"))
+            rb = _rows(c.query(f"SELECT id FROM g2b WHERE k = {int(k)}"))
+            if ra or rb:
+                c.query(d.rollback())
+                return {**op, "type": "fail", "error": "already-present"}
+            if a_id is not None:
+                c.query(f"INSERT INTO g2a (id, k) "
+                        f"VALUES ({int(a_id)}, {int(k)})")
+            else:
+                c.query(f"INSERT INTO g2b (id, k) "
+                        f"VALUES ({int(b_id)}, {int(k)})")
+            c.query(d.commit())
+        except DBError:
+            self._try_rollback()
+            raise
+        return {**op, "type": "ok"}
+
+    def _try_rollback(self):
+        try:
+            if self.conn is not None:
+                self.conn.query(self.dialect.rollback())
+        except (DBError, DriverError, OSError):
+            self.close(None)
+
+
+#: workload name -> SQLClient mode
+MODES = {
+    "register": "register", "append": "append", "wr": "wr",
+    "bank": "bank", "set": "set", "monotonic": "monotonic",
+    "sequential": "sequential", "long-fork": "wr", "g2": "g2",
+}
+
+
+def client_for(dialect: Dialect, workload: str, opts: dict | None = None
+               ) -> SQLClient:
+    opts = opts or {}
+    return SQLClient(dialect, MODES.get(workload, "register"),
+                     accounts=opts.get("accounts"),
+                     total=opts.get("total-amount", 100))

@@ -14,7 +14,7 @@ from .. import control
 from .. import db as jdb
 from .. import nemesis as jnemesis, os_setup
 from ..control import util as cutil
-from . import base_opts, standard_workloads, suite_test
+from . import base_opts, sql, standard_workloads, suite_test
 
 VERSION = "v3.0.3"
 DIR = "/opt/tidb"
@@ -77,12 +77,21 @@ def workloads(opts: dict | None = None) -> dict:
              "sequential", "monotonic")}
 
 
+def default_client(workload: str, opts: dict):
+    """mysql-protocol client on tidb-server's port (the reference
+    drives tidb through jdbc/mysql, tidb/src/tidb/sql.clj)."""
+    return sql.client_for(
+        sql.MySQLDialect(port=4000, user="root", database="test"),
+        workload, opts)
+
+
 def tidb_test(opts: dict | None = None) -> dict:
     opts = base_opts(**(opts or {}))
+    wname = opts.get("workload", "append")
     return suite_test(
-        "tidb", opts.get("workload", "append"), opts, workloads(opts),
+        "tidb", wname, opts, workloads(opts),
         db=TiDB(opts.get("version", VERSION)),
-        client=opts.get("client"),
+        client=opts.get("client") or default_client(wname, opts),
         nemesis=jnemesis.partition_random_halves(),
         os_setup=os_setup.debian())
 

@@ -1,0 +1,238 @@
+"""YCQL client: yugabyte's Cassandra-compatible API over drivers.cql.
+
+Counterpart of the reference's YCQL client namespaces
+(yugabyte/src/yugabyte/ycql/*, dual-API matrix at
+yugabyte/src/yugabyte/core.clj:74-110). CQL semantics differ from SQL in
+ways the workloads exploit:
+
+  * INSERT is an upsert (no duplicate-key errors) -> set-adds dedupe,
+  * CAS is a lightweight transaction: `UPDATE .. IF val = old`, whose
+    result row is `[applied]` (+ current values when not applied),
+  * multi-row atomicity is `BEGIN TRANSACTION .. END TRANSACTION;`
+    blocks (writes only — reads can't join, so the bank transfer reads
+    first, then writes computed balances in a txn block, exactly the
+    reference's lost-update-prone shape the checker exists to catch),
+  * lists are native: `val = val + [x]` appends.
+"""
+
+from __future__ import annotations
+
+from .. import client as jclient
+from .. import independent
+from ..drivers import DBError, DriverError
+from .sql import resolve
+
+KEYSPACE = "jepsen"
+
+DDL = [
+    f"CREATE KEYSPACE IF NOT EXISTS {KEYSPACE}",
+    f"USE {KEYSPACE}",
+    "CREATE TABLE IF NOT EXISTS registers (id bigint PRIMARY KEY,"
+    " val bigint) WITH transactions = {'enabled': true}",
+    "CREATE TABLE IF NOT EXISTS lists (id bigint PRIMARY KEY,"
+    " val list<bigint>) WITH transactions = {'enabled': true}",
+    "CREATE TABLE IF NOT EXISTS accounts (id bigint PRIMARY KEY,"
+    " balance bigint) WITH transactions = {'enabled': true}",
+    "CREATE TABLE IF NOT EXISTS sets (val bigint PRIMARY KEY)",
+    "CREATE TABLE IF NOT EXISTS counter (id bigint PRIMARY KEY,"
+    " val bigint) WITH transactions = {'enabled': true}",
+]
+
+
+class YCQLClient(jclient.Client):
+    def __init__(self, mode: str = "register", port: int = 9042,
+                 accounts: list | None = None, total: int = 100,
+                 node: str | None = None, timeout: float = 10.0):
+        self.mode = mode
+        self.port = port
+        self.accounts = accounts if accounts is not None else list(range(8))
+        self.total = total
+        self.node = node
+        self.timeout = timeout
+        self.conn = None
+        self._setup_done = False
+
+    def open(self, test, node):
+        return YCQLClient(self.mode, self.port, self.accounts,
+                          self.total, node, self.timeout)
+
+    def _ensure_conn(self, test):
+        if self.conn is None:
+            from ..drivers import cql
+            host, port = resolve(self.node, self.port, test or {})
+            self.conn = cql.connect(host, port, timeout=self.timeout)
+        if not self._setup_done:
+            for stmt in DDL:
+                self.conn.query(stmt)
+            if self.mode == "bank":
+                # INSERT IF NOT EXISTS: atomic seed (LWT)
+                self.conn.query(
+                    f"INSERT INTO accounts (id, balance) VALUES "
+                    f"(0, {self.total}) IF NOT EXISTS")
+                for a in self.accounts:
+                    if a != 0:
+                        self.conn.query(
+                            f"INSERT INTO accounts (id, balance) VALUES "
+                            f"({int(a)}, 0) IF NOT EXISTS")
+            self._setup_done = True
+
+    def close(self, test):
+        if self.conn is not None:
+            try:
+                self.conn.close()
+            finally:
+                self.conn = None
+
+    def invoke(self, test, op):
+        read_only = op.get("f") == "read"
+        try:
+            self._ensure_conn(test)
+            return self._dispatch(op)
+        except DBError as e:
+            return {**op, "type": "fail",
+                    "error": f"ycql-{e.code}: {e.message[:120]}"}
+        except (DriverError, OSError) as e:
+            self.close(test)
+            return {**op, "type": "fail" if read_only else "info",
+                    "error": str(e)[:160]}
+
+    def _dispatch(self, op):
+        if self.mode == "bank":
+            return self._bank(op)
+        if self.mode == "set":
+            return self._set(op)
+        if self.mode == "monotonic":
+            return self._monotonic(op)
+        if self.mode == "append":
+            return self._append(op)
+        return self._register(op)
+
+    def _register(self, op):
+        v = op["value"]
+        k, val = (v.key, v.value) if independent.is_tuple(v) else (0, v)
+        lift = (lambda x: independent.tuple_(k, x)) \
+            if independent.is_tuple(v) else (lambda x: x)
+        c = self.conn
+        if op["f"] == "read":
+            rows = c.query(f"SELECT val FROM registers "
+                           f"WHERE id = {int(k)}").rows
+            out = rows[0][0] if rows else None
+            return {**op, "type": "ok", "value": lift(out)}
+        if op["f"] == "write":
+            c.query(f"INSERT INTO registers (id, val) VALUES "
+                    f"({int(k)}, {int(val)})")
+            return {**op, "type": "ok"}
+        if op["f"] == "cas":
+            old, new = val
+            res = c.query(f"UPDATE registers SET val = {int(new)} "
+                          f"WHERE id = {int(k)} IF val = {int(old)}")
+            applied = bool(res.rows and res.rows[0][0])
+            if applied:
+                return {**op, "type": "ok"}
+            return {**op, "type": "fail", "error": "precondition"}
+        return {**op, "type": "fail", "error": f"unknown f {op['f']!r}"}
+
+    def _append(self, op):
+        mops = op["value"]
+        k0 = None
+        if independent.is_tuple(mops):
+            k0, mops = mops.key, mops.value
+        c = self.conn
+        out = []
+        # single-mop txns run direct; multi-mop writes use a txn block.
+        writes = [m for m in mops if m[0] == "append"]
+        if len(writes) > 1:
+            block = "BEGIN TRANSACTION " + " ".join(
+                f"UPDATE lists SET val = val + [{int(v)}] "
+                f"WHERE id = {int(k)};" for _, k, v in writes) + \
+                " END TRANSACTION;"
+            c.query(block)
+        for mf, mk, mv in mops:
+            if mf == "append":
+                if len(writes) <= 1:
+                    c.query(f"UPDATE lists SET val = val + [{int(mv)}] "
+                            f"WHERE id = {int(mk)}")
+                out.append([mf, mk, mv])
+            else:
+                rows = c.query(f"SELECT val FROM lists "
+                               f"WHERE id = {int(mk)}").rows
+                vals = rows[0][0] if rows and rows[0][0] else []
+                out.append([mf, mk, list(vals)])
+        new_v = independent.tuple_(k0, out) if k0 is not None else out
+        return {**op, "type": "ok", "value": new_v}
+
+    def _bank(self, op):
+        c = self.conn
+        if op["f"] == "read":
+            rows = c.query("SELECT id, balance FROM accounts").rows
+            return {**op, "type": "ok",
+                    "value": {int(r[0]): int(r[1]) for r in rows}}
+        if op["f"] == "transfer":
+            t = op["value"]
+            frm, to, amt = int(t["from"]), int(t["to"]), int(t["amount"])
+            rows = c.query(f"SELECT balance FROM accounts "
+                           f"WHERE id = {frm}").rows
+            b1 = int(rows[0][0]) if rows else 0
+            if b1 < amt:
+                return {**op, "type": "fail", "error": "insufficient"}
+            rows = c.query(f"SELECT balance FROM accounts "
+                           f"WHERE id = {to}").rows
+            b2 = int(rows[0][0]) if rows else 0
+            c.query("BEGIN TRANSACTION "
+                    f"UPDATE accounts SET balance = {b1 - amt} "
+                    f"WHERE id = {frm}; "
+                    f"UPDATE accounts SET balance = {b2 + amt} "
+                    f"WHERE id = {to}; "
+                    "END TRANSACTION;")
+            return {**op, "type": "ok"}
+        return {**op, "type": "fail", "error": f"unknown f {op['f']!r}"}
+
+    def _set(self, op):
+        c = self.conn
+        if op["f"] == "add":
+            c.query(f"INSERT INTO sets (val) VALUES ({int(op['value'])})")
+            return {**op, "type": "ok"}
+        if op["f"] == "read":
+            rows = c.query("SELECT val FROM sets").rows
+            return {**op, "type": "ok",
+                    "value": sorted(int(r[0]) for r in rows)}
+        return {**op, "type": "fail", "error": f"unknown f {op['f']!r}"}
+
+    def _monotonic(self, op):
+        c = self.conn
+        if op["f"] == "read":
+            rows = c.query("SELECT val FROM counter WHERE id = 0").rows
+            v = int(rows[0][0]) if rows and rows[0][0] is not None else None
+            return {**op, "type": "ok", "value": v}
+        if op["f"] == "inc":
+            # LWT loop: CAS val -> val+1 (the reference's counter
+            # workload shape)
+            for _ in range(16):
+                rows = c.query("SELECT val FROM counter "
+                               "WHERE id = 0").rows
+                cur = int(rows[0][0]) if rows and rows[0][0] is not None \
+                    else None
+                if cur is None:
+                    res = c.query("INSERT INTO counter (id, val) VALUES "
+                                  "(0, 1) IF NOT EXISTS")
+                else:
+                    res = c.query(f"UPDATE counter SET val = {cur + 1} "
+                                  f"WHERE id = 0 IF val = {cur}")
+                if bool(res.rows and res.rows[0][0]):
+                    return {**op, "type": "ok",
+                            "value": 1 if cur is None else cur + 1}
+            return {**op, "type": "fail", "error": "cas-exhausted"}
+        return {**op, "type": "fail", "error": f"unknown f {op['f']!r}"}
+
+
+#: workload -> YCQL mode (the reference's YCQL matrix subset: no wr /
+#: long-fork — reads can't join YCQL txn blocks)
+MODES = {"register": "register", "set": "set", "bank": "bank",
+         "monotonic": "monotonic", "append": "append"}
+
+
+def client_for(workload: str, opts: dict | None = None) -> YCQLClient:
+    opts = opts or {}
+    return YCQLClient(MODES.get(workload, "register"),
+                      accounts=opts.get("accounts"),
+                      total=opts.get("total-amount", 100))

@@ -15,7 +15,7 @@ from .. import control
 from .. import db as jdb
 from .. import nemesis as jnemesis, os_setup
 from ..control import util as cutil
-from . import base_opts, standard_workloads, suite_test
+from . import base_opts, sql, standard_workloads, suite_test
 
 VERSION = "1.3.1.0"
 DIR = "/opt/yugabyte"
@@ -68,14 +68,26 @@ def workloads(opts: dict | None = None) -> dict:
              "monotonic")}
 
 
+def default_client(api: str, workload: str, opts: dict):
+    """YSQL speaks pg-wire on 5433 (yugabyte/src/yugabyte/ysql).
+    YCQL speaks the CQL binary protocol on 9042 (yugabyte/ycql)."""
+    if api == "ycql":
+        from . import ycql
+        return ycql.client_for(workload, opts)
+    return sql.client_for(
+        sql.PGDialect(port=5433, user="yugabyte", database="yugabyte"),
+        workload, opts)
+
+
 def yugabyte_test(opts: dict | None = None) -> dict:
     opts = base_opts(**(opts or {}))
     api = opts.get("api", "ysql")
+    wname = opts.get("workload", "bank")
     test = suite_test(
-        f"yugabyte-{api}", opts.get("workload", "bank"), opts,
+        f"yugabyte-{api}", wname, opts,
         workloads(opts),
         db=YugaByteDB(opts.get("version", VERSION)),
-        client=opts.get("client"),
+        client=opts.get("client") or default_client(api, wname, opts),
         nemesis=jnemesis.partition_random_halves(),
         os_setup=os_setup.debian())
     test["api"] = api

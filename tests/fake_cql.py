@@ -1,0 +1,309 @@
+"""In-process fake CQL server: Cassandra native protocol v4 over
+localhost, backed by a MiniDB-style store with CQL semantics (INSERT is
+upsert, `IF` lightweight transactions, `BEGIN TRANSACTION` write
+blocks, native lists). The YCQL tier of the suite tests runs against
+this the way the SQL tiers run against fake_sql."""
+
+from __future__ import annotations
+
+import re
+import socketserver
+import struct
+import threading
+
+from fake_sql import MiniDB, SQLFail
+
+OP_ERROR, OP_STARTUP, OP_READY = 0x00, 0x01, 0x02
+OP_AUTHENTICATE, OP_QUERY, OP_RESULT = 0x03, 0x07, 0x08
+OP_AUTH_RESPONSE, OP_AUTH_SUCCESS = 0x0F, 0x10
+
+T_BIGINT, T_BOOLEAN, T_VARCHAR, T_LIST = 0x0002, 0x0004, 0x000D, 0x0020
+
+
+class MiniCQL:
+    """CQL executor over MiniDB tables."""
+
+    def __init__(self, db: MiniDB | None = None):
+        self.db = db or MiniDB()
+        self.lock = self.db.lock
+
+    _re_create = re.compile(
+        r"CREATE TABLE IF NOT EXISTS (\w+)\s*\((.*?)\)\s*(WITH .*)?$",
+        re.I | re.S)
+    _re_select = re.compile(
+        r"SELECT\s+(.+?)\s+FROM\s+(\w+)"
+        r"(?:\s+WHERE\s+(\w+)\s*=\s*(-?\d+))?\s*$", re.I)
+    _re_insert = re.compile(
+        r"INSERT INTO (\w+)\s*\(([^)]*)\)\s*VALUES\s*\(([^)]*)\)\s*"
+        r"(IF NOT EXISTS)?\s*$", re.I)
+    _re_update = re.compile(
+        r"UPDATE (\w+)\s+SET\s+(\w+)\s*=\s*(.+?)\s+WHERE\s+(\w+)\s*=\s*"
+        r"(-?\d+)(?:\s+IF\s+(\w+)\s*=\s*(-?\d+))?\s*$", re.I)
+
+    def execute(self, cql: str):
+        """-> (kind, columns, rows) where kind is 'void'|'rows'|
+        'set_keyspace'|'schema_change'."""
+        cql = cql.strip().rstrip(";").strip()
+        u = cql.upper()
+        if u.startswith("CREATE KEYSPACE"):
+            return "schema_change", [], []
+        if u.startswith("USE "):
+            return "set_keyspace", [], []
+        if u.startswith("BEGIN TRANSACTION"):
+            m = re.match(r"BEGIN TRANSACTION\s+(.*?)\s*END TRANSACTION",
+                         cql, re.I | re.S)
+            if not m:
+                raise SQLFail("0x2000", "malformed txn block")
+            with self.lock:
+                for stmt in filter(None, (s.strip() for s in
+                                          m.group(1).split(";"))):
+                    self.execute(stmt)
+            return "void", [], []
+        m = self._re_create.match(cql)
+        if m:
+            name, body = m.group(1).lower(), m.group(2)
+            cols, pk = [], []
+            for piece in re.split(r",(?![^<]*>)", body):
+                piece = piece.strip()
+                cname = piece.split()[0].lower()
+                cols.append(cname)
+                if "PRIMARY KEY" in piece.upper():
+                    pk.append(cname)
+            with self.lock:
+                self.db.create(name, cols, pk or cols[:1])
+            return "schema_change", [], []
+        m = self._re_select.match(cql)
+        if m:
+            cols = [c.strip().lower() for c in m.group(1).split(",")]
+            t = self.db.tables.get(m.group(2).lower())
+            if t is None:
+                raise SQLFail("0x2200", f"no table {m.group(2)}")
+            with self.lock:
+                rows = list(t["rows"].values())
+                if m.group(3):
+                    wc, wv = m.group(3).lower(), int(m.group(4))
+                    rows = [r for r in rows if r.get(wc) == wv]
+                return "rows", cols, [[r.get(c) for c in cols]
+                                      for r in rows]
+        m = self._re_insert.match(cql)
+        if m:
+            table = m.group(1).lower()
+            cols = [c.strip().lower() for c in m.group(2).split(",")]
+            vals = [_parse_val(v) for v in m.group(3).split(",")]
+            lwt = bool(m.group(4))
+            row = dict(zip(cols, vals))
+            with self.lock:
+                t = self.db.tables.get(table)
+                if t is None:
+                    raise SQLFail("0x2200", f"no table {table}")
+                for c in t["cols"]:
+                    row.setdefault(c, None)
+                pk = tuple(row[c] for c in t["pk"])
+                exists = pk in t["rows"]
+                if lwt:
+                    if exists:
+                        return "rows", ["[applied]"], [[False]]
+                    t["rows"][pk] = row
+                    return "rows", ["[applied]"], [[True]]
+                t["rows"][pk] = row  # CQL INSERT is an upsert
+                return "void", [], []
+        m = self._re_update.match(cql)
+        if m:
+            table, col = m.group(1).lower(), m.group(2).lower()
+            expr = m.group(3).strip()
+            wc, wv = m.group(4).lower(), int(m.group(5))
+            ifc = m.group(6).lower() if m.group(6) else None
+            ifv = int(m.group(7)) if m.group(7) else None
+            with self.lock:
+                t = self.db.tables.get(table)
+                if t is None:
+                    raise SQLFail("0x2200", f"no table {table}")
+                target = None
+                for pkv, r in t["rows"].items():
+                    if r.get(wc) == wv:
+                        target = r
+                        break
+                if ifc is not None:
+                    cur = target.get(ifc) if target else None
+                    if cur != ifv:
+                        return "rows", ["[applied]", ifc], [[False, cur]]
+                if target is None:
+                    # CQL UPDATE upserts the row
+                    target = {c: None for c in t["cols"]}
+                    target[wc] = wv
+                    t["rows"][tuple(target[c] for c in t["pk"])] = target
+                lm = re.match(rf"{col}\s*\+\s*\[(-?\d+)\]$", expr)
+                if lm:
+                    target[col] = (target.get(col) or []) + \
+                        [int(lm.group(1))]
+                else:
+                    target[col] = _parse_val(expr)
+                if ifc is not None:
+                    return "rows", ["[applied]"], [[True]]
+                return "void", [], []
+        raise SQLFail("0x2000", f"minicql cannot parse: {cql!r}")
+
+
+def _parse_val(s: str):
+    s = s.strip()
+    if s.startswith("'") and s.endswith("'"):
+        return s[1:-1]
+    if s.upper() == "NULL":
+        return None
+    return int(s)
+
+
+def _string(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack("!H", len(b)) + b
+
+
+def _col_type(values: list) -> tuple:
+    for v in values:
+        if v is None:
+            continue
+        if isinstance(v, bool):
+            return (T_BOOLEAN, None)
+        if isinstance(v, list):
+            return (T_LIST, (T_BIGINT, None))
+        if isinstance(v, int):
+            return (T_BIGINT, None)
+        return (T_VARCHAR, None)
+    return (T_VARCHAR, None)
+
+
+def _enc_type(t: tuple) -> bytes:
+    tid, inner = t
+    out = struct.pack("!H", tid)
+    if tid == T_LIST:
+        out += _enc_type(inner)
+    return out
+
+
+def _enc_value(v, t: tuple) -> bytes:
+    if v is None:
+        return struct.pack("!i", -1)
+    tid, inner = t
+    if tid == T_BOOLEAN:
+        b = b"\x01" if v else b"\x00"
+    elif tid == T_BIGINT:
+        b = struct.pack("!q", int(v))
+    elif tid == T_LIST:
+        b = struct.pack("!i", len(v))
+        for x in v:
+            b += _enc_value(x, inner)
+    else:
+        b = str(v).encode()
+    return struct.pack("!i", len(b)) + b
+
+
+def _rows_body(cols: list, rows: list) -> bytes:
+    types = [_col_type([r[i] for r in rows]) for i in range(len(cols))]
+    body = struct.pack("!iiI", 2, 0x0001, len(cols))   # kind=rows, global
+    body += _string("ks") + _string("t")
+    for c, t in zip(cols, types):
+        body += _string(c) + _enc_type(t)
+    body += struct.pack("!i", len(rows))
+    for r in rows:
+        for v, t in zip(r, types):
+            body += _enc_value(v, t)
+    return body
+
+
+class _CQLHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        srv: FakeCQLServer = self.server.owner  # type: ignore
+        sock = self.request
+        buf = b""
+
+        def recvn(n):
+            nonlocal buf
+            while len(buf) < n:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            out, rest = buf[:n], buf[n:]
+            buf = rest
+            return out
+
+        def send(opcode, body=b"", stream=0):
+            sock.sendall(struct.pack("!BBhBI", 0x84, 0, stream, opcode,
+                                     len(body)) + body)
+
+        try:
+            while True:
+                head = recvn(9)
+                _v, _f, stream, opcode, length = struct.unpack("!BBhBI",
+                                                               head)
+                body = recvn(length)
+                if opcode == OP_STARTUP:
+                    if srv.password:
+                        send(OP_AUTHENTICATE,
+                             _string("PasswordAuthenticator"), stream)
+                    else:
+                        send(OP_READY, b"", stream)
+                elif opcode == OP_AUTH_RESPONSE:
+                    (n,) = struct.unpack_from("!i", body, 0)
+                    token = body[4:4 + n]
+                    parts = token.split(b"\0")
+                    if (len(parts) >= 3 and
+                            parts[2].decode() == srv.password):
+                        send(OP_AUTH_SUCCESS, struct.pack("!i", -1),
+                             stream)
+                    else:
+                        send(OP_ERROR, struct.pack("!i", 0x0100) +
+                             _string("bad credentials"), stream)
+                        return
+                elif opcode == OP_QUERY:
+                    (n,) = struct.unpack_from("!I", body, 0)
+                    cql = body[4:4 + n].decode()
+                    try:
+                        kind, cols, rows = srv.db.execute(cql)
+                    except SQLFail as e:
+                        send(OP_ERROR, struct.pack("!i", 0x2200) +
+                             _string(e.message), stream)
+                        continue
+                    if kind == "rows":
+                        send(OP_RESULT, _rows_body(cols, rows), stream)
+                    elif kind == "set_keyspace":
+                        send(OP_RESULT, struct.pack("!i", 3) +
+                             _string("jepsen"), stream)
+                    elif kind == "schema_change":
+                        send(OP_RESULT, struct.pack("!i", 5) +
+                             _string("CREATED") + _string("TABLE") +
+                             _string("t"), stream)
+                    else:
+                        send(OP_RESULT, struct.pack("!i", 1), stream)
+                else:
+                    send(OP_ERROR, struct.pack("!i", 0x000A) +
+                         _string("unsupported opcode"), stream)
+        except ConnectionError:
+            pass
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class FakeCQLServer:
+    def __init__(self, password: str = "", db: MiniCQL | None = None):
+        self.db = db or MiniCQL()
+        self.password = password
+        self._srv = _Server(("127.0.0.1", 0), _CQLHandler)
+        self._srv.owner = self
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()

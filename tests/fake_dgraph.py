@@ -1,0 +1,264 @@
+"""In-process fake Dgraph alpha: the HTTP transaction API
+(/alter /query /mutate /commit) over an in-memory predicate store with
+snapshot-isolation-style write-write conflict detection — enough to run
+the dgraph suite's client end-to-end and to exercise the txn
+abort-on-conflict path the workloads rely on."""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+
+class DgraphStore:
+    def __init__(self):
+        self.nodes: dict[str, dict] = {}      # uid -> {pred: value}
+        self.next_uid = 1
+        self.next_ts = 1
+        # committed write keys: (pred, value) and uid -> commit_ts
+        self.commit_log: dict = {}
+        self.txns: dict[int, dict] = {}       # start_ts -> state
+        self.lock = threading.RLock()
+
+    def new_ts(self) -> int:
+        with self.lock:
+            ts = self.next_ts
+            self.next_ts += 1
+            return ts
+
+    def new_uid(self) -> str:
+        uid = f"0x{self.next_uid:x}"
+        self.next_uid += 1
+        return uid
+
+    # -- queries -------------------------------------------------------
+
+    _re_block = re.compile(
+        r"(\w+)\s+as\s+var\s*\(func:\s*(\w+)\(([^)]*)\)\)"
+        r"|(\w+)\s*\(func:\s*(\w+)\(([^)]*)\)\)\s*\{([^}]*)\}")
+
+    def query(self, dql: str) -> dict:
+        data = {}
+        with self.lock:
+            for m in self._re_block.finditer(dql):
+                if m.group(1):        # var block: name as var(func: ...)
+                    name, func, args = m.group(1), m.group(2), m.group(3)
+                    data["_var_" + name] = [
+                        uid for uid, _ in self._match(func, args)]
+                else:
+                    name, func, args = m.group(4), m.group(5), m.group(6)
+                    fields = m.group(7).split()
+                    out = []
+                    for uid, node in self._match(func, args):
+                        item = {}
+                        for f in fields:
+                            if f == "uid":
+                                item["uid"] = uid
+                            elif f in node:
+                                item[f] = node[f]
+                        out.append(item)
+                    data[name] = out
+        return data
+
+    def _match(self, func: str, args: str):
+        if func == "eq":
+            pred, val = [a.strip() for a in args.split(",", 1)]
+            val = int(val)
+            return [(u, n) for u, n in self.nodes.items()
+                    if n.get(pred) == val]
+        if func == "has":
+            pred = args.strip()
+            return [(u, n) for u, n in self.nodes.items() if pred in n]
+        return []
+
+    # -- mutations -----------------------------------------------------
+
+    def apply_set(self, set_objs: list, var_uids: dict) -> list:
+        """Apply under lock; returns the write keys touched. Mirrors
+        real dgraph: `uid(u)` with an empty var drops the object
+        silently (no node is created)."""
+        keys = []
+        for obj in set_objs:
+            uid = obj.get("uid")
+            if uid and uid.startswith("uid("):
+                var = uid[4:-1]
+                uids = var_uids.get(var, [])
+                if not uids:
+                    continue  # real dgraph: no-op, not an insert
+                uid = uids[0]
+            if not uid or uid.startswith("_:"):
+                uid = self.new_uid()
+            node = self.nodes.setdefault(uid, {})
+            keys.append(uid)
+            for pred, val in obj.items():
+                if pred == "uid":
+                    continue
+                node[pred] = val
+                keys.append((pred, val if not isinstance(val, dict)
+                             else str(val)))
+        return keys
+
+    @staticmethod
+    def _cond_ok(cond: str | None, var_uids: dict) -> bool:
+        if not cond:
+            return True
+        m = re.match(r"@if\((eq|gt|lt)\(len\((\w+)\),\s*(\d+)\)\)", cond)
+        if not m:
+            return True
+        n = len(var_uids.get(m.group(2), []))
+        want = int(m.group(3))
+        return {"eq": n == want, "gt": n > want,
+                "lt": n < want}[m.group(1)]
+
+    @staticmethod
+    def _blocks(body: dict) -> list[tuple]:
+        """-> [(cond, set_objs)] covering both the single-mutation and
+        the multi-block `mutations` upsert forms."""
+        if body.get("mutations") is not None:
+            return [(mu.get("cond"), mu.get("set") or [])
+                    for mu in body["mutations"]]
+        return [(body.get("cond"), body.get("set") or [])]
+
+    def mutate_commit_now(self, body: dict) -> None:
+        with self.lock:
+            var_uids = {}
+            if body.get("query"):
+                q = self.query(body["query"])
+                var_uids = {k[5:]: v for k, v in q.items()
+                            if k.startswith("_var_")}
+            keys = []
+            for cond, set_objs in self._blocks(body):
+                if self._cond_ok(cond, var_uids):
+                    keys += self.apply_set(set_objs, var_uids)
+            ts = self.new_ts()
+            for k in keys:
+                self.commit_log[k] = ts
+
+    def txn_mutate(self, start_ts: int, body: dict) -> None:
+        with self.lock:
+            st = self.txns.setdefault(start_ts, {"muts": [],
+                                                 "reads": []})
+            st["muts"].append(body)
+
+    def commit(self, start_ts: int, abort: bool) -> bool:
+        """True = committed; False = conflict abort."""
+        with self.lock:
+            st = self.txns.pop(start_ts, {"muts": []})
+            if abort:
+                return True
+            # predict write keys without applying, to check conflicts
+            pending_keys = []
+            for body in st["muts"]:
+                for _cond, set_objs in self._blocks(body):
+                    for obj in set_objs:
+                        uid = obj.get("uid")
+                        if uid and not uid.startswith("_:") and \
+                                not uid.startswith("uid("):
+                            pending_keys.append(uid)
+                        for pred, val in obj.items():
+                            if pred != "uid":
+                                pending_keys.append((pred, val))
+            for k in pending_keys:
+                if self.commit_log.get(k, 0) > start_ts:
+                    return False
+            for body in st["muts"]:
+                var_uids = {}
+                if body.get("query"):
+                    q = self.query(body["query"])
+                    var_uids = {k[5:]: v for k, v in q.items()
+                                if k.startswith("_var_")}
+                keys = []
+                for cond, set_objs in self._blocks(body):
+                    if self._cond_ok(cond, var_uids):
+                        keys += self.apply_set(set_objs, var_uids)
+                ts = self.new_ts()
+                for k in keys:
+                    self.commit_log[k] = ts
+            return True
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        srv: FakeDgraphServer = self.server.owner  # type: ignore
+        store = srv.store
+        parsed = urlparse(self.path)
+        qs = parse_qs(parsed.query)
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        path = parsed.path
+
+        def reply(obj, code=200):
+            data = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        if path == "/alter":
+            reply({"data": {"code": "Success"}})
+            return
+        if path == "/query":
+            start_ts = int(qs.get("startTs", [0])[0]) or store.new_ts()
+            data = {k: v for k, v in store.query(body.decode()).items()
+                    if not k.startswith("_var_")}
+            reply({"data": data,
+                   "extensions": {"txn": {"start_ts": start_ts}}})
+            return
+        if path == "/mutate":
+            mu = json.loads(body or b"{}")
+            commit_now = qs.get("commitNow", ["false"])[0] == "true"
+            start_ts = int(qs.get("startTs", [0])[0])
+            if commit_now or not start_ts:
+                store.mutate_commit_now(mu)
+                reply({"data": {"code": "Success"},
+                       "extensions": {"txn": {"start_ts":
+                                              store.new_ts()}}})
+            else:
+                store.txn_mutate(start_ts, mu)
+                reply({"data": {"code": "Success"},
+                       "extensions": {"txn": {"start_ts": start_ts,
+                                              "keys": ["k"],
+                                              "preds": ["p"]}}})
+            return
+        if path == "/commit":
+            start_ts = int(qs.get("startTs", [0])[0])
+            abort = qs.get("abort", ["false"])[0] == "true"
+            if store.commit(start_ts, abort):
+                reply({"data": {"code": "Success"}})
+            else:
+                reply({"errors": [{"message":
+                                   "Transaction has been aborted."
+                                   " Please retry",
+                                   "extensions":
+                                   {"code": "ErrorAborted"}}]},
+                      code=409)
+            return
+        reply({"errors": [{"message": f"no route {path}"}]}, code=404)
+
+
+class FakeDgraphServer:
+    def __init__(self):
+        self.store = DgraphStore()
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+        self._srv.owner = self
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()

@@ -1,0 +1,589 @@
+"""In-process fake SQL servers speaking real wire protocols.
+
+The reference tests its client stack against an in-JVM atom DB
+(jepsen/test/jepsen/tests.clj:27-67 atom-db/atom-client). Here the
+equivalent tier goes one layer deeper: a real TCP server speaking the
+PostgreSQL v3 / MySQL protocols over localhost, backed by `MiniDB`, an
+in-memory table store that executes exactly the statement shapes
+jepsen_tpu.suites.sql emits, serializably (one global lock held
+BEGIN..COMMIT). This exercises the wire drivers byte-for-byte AND gives
+end-to-end suite runs a linearizable SUT whose checks must pass.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+import re
+import socket
+import socketserver
+import struct
+import threading
+
+
+class SQLFail(Exception):
+    def __init__(self, code: str, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+class MiniDB:
+    """Tables of dict rows; global lock => serializable."""
+
+    def __init__(self):
+        self.tables: dict = {}
+        self.lock = threading.RLock()
+
+    def create(self, name: str, cols: list[str], pk: list[str]):
+        self.tables.setdefault(
+            name, {"cols": cols, "pk": pk, "rows": {}})
+
+    def _pk(self, table: str, row: dict):
+        t = self.tables[table]
+        return tuple(row[c] for c in t["pk"])
+
+    # -- statement execution (the MiniSQL dialect) ---------------------
+
+    _re_create = re.compile(
+        r"CREATE TABLE IF NOT EXISTS (\w+)\s*\((.*)\)\s*$", re.I | re.S)
+    _re_select = re.compile(
+        r"SELECT\s+(.+?)\s+FROM\s+(\w+)(?:\s+WHERE\s+(\w+)\s*=\s*(-?\d+))?"
+        r"(?:\s+FOR UPDATE)?\s*$", re.I)
+    _re_insert = re.compile(
+        r"INSERT INTO (\w+)\s*\(([^)]*)\)\s*VALUES\s*\(([^)]*)\)\s*(.*)$",
+        re.I | re.S)
+    _re_update = re.compile(
+        r"UPDATE (\w+)\s+SET\s+(\w+)\s*=\s*(.+?)\s+WHERE\s+(\w+)\s*=\s*"
+        r"(-?\d+)\s*$", re.I)
+
+    def execute(self, sql: str, txn: "Txn") -> tuple[list, list, str]:
+        """-> (columns, rows, tag)."""
+        sql = sql.strip().rstrip(";").strip()
+        u = sql.upper()
+        if u in ("BEGIN", "START TRANSACTION"):
+            txn.begin()
+            return [], [], "BEGIN"
+        if u == "COMMIT":
+            txn.commit()
+            return [], [], "COMMIT"
+        if u == "ROLLBACK":
+            txn.rollback()
+            return [], [], "ROLLBACK"
+        if u == "SELECT 1":
+            return ["?column?"], [["1"]], "SELECT 1"
+        m = self._re_create.match(sql)
+        if m:
+            name, body = m.group(1).lower(), m.group(2)
+            pk_m = re.search(r"PRIMARY KEY\s*\(([^)]*)\)", body, re.I)
+            cols = []
+            for piece in re.split(r",(?![^(]*\))", body):
+                piece = piece.strip()
+                if piece.upper().startswith("PRIMARY KEY"):
+                    continue
+                cols.append(piece.split()[0].lower())
+            if pk_m:
+                pk = [c.strip().lower() for c in pk_m.group(1).split(",")]
+            else:
+                pk = [c for c, piece in zip(
+                    cols, re.split(r",(?![^(]*\))", body))
+                    if "PRIMARY KEY" in piece.upper()] or cols[:1]
+            with self.lock:
+                self.create(name, cols, pk)
+            return [], [], "CREATE TABLE"
+        m = self._re_select.match(sql)
+        if m:
+            return self._select(m, txn)
+        m = self._re_insert.match(sql)
+        if m:
+            return self._insert(m, txn)
+        m = self._re_update.match(sql)
+        if m:
+            return self._update(m, txn)
+        raise SQLFail("42601", f"minidb cannot parse: {sql!r}")
+
+    def _select(self, m, txn):
+        cols = [c.strip().lower() for c in m.group(1).split(",")]
+        table = m.group(2).lower()
+        with txn.held():
+            t = self.tables.get(table)
+            if t is None:
+                raise SQLFail("42P01", f"no table {table}")
+            rows = list(t["rows"].values())
+            if m.group(3):
+                wc, wv = m.group(3).lower(), int(m.group(4))
+                rows = [r for r in rows if r.get(wc) == wv]
+            out = [[_fmt(r.get(c)) for c in cols] for r in rows]
+            return cols, out, f"SELECT {len(out)}"
+
+    def _insert(self, m, txn):
+        table = m.group(1).lower()
+        cols = [c.strip().lower() for c in m.group(2).split(",")]
+        vals = [_parse_val(v) for v in _split_vals(m.group(3))]
+        clause = m.group(4).strip()
+        row = dict(zip(cols, vals))
+        with txn.held():
+            t = self.tables.get(table)
+            if t is None:
+                raise SQLFail("42P01", f"no table {table}")
+            for c in t["cols"]:
+                row.setdefault(c, None)
+            pk = self._pk(table, row)
+            exists = pk in t["rows"]
+            cu = clause.upper()
+            if exists and not cu:
+                raise SQLFail("23505", f"duplicate key {pk} in {table}")
+            if exists and "DO NOTHING" in cu:
+                return [], [], "INSERT 0 0"
+            if exists and ("DO UPDATE" in cu or "ON DUPLICATE" in cu):
+                old = t["rows"][pk]
+                if "||" in clause or "CONCAT" in cu:
+                    old["val"] = f"{old['val']},{row['val']}"
+                elif re.search(r"balance\s*=\s*balance\b", clause):
+                    pass  # DO UPDATE SET balance = balance (no-op seed)
+                else:
+                    sm = re.search(
+                        r"(\w+)\s*=\s*(?:excluded\.\w+|VALUES\s*\()",
+                        clause, re.I)
+                    if sm is None:
+                        raise SQLFail(
+                            "42601", f"minidb bad upsert: {clause!r}")
+                    col = sm.group(1).lower()
+                    old[col] = row[col]
+                return [], [], "INSERT 0 1"
+            t["rows"][pk] = row
+            return [], [], "INSERT 0 1"
+
+    def _update(self, m, txn):
+        table, col, expr = m.group(1).lower(), m.group(2).lower(), \
+            m.group(3).strip()
+        wc, wv = m.group(4).lower(), int(m.group(5))
+        with txn.held():
+            t = self.tables.get(table)
+            if t is None:
+                raise SQLFail("42P01", f"no table {table}")
+            n = 0
+            for r in t["rows"].values():
+                if r.get(wc) != wv:
+                    continue
+                em = re.match(rf"{col}\s*([+-])\s*(\d+)$", expr)
+                if em:
+                    delta = int(em.group(2))
+                    r[col] = (r[col] or 0) + (
+                        delta if em.group(1) == "+" else -delta)
+                else:
+                    r[col] = _parse_val(expr)
+                n += 1
+            return [], [], f"UPDATE {n}"
+
+
+def _split_vals(s: str) -> list[str]:
+    return [p.strip() for p in s.split(",")]
+
+
+def _parse_val(s: str):
+    s = s.strip()
+    if s.startswith("'") and s.endswith("'"):
+        return s[1:-1]
+    if s.upper() == "NULL":
+        return None
+    return int(s)
+
+
+def _fmt(v):
+    return None if v is None else str(v)
+
+
+class Txn:
+    """Per-connection transaction state over MiniDB's global lock:
+    `held()` acquires for a single statement, or no-ops when the
+    connection holds the lock BEGIN..COMMIT."""
+
+    def __init__(self, db: MiniDB):
+        self.db = db
+        self.active = False
+
+    def begin(self):
+        if not self.active:
+            self.db.lock.acquire()
+            self.active = True
+
+    def commit(self):
+        if self.active:
+            self.active = False
+            self.db.lock.release()
+
+    rollback = commit  # single-version store: rollback == release
+    # (clients only roll back before any write, so this stays safe for
+    # the statement shapes suites.sql emits: cas/g2 roll back pre-write,
+    # bank rolls back pre-update)
+
+    def held(self):
+        return self if self.active else self.db.lock
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+# ---------------------------------------------------------------------
+# PostgreSQL v3 protocol server
+
+
+class _PGHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        srv: FakePGServer = self.server.owner  # type: ignore
+        sock = self.request
+        buf = b""
+
+        def recvn(n):
+            nonlocal buf
+            while len(buf) < n:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            out, buf2 = buf[:n], buf[n:]
+            buf = buf2
+            return out
+
+        def send(t, payload=b""):
+            sock.sendall(t + struct.pack("!I", len(payload) + 4) + payload)
+
+        txn = Txn(srv.db)
+        try:
+            (length,) = struct.unpack("!I", recvn(4))
+            startup = recvn(length - 4)
+            (ver,) = struct.unpack("!I", startup[:4])
+            if ver != 196608:
+                return
+            kv = startup[4:].split(b"\0")
+            params = dict(zip(kv[0::2], kv[1::2]))
+            user = params.get(b"user", b"").decode()
+            if not self._auth(send, recvn, srv, user):
+                return
+            send(b"S", b"server_version\0faketpg 1.0\0")
+            send(b"K", struct.pack("!II", os.getpid() & 0x7FFFFFFF, 1))
+            send(b"Z", b"I")
+            while True:
+                mtype = recvn(1)
+                (mlen,) = struct.unpack("!I", recvn(4))
+                payload = recvn(mlen - 4)
+                if mtype == b"X":
+                    return
+                if mtype != b"Q":
+                    send(b"E", _pg_err("08P01", "unexpected message"))
+                    send(b"Z", b"I")
+                    continue
+                sql_all = payload.rstrip(b"\0").decode()
+                try:
+                    for stmt in filter(None,
+                                       (s.strip() for s in
+                                        sql_all.split(";"))):
+                        cols, rows, tag = srv.db.execute(stmt, txn)
+                        if cols:
+                            send(b"T", _pg_rowdesc(cols))
+                            for r in rows:
+                                send(b"D", _pg_datarow(r))
+                        send(b"C", tag.encode() + b"\0")
+                except SQLFail as e:
+                    txn.rollback()
+                    send(b"E", _pg_err(e.code, e.message))
+                send(b"Z", b"T" if txn.active else b"I")
+        except ConnectionError:
+            pass
+        finally:
+            txn.rollback()
+
+    def _auth(self, send, recvn, srv, user) -> bool:
+        mode = srv.auth
+        if mode == "trust":
+            send(b"R", struct.pack("!I", 0))
+            return True
+
+        def read_pw_msg():
+            t = recvn(1)
+            (n,) = struct.unpack("!I", recvn(4))
+            body = recvn(n - 4)
+            assert t == b"p", t
+            return body
+
+        if mode == "cleartext":
+            send(b"R", struct.pack("!I", 3))
+            pw = read_pw_msg().rstrip(b"\0").decode()
+            ok = pw == srv.password
+        elif mode == "md5":
+            salt = os.urandom(4)
+            send(b"R", struct.pack("!I", 5) + salt)
+            got = read_pw_msg().rstrip(b"\0").decode()
+            inner = hashlib.md5(
+                srv.password.encode() + user.encode()).hexdigest()
+            want = "md5" + hashlib.md5(inner.encode() + salt).hexdigest()
+            ok = got == want
+        elif mode == "scram":
+            send(b"R", struct.pack("!I", 10) + b"SCRAM-SHA-256\0\0")
+            body = read_pw_msg()
+            zero = body.index(b"\0")
+            (ilen,) = struct.unpack("!I", body[zero + 1:zero + 5])
+            client_first = body[zero + 5:zero + 5 + ilen].decode()
+            cf_bare = client_first.split(",", 2)[2]
+            cnonce = dict(p.split("=", 1)
+                          for p in cf_bare.split(","))["r"]
+            snonce = cnonce + base64.b64encode(os.urandom(9)).decode()
+            salt = os.urandom(16)
+            it = 4096
+            server_first = (f"r={snonce},s="
+                            f"{base64.b64encode(salt).decode()},i={it}")
+            send(b"R", struct.pack("!I", 11) + server_first.encode())
+            final = read_pw_msg().decode()
+            fparts = dict(p.split("=", 1) for p in final.split(","))
+            final_bare = final[:final.rindex(",p=")]
+            auth_msg = ",".join((cf_bare, server_first,
+                                 final_bare)).encode()
+            salted = hashlib.pbkdf2_hmac(
+                "sha256", srv.password.encode(), salt, it)
+            client_key = hmac.digest(salted, b"Client Key", "sha256")
+            stored = hashlib.sha256(client_key).digest()
+            sig = hmac.digest(stored, auth_msg, "sha256")
+            proof = base64.b64decode(fparts["p"])
+            recovered = bytes(a ^ b for a, b in zip(proof, sig))
+            ok = hashlib.sha256(recovered).digest() == stored
+            if ok:
+                skey = hmac.digest(salted, b"Server Key", "sha256")
+                ssig = hmac.digest(skey, auth_msg, "sha256")
+                send(b"R", struct.pack("!I", 12) + b"v=" +
+                     base64.b64encode(ssig))
+        else:
+            raise ValueError(mode)
+        if not ok:
+            send(b"E", _pg_err("28P01", "password authentication failed"))
+            return False
+        send(b"R", struct.pack("!I", 0))
+        return True
+
+
+def _pg_err(code: str, msg: str) -> bytes:
+    return (b"SERROR\0" + b"C" + code.encode() + b"\0" +
+            b"M" + msg.encode() + b"\0\0")
+
+
+def _pg_rowdesc(cols: list[str]) -> bytes:
+    out = struct.pack("!H", len(cols))
+    for c in cols:
+        out += c.encode() + b"\0" + struct.pack(
+            "!IhIhih", 0, 0, 25, -1, -1, 0)  # text oid 25
+    return out
+
+
+def _pg_datarow(row: list) -> bytes:
+    out = struct.pack("!H", len(row))
+    for v in row:
+        if v is None:
+            out += struct.pack("!i", -1)
+        else:
+            b = str(v).encode()
+            out += struct.pack("!i", len(b)) + b
+    return out
+
+
+# ---------------------------------------------------------------------
+# MySQL protocol server
+
+
+class _MyHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        srv: FakeMySQLServer = self.server.owner  # type: ignore
+        sock = self.request
+        buf = b""
+        seq = 0
+
+        def recvn(n):
+            nonlocal buf
+            while len(buf) < n:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            out, buf2 = buf[:n], buf[n:]
+            buf = buf2
+            return out
+
+        def recv_packet():
+            nonlocal seq
+            head = recvn(4)
+            n = head[0] | (head[1] << 8) | (head[2] << 16)
+            seq = (head[3] + 1) & 0xFF
+            return recvn(n)
+
+        def send_packet(payload):
+            nonlocal seq
+            sock.sendall(struct.pack("<I", len(payload))[:3] +
+                         bytes([seq]) + payload)
+            seq = (seq + 1) & 0xFF
+
+        txn = Txn(srv.db)
+        try:
+            scramble = os.urandom(20)
+            greeting = (bytes([10]) + b"5.7.faketpmy\0" +
+                        struct.pack("<I", 42) + scramble[:8] + b"\0" +
+                        struct.pack("<H", 0xF7FF) + bytes([33]) +
+                        struct.pack("<H", 2) +
+                        struct.pack("<H", 0x000F) + bytes([21]) +
+                        b"\0" * 10 + scramble[8:] + b"\0" +
+                        b"mysql_native_password\0")
+            send_packet(greeting)
+            resp = recv_packet()
+            (caps,) = struct.unpack_from("<I", resp, 0)
+            off = 4 + 4 + 1 + 23
+            end = resp.index(b"\0", off)
+            off = end + 1
+            alen = resp[off]
+            auth = resp[off + 1:off + 1 + alen]
+            if srv.password:
+                h1 = hashlib.sha1(srv.password.encode()).digest()
+                h2 = hashlib.sha1(h1).digest()
+                h3 = hashlib.sha1(scramble + h2).digest()
+                want = bytes(a ^ b for a, b in zip(h1, h3))
+                if auth != want:
+                    send_packet(_my_err(1045, "28000",
+                                        "Access denied"))
+                    return
+            send_packet(_my_ok())
+            while True:
+                seq = 0
+                cmd = recv_packet()
+                if not cmd or cmd[0] == 0x01:      # COM_QUIT
+                    return
+                if cmd[0] != 0x03:
+                    send_packet(_my_err(1047, "08S01", "unknown command"))
+                    continue
+                sql = cmd[1:].decode()
+                try:
+                    cols, rows, tag = srv.db.execute(sql, txn)
+                    if cols:
+                        send_packet(bytes([len(cols)]))
+                        for c in cols:
+                            send_packet(_my_coldef(c))
+                        send_packet(_my_eof())
+                        for r in rows:
+                            send_packet(_my_row(r))
+                        send_packet(_my_eof())
+                    else:
+                        m = re.match(r"(INSERT|UPDATE)\s+(\d+)\s*(\d+)?",
+                                     tag)
+                        affected = int(m.group(m.lastindex)) if m else 0
+                        send_packet(_my_ok(affected))
+                except SQLFail as e:
+                    txn.rollback()
+                    send_packet(_my_err(
+                        1062 if e.code == "23505" else 1064,
+                        "40001" if e.code == "23505" else "42000",
+                        e.message))
+        except ConnectionError:
+            pass
+        finally:
+            txn.rollback()
+
+
+def _my_lenenc(n: int) -> bytes:
+    if n < 251:
+        return bytes([n])
+    if n < 1 << 16:
+        return b"\xfc" + struct.pack("<H", n)
+    if n < 1 << 24:
+        return b"\xfd" + struct.pack("<I", n)[:3]
+    return b"\xfe" + struct.pack("<Q", n)
+
+
+def _my_lcs(s: bytes) -> bytes:
+    return _my_lenenc(len(s)) + s
+
+
+def _my_ok(affected: int = 0) -> bytes:
+    return (b"\x00" + _my_lenenc(affected) + _my_lenenc(0) +
+            struct.pack("<HH", 2, 0))
+
+
+def _my_eof() -> bytes:
+    return b"\xfe" + struct.pack("<HH", 0, 2)
+
+
+def _my_err(code: int, state: str, msg: str) -> bytes:
+    return (b"\xff" + struct.pack("<H", code) + b"#" + state.encode() +
+            msg.encode())
+
+
+def _my_coldef(name: str) -> bytes:
+    return (_my_lcs(b"def") + _my_lcs(b"") + _my_lcs(b"t") +
+            _my_lcs(b"t") + _my_lcs(name.encode()) +
+            _my_lcs(name.encode()) + bytes([0x0C]) +
+            struct.pack("<HIBHB", 33, 255, 0xFD, 0, 0) + b"\0\0")
+
+
+def _my_row(row: list) -> bytes:
+    out = b""
+    for v in row:
+        if v is None:
+            out += b"\xfb"
+        else:
+            out += _my_lcs(str(v).encode())
+    return out
+
+
+# ---------------------------------------------------------------------
+# server wrappers
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class FakePGServer:
+    def __init__(self, auth: str = "trust", password: str = "",
+                 db: MiniDB | None = None):
+        self.db = db or MiniDB()
+        self.auth = auth
+        self.password = password
+        self._srv = _Server(("127.0.0.1", 0), _PGHandler)
+        self._srv.owner = self
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+class FakeMySQLServer:
+    def __init__(self, password: str = "", db: MiniDB | None = None):
+        self.db = db or MiniDB()
+        self.password = password
+        self._srv = _Server(("127.0.0.1", 0), _MyHandler)
+        self._srv.owner = self
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()

@@ -1,0 +1,114 @@
+"""Dgraph HTTP driver + client tests against the fake alpha, and the
+dgraph suite end-to-end."""
+
+from __future__ import annotations
+
+import pytest
+
+from jepsen_tpu import core, independent, net as jnet
+from jepsen_tpu.drivers import DBError, dgraph_http
+from jepsen_tpu.store import Store
+from jepsen_tpu.suites import dgraph
+
+from fake_dgraph import FakeDgraphServer
+
+
+def hosts_for(srv):
+    return {n: ("127.0.0.1", srv.port)
+            for n in ("n1", "n2", "n3", "n4", "n5")}
+
+
+def test_driver_mutate_query_roundtrip():
+    with FakeDgraphServer() as srv:
+        c = dgraph_http.connect("127.0.0.1", srv.port)
+        c.alter("key: int @index(int) .")
+        c.mutate(set_obj=[{"key": 1, "val": 10}])
+        out = c.query("{ q(func: eq(key, 1)) { val } }")
+        assert out["data"]["q"] == [{"val": 10}]
+
+
+def test_driver_txn_conflict_aborts():
+    with FakeDgraphServer() as srv:
+        c = dgraph_http.connect("127.0.0.1", srv.port)
+        c.mutate(set_obj=[{"key": 5, "val": 0}])
+        t1, t2 = c.begin(), c.begin()
+        n1 = t1.query("{ q(func: eq(key, 5)) { uid val } }"
+                      )["data"]["q"][0]
+        n2 = t2.query("{ q(func: eq(key, 5)) { uid val } }"
+                      )["data"]["q"][0]
+        t1.mutate(set_obj=[{"uid": n1["uid"], "key": 5, "val": 1}])
+        t2.mutate(set_obj=[{"uid": n2["uid"], "key": 5, "val": 2}])
+        t1.commit()
+        with pytest.raises(DBError):
+            t2.commit()
+        out = c.query("{ q(func: eq(key, 5)) { val } }")
+        assert out["data"]["q"] == [{"val": 1}]
+
+
+def test_client_register_and_cas():
+    with FakeDgraphServer() as srv:
+        test = {"db-hosts": hosts_for(srv)}
+        c = dgraph.DgraphClient("register").open(test, "n1")
+        kv = independent.tuple_(2, 9)
+        assert c.invoke(test, {"type": "invoke", "f": "write",
+                               "value": kv, "process": 0})["type"] == "ok"
+        r = c.invoke(test, {"type": "invoke", "f": "read",
+                            "value": independent.tuple_(2, None),
+                            "process": 0})
+        assert r["value"].value == 9
+        ok = c.invoke(test, {"type": "invoke", "f": "cas",
+                             "value": independent.tuple_(2, [9, 10]),
+                             "process": 0})
+        assert ok["type"] == "ok"
+        miss = c.invoke(test, {"type": "invoke", "f": "cas",
+                               "value": independent.tuple_(2, [9, 11]),
+                               "process": 0})
+        assert miss["type"] == "fail"
+
+
+def test_client_bank_conserves_total():
+    with FakeDgraphServer() as srv:
+        test = {"db-hosts": hosts_for(srv)}
+        c = dgraph.DgraphClient("bank").open(test, "n1")
+        r = c.invoke(test, {"type": "invoke", "f": "read", "value": None,
+                            "process": 0})
+        assert sum(r["value"].values()) == 100
+        t = c.invoke(test, {"type": "invoke", "f": "transfer",
+                            "process": 0,
+                            "value": {"from": 0, "to": 4, "amount": 7}})
+        assert t["type"] == "ok"
+        r = c.invoke(test, {"type": "invoke", "f": "read", "value": None,
+                            "process": 0})
+        assert sum(r["value"].values()) == 100 and r["value"][4] == 7
+
+
+def test_client_g2_upsert_at_most_one():
+    with FakeDgraphServer() as srv:
+        test = {"db-hosts": hosts_for(srv)}
+        c = dgraph.DgraphClient("g2").open(test, "n1")
+        first = c.invoke(test, {"type": "invoke", "f": "insert",
+                                "process": 0,
+                                "value": independent.tuple_(1, [5, None])})
+        assert first["type"] == "ok"
+        second = c.invoke(test, {"type": "invoke", "f": "insert",
+                                 "process": 0,
+                                 "value": independent.tuple_(
+                                     1, [None, 6])})
+        assert second["type"] == "fail"
+
+
+def test_dgraph_suite_end_to_end(tmp_path):
+    with FakeDgraphServer() as srv:
+        opts = {
+            "workload": "set",
+            "ssh": {"dummy": True}, "time-limit": 1.0,
+            "extra": {"net": jnet.noop(),
+                      "store": Store(tmp_path / "store")},
+            "db-hosts": hosts_for(srv),
+        }
+        test = dgraph.dgraph_test(opts)
+        for k in ("db", "os", "nemesis"):
+            test.pop(k, None)
+        test = core.run(test)
+    r = test["results"]
+    assert r["valid?"] is True, r

@@ -1,0 +1,131 @@
+"""CQL driver + YCQL client tests against the fake CQL server, plus the
+yugabyte-ycql suite end-to-end."""
+
+from __future__ import annotations
+
+import pytest
+
+from jepsen_tpu import core, independent, net as jnet
+from jepsen_tpu.drivers import DBError, cql
+from jepsen_tpu.store import Store
+from jepsen_tpu.suites import yugabyte, ycql
+
+from fake_cql import FakeCQLServer
+
+
+def test_cql_driver_roundtrip():
+    with FakeCQLServer() as srv:
+        conn = cql.connect("127.0.0.1", srv.port)
+        conn.query("CREATE KEYSPACE IF NOT EXISTS jepsen")
+        conn.query("USE jepsen")
+        conn.query("CREATE TABLE IF NOT EXISTS registers "
+                   "(id bigint PRIMARY KEY, val bigint) "
+                   "WITH transactions = {'enabled': true}")
+        conn.query("INSERT INTO registers (id, val) VALUES (1, 5)")
+        res = conn.query("SELECT val FROM registers WHERE id = 1")
+        assert res.rows == [[5]]          # typed bigint, not text
+        # LWT applied / not applied
+        r = conn.query("UPDATE registers SET val = 6 WHERE id = 1 "
+                       "IF val = 5")
+        assert r.columns[0] == "[applied]" and r.rows[0][0] is True
+        r = conn.query("UPDATE registers SET val = 9 WHERE id = 1 "
+                       "IF val = 5")
+        assert r.rows[0][0] is False
+        conn.close()
+
+
+def test_cql_auth():
+    with FakeCQLServer(password="cassandra") as srv:
+        conn = cql.connect("127.0.0.1", srv.port, user="cassandra",
+                           password="cassandra")
+        conn.query("CREATE KEYSPACE IF NOT EXISTS jepsen")
+        conn.close()
+        with pytest.raises(DBError):
+            cql.connect("127.0.0.1", srv.port, user="x", password="bad")
+
+
+def test_cql_lists():
+    with FakeCQLServer() as srv:
+        conn = cql.connect("127.0.0.1", srv.port)
+        conn.query("CREATE TABLE IF NOT EXISTS lists "
+                   "(id bigint PRIMARY KEY, val list<bigint>)")
+        for v in (1, 2, 3):
+            conn.query(f"UPDATE lists SET val = val + [{v}] "
+                       f"WHERE id = 4")
+        res = conn.query("SELECT val FROM lists WHERE id = 4")
+        assert res.rows == [[[1, 2, 3]]]
+        conn.close()
+
+
+def hosts_for(srv):
+    return {n: ("127.0.0.1", srv.port)
+            for n in ("n1", "n2", "n3", "n4", "n5")}
+
+
+def test_ycql_client_ops():
+    with FakeCQLServer() as srv:
+        test = {"db-hosts": hosts_for(srv)}
+        c = ycql.YCQLClient("register").open(test, "n1")
+        kv = independent.tuple_(3, 7)
+        assert c.invoke(test, {"type": "invoke", "f": "write",
+                               "value": kv, "process": 0})["type"] == "ok"
+        r = c.invoke(test, {"type": "invoke", "f": "read",
+                            "value": independent.tuple_(3, None),
+                            "process": 0})
+        assert r["value"].value == 7
+        ok = c.invoke(test, {"type": "invoke", "f": "cas",
+                             "value": independent.tuple_(3, [7, 8]),
+                             "process": 0})
+        assert ok["type"] == "ok"
+        miss = c.invoke(test, {"type": "invoke", "f": "cas",
+                               "value": independent.tuple_(3, [7, 9]),
+                               "process": 0})
+        assert miss["type"] == "fail"
+        c.close(test)
+
+        b = ycql.YCQLClient("bank").open(test, "n1")
+        r = b.invoke(test, {"type": "invoke", "f": "read", "value": None,
+                            "process": 0})
+        assert sum(r["value"].values()) == 100
+        t = b.invoke(test, {"type": "invoke", "f": "transfer",
+                            "process": 0,
+                            "value": {"from": 0, "to": 2, "amount": 10}})
+        assert t["type"] == "ok"
+        r = b.invoke(test, {"type": "invoke", "f": "read", "value": None,
+                            "process": 0})
+        assert sum(r["value"].values()) == 100 and r["value"][2] == 10
+        b.close(test)
+
+        m = ycql.YCQLClient("monotonic").open(test, "n1")
+        assert m.invoke(test, {"type": "invoke", "f": "inc",
+                               "value": None, "process": 0})["value"] == 1
+        assert m.invoke(test, {"type": "invoke", "f": "inc",
+                               "value": None, "process": 0})["value"] == 2
+        m.close(test)
+
+        a = ycql.YCQLClient("append").open(test, "n1")
+        r = a.invoke(test, {"type": "invoke", "f": "txn", "process": 0,
+                            "value": [["append", 1, 10],
+                                      ["append", 2, 20],
+                                      ["r", 1, None]]})
+        assert r["type"] == "ok"
+        assert r["value"][2] == ["r", 1, [10]]
+        a.close(test)
+
+
+def test_yugabyte_ycql_suite_end_to_end(tmp_path):
+    with FakeCQLServer() as srv:
+        opts = {
+            "api": "ycql", "workload": "register",
+            "ssh": {"dummy": True}, "time-limit": 1.0,
+            "extra": {"net": jnet.noop(),
+                      "store": Store(tmp_path / "store")},
+            "db-hosts": hosts_for(srv),
+        }
+        test = yugabyte.yugabyte_test(opts)
+        for k in ("db", "os", "nemesis"):
+            test.pop(k, None)
+        test = core.run(test)
+    r = test["results"]
+    assert r["valid?"] is True
+    assert test["api"] == "ycql"
