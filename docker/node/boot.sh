@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Node entrypoint: trust the generated cluster key, then sshd.
+set -euo pipefail
+
+if [ -f /run/jepsen-secret/id_ed25519.pub ]; then
+    install -m 600 /run/jepsen-secret/id_ed25519.pub \
+        /root/.ssh/authorized_keys
+fi
+exec /usr/sbin/sshd -D -e

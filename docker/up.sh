@@ -1,0 +1,47 @@
+#!/usr/bin/env bash
+# Bring up the 1-control + 5-node development cluster (reference
+# harness analogue: docker/up.sh there). Generates the cluster SSH key
+# on first run, builds and starts the containers, then opens a shell on
+# the control node. Options:
+#   --daemon      start and return (no control shell)
+#   --down        stop and remove the cluster
+#   --test        start, run the SSH integration test tier, tear down
+set -euo pipefail
+
+cd "$(dirname "${BASH_SOURCE[0]}")"
+
+COMPOSE_CMD=${COMPOSE_CMD:-"docker compose"}
+
+gen_secret() {
+    if [ ! -f secret/id_ed25519 ]; then
+        echo "[up.sh] generating cluster ssh key"
+        mkdir -p secret
+        ssh-keygen -t ed25519 -N "" -q -f secret/id_ed25519
+    fi
+}
+
+case "${1:-}" in
+    --down)
+        exec $COMPOSE_CMD down -v
+        ;;
+    --daemon)
+        gen_secret
+        $COMPOSE_CMD up -d --build
+        echo "[up.sh] cluster up; attach with:"
+        echo "  docker exec -it jepsen-tpu-control bash"
+        ;;
+    --test)
+        gen_secret
+        $COMPOSE_CMD up -d --build
+        trap '$COMPOSE_CMD down -v' EXIT
+        docker exec \
+            -e JEPSEN_TPU_SSH_NODES=n1,n2,n3,n4,n5 \
+            jepsen-tpu-control \
+            python -m pytest tests/test_integration_ssh.py -v
+        ;;
+    *)
+        gen_secret
+        $COMPOSE_CMD up -d --build
+        exec docker exec -it jepsen-tpu-control bash
+        ;;
+esac

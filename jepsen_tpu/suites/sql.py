@@ -24,12 +24,15 @@ indeterminate => "info" (reads may safely "fail").
 
 from __future__ import annotations
 
-import random
-from typing import Any, Callable
-
 from .. import client as jclient
 from .. import independent
 from ..drivers import DBError, DriverError
+
+#: Error codes whose outcome is UNKNOWN: the txn may have committed.
+#: pg 40003 = statement_completion_unknown (cockroach's "result is
+#: ambiguous" commit errors); mysql 2013/2006-style losses arrive as
+#: DriverError already.
+AMBIGUOUS_SQL = {"40003"}
 
 
 def resolve(node: str, default_port: int, test: dict) -> tuple[str, int]:
@@ -219,7 +222,13 @@ class SQLClient(jclient.Client):
             self._ensure_conn(test)
             return self._dispatch(op)
         except DBError as e:
-            return {**op, "type": "fail", "error": f"{self.dialect.name}-"
+            # Most backend errors are definite rejections -> fail; the
+            # ambiguous-commit SQLSTATEs mean the txn may have applied
+            # -> info for writes (cockroach/client.clj's retry loop
+            # makes the same distinction).
+            ambiguous = str(e.code) in AMBIGUOUS_SQL and not read_only
+            return {**op, "type": "info" if ambiguous else "fail",
+                    "error": f"{self.dialect.name}-"
                     f"{e.code}: {e.message[:120]}"}
         except DriverError as e:
             self.close(test)

@@ -83,13 +83,19 @@ class YCQLClient(jclient.Client):
             finally:
                 self.conn = None
 
+    #: CQL error codes whose outcome is UNKNOWN for a write: the
+    #: coordinator lost track, but replicas may still apply it.
+    #: 0x1100 WriteTimeout, 0x1400 WriteFailure, 0x0000 ServerError.
+    AMBIGUOUS = {"cql-0x1100", "cql-0x1400", "cql-0x0000"}
+
     def invoke(self, test, op):
         read_only = op.get("f") == "read"
         try:
             self._ensure_conn(test)
             return self._dispatch(op)
         except DBError as e:
-            return {**op, "type": "fail",
+            ambiguous = str(e.code) in self.AMBIGUOUS and not read_only
+            return {**op, "type": "info" if ambiguous else "fail",
                     "error": f"ycql-{e.code}: {e.message[:120]}"}
         except (DriverError, OSError) as e:
             self.close(test)
@@ -103,8 +109,8 @@ class YCQLClient(jclient.Client):
             return self._set(op)
         if self.mode == "monotonic":
             return self._monotonic(op)
-        if self.mode == "append":
-            return self._append(op)
+        if self.mode == "long-fork":
+            return self._long_fork(op)
         return self._register(op)
 
     def _register(self, op):
@@ -132,32 +138,35 @@ class YCQLClient(jclient.Client):
             return {**op, "type": "fail", "error": "precondition"}
         return {**op, "type": "fail", "error": f"unknown f {op['f']!r}"}
 
-    def _append(self, op):
+    def _long_fork(self, op):
+        """Long-fork over key registers: the whole-group read is ONE
+        `IN`-clause SELECT (a single-statement snapshot read on a
+        transactional table — the reference's approach,
+        yugabyte/src/yugabyte/ycql/long_fork.clj:33-44); writes are
+        single-row inserts. Read-write-mixed txns stay unsupported
+        (reads can't join YCQL txn blocks), which is why append/wr are
+        out of the YCQL matrix."""
         mops = op["value"]
         k0 = None
         if independent.is_tuple(mops):
             k0, mops = mops.key, mops.value
         c = self.conn
-        out = []
-        # single-mop txns run direct; multi-mop writes use a txn block.
-        writes = [m for m in mops if m[0] == "append"]
-        if len(writes) > 1:
-            block = "BEGIN TRANSACTION " + " ".join(
-                f"UPDATE lists SET val = val + [{int(v)}] "
-                f"WHERE id = {int(k)};" for _, k, v in writes) + \
-                " END TRANSACTION;"
-            c.query(block)
-        for mf, mk, mv in mops:
-            if mf == "append":
-                if len(writes) <= 1:
-                    c.query(f"UPDATE lists SET val = val + [{int(mv)}] "
-                            f"WHERE id = {int(mk)}")
-                out.append([mf, mk, mv])
-            else:
-                rows = c.query(f"SELECT val FROM lists "
-                               f"WHERE id = {int(mk)}").rows
-                vals = rows[0][0] if rows and rows[0][0] else []
-                out.append([mf, mk, list(vals)])
+        if all(m[0] == "r" for m in mops):
+            ks = sorted({int(m[1]) for m in mops})
+            rows = c.query(
+                f"SELECT id, val FROM registers WHERE id IN "
+                f"({', '.join(str(k) for k in ks)})").rows
+            got = {int(r[0]): (int(r[1]) if r[1] is not None else None)
+                   for r in rows}
+            out = [["r", mk, got.get(int(mk))] for _mf, mk, _mv in mops]
+        elif len(mops) == 1 and mops[0][0] == "w":
+            _, k, v = mops[0]
+            c.query(f"INSERT INTO registers (id, val) VALUES "
+                    f"({int(k)}, {int(v)})")
+            out = [["w", k, v]]
+        else:
+            return {**op, "type": "fail",
+                    "error": "ycql long-fork: mixed txn unsupported"}
         new_v = independent.tuple_(k0, out) if k0 is not None else out
         return {**op, "type": "ok", "value": new_v}
 
@@ -170,18 +179,14 @@ class YCQLClient(jclient.Client):
         if op["f"] == "transfer":
             t = op["value"]
             frm, to, amt = int(t["from"]), int(t["to"]), int(t["amount"])
-            rows = c.query(f"SELECT balance FROM accounts "
-                           f"WHERE id = {frm}").rows
-            b1 = int(rows[0][0]) if rows else 0
-            if b1 < amt:
-                return {**op, "type": "fail", "error": "insufficient"}
-            rows = c.query(f"SELECT balance FROM accounts "
-                           f"WHERE id = {to}").rows
-            b2 = int(rows[0][0]) if rows else 0
+            # Server-side arithmetic inside the txn block — the
+            # reference's shape (ycql/bank.clj:46-58). No balance
+            # check, so overdrafts happen; the suite runs this workload
+            # with negative balances allowed.
             c.query("BEGIN TRANSACTION "
-                    f"UPDATE accounts SET balance = {b1 - amt} "
+                    f"UPDATE accounts SET balance = balance - {amt} "
                     f"WHERE id = {frm}; "
-                    f"UPDATE accounts SET balance = {b2 + amt} "
+                    f"UPDATE accounts SET balance = balance + {amt} "
                     f"WHERE id = {to}; "
                     "END TRANSACTION;")
             return {**op, "type": "ok"}
@@ -225,14 +230,19 @@ class YCQLClient(jclient.Client):
         return {**op, "type": "fail", "error": f"unknown f {op['f']!r}"}
 
 
-#: workload -> YCQL mode (the reference's YCQL matrix subset: no wr /
-#: long-fork — reads can't join YCQL txn blocks)
+#: workload -> YCQL mode (the reference's YCQL matrix: bank, counter,
+#: long-fork, set, single/multi-key-acid — no append/wr, whose
+#: read-write txns can't be expressed in YCQL txn blocks)
 MODES = {"register": "register", "set": "set", "bank": "bank",
-         "monotonic": "monotonic", "append": "append"}
+         "monotonic": "monotonic", "long-fork": "long-fork"}
 
 
 def client_for(workload: str, opts: dict | None = None) -> YCQLClient:
     opts = opts or {}
-    return YCQLClient(MODES.get(workload, "register"),
+    if workload not in MODES:
+        raise ValueError(
+            f"workload {workload!r} has no YCQL client (reads can't "
+            f"join YCQL txn blocks); supported: {sorted(MODES)}")
+    return YCQLClient(MODES[workload],
                       accounts=opts.get("accounts"),
                       total=opts.get("total-amount", 100))

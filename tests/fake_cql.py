@@ -32,7 +32,8 @@ class MiniCQL:
         re.I | re.S)
     _re_select = re.compile(
         r"SELECT\s+(.+?)\s+FROM\s+(\w+)"
-        r"(?:\s+WHERE\s+(\w+)\s*=\s*(-?\d+))?\s*$", re.I)
+        r"(?:\s+WHERE\s+(\w+)\s*(?:=\s*(-?\d+)"
+        r"|IN\s*\(([^)]*)\)))?\s*$", re.I)
     _re_insert = re.compile(
         r"INSERT INTO (\w+)\s*\(([^)]*)\)\s*VALUES\s*\(([^)]*)\)\s*"
         r"(IF NOT EXISTS)?\s*$", re.I)
@@ -81,8 +82,12 @@ class MiniCQL:
             with self.lock:
                 rows = list(t["rows"].values())
                 if m.group(3):
-                    wc, wv = m.group(3).lower(), int(m.group(4))
-                    rows = [r for r in rows if r.get(wc) == wv]
+                    wc = m.group(3).lower()
+                    if m.group(4) is not None:
+                        want = {int(m.group(4))}
+                    else:
+                        want = {int(x) for x in m.group(5).split(",")}
+                    rows = [r for r in rows if r.get(wc) in want]
                 return "rows", cols, [[r.get(c) for c in cols]
                                       for r in rows]
         m = self._re_insert.match(cql)
@@ -133,9 +138,14 @@ class MiniCQL:
                     target[wc] = wv
                     t["rows"][tuple(target[c] for c in t["pk"])] = target
                 lm = re.match(rf"{col}\s*\+\s*\[(-?\d+)\]$", expr)
+                am = re.match(rf"{col}\s*([+-])\s*(\d+)$", expr)
                 if lm:
                     target[col] = (target.get(col) or []) + \
                         [int(lm.group(1))]
+                elif am:
+                    delta = int(am.group(2))
+                    target[col] = (target.get(col) or 0) + (
+                        delta if am.group(1) == "+" else -delta)
                 else:
                     target[col] = _parse_val(expr)
                 if ifc is not None:

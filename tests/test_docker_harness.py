@@ -1,0 +1,67 @@
+"""Structural checks on the docker cluster harness (L11): the compose
+topology matches the 1-control + 5-node shape the suites assume, the
+scripts parse, and the images carry the tools the framework shells out
+to. (The live tier is tests/test_integration_ssh.py, run by
+docker/up.sh --test.)"""
+
+from __future__ import annotations
+
+import subprocess
+from pathlib import Path
+
+import yaml
+
+DOCKER = Path(__file__).resolve().parent.parent / "docker"
+
+
+def compose() -> dict:
+    return yaml.safe_load((DOCKER / "docker-compose.yml").read_text())
+
+
+def test_compose_topology():
+    c = compose()
+    services = c["services"]
+    assert set(services) == {"control", "n1", "n2", "n3", "n4", "n5"}
+    for n in ("n1", "n2", "n3", "n4", "n5"):
+        node = services[n]
+        assert node["privileged"] is True, f"{n} needs privileged for " \
+            "iptables/tc/fuse faults"
+        assert node["hostname"] == n
+        assert "jepsen" in node["networks"]
+    assert "jepsen" in c["networks"]
+
+
+def test_compose_control_mounts_repo():
+    ctl = compose()["services"]["control"]
+    assert any(v.startswith("..:") for v in ctl["volumes"]), \
+        "control must mount the repo"
+    assert any("secret" in v for v in ctl["volumes"])
+
+
+def test_scripts_parse():
+    for script in (DOCKER / "up.sh", DOCKER / "node" / "boot.sh"):
+        p = subprocess.run(["bash", "-n", str(script)],
+                           capture_output=True, text=True)
+        assert p.returncode == 0, f"{script.name}: {p.stderr}"
+
+
+def test_node_image_has_fault_tooling():
+    df = (DOCKER / "node" / "Dockerfile").read_text()
+    for tool in ("openssh-server", "iptables", "iproute2", "gcc",
+                 "tcpdump", "faketime", "fuse3", "ntpdate"):
+        assert tool in df, f"node image missing {tool}"
+    assert "boot.sh" in df
+
+
+def test_control_image_runs_the_repo():
+    df = (DOCKER / "control" / "Dockerfile").read_text()
+    assert "openssh-client" in df
+    assert "jax" in df
+    assert "JEPSEN_TPU_SSH_NODES" in df
+
+
+def test_integration_tier_is_gated():
+    """The live tier must skip cleanly when no cluster is configured."""
+    src = (Path(__file__).parent / "test_integration_ssh.py").read_text()
+    assert "JEPSEN_TPU_SSH_NODES" in src
+    assert "skipif" in src

@@ -1,0 +1,148 @@
+"""Real-SSH integration tier: exercises SSHRemote against live nodes.
+
+The reference gates the equivalent tests with the :integration selector
+and provides nodes via its docker harness (core_test.clj:137-191,
+docker/docker-compose.yml). Here the gate is the JEPSEN_TPU_SSH_NODES
+env var — set by docker/up.sh --test inside the control container, or
+by hand against any cluster:
+
+    JEPSEN_TPU_SSH_NODES=n1,n2,n3 \
+    JEPSEN_TPU_SSH_KEY=~/.ssh/id_ed25519 \
+    python -m pytest tests/test_integration_ssh.py -v
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+
+import pytest
+
+from jepsen_tpu import control
+
+NODES = [n for n in os.environ.get("JEPSEN_TPU_SSH_NODES", "").split(",")
+         if n]
+
+pytestmark = pytest.mark.skipif(
+    not NODES, reason="JEPSEN_TPU_SSH_NODES not set (integration tier)")
+
+
+def make_test(**kw) -> dict:
+    ssh = {"username": os.environ.get("JEPSEN_TPU_SSH_USER", "root")}
+    if os.environ.get("JEPSEN_TPU_SSH_KEY"):
+        ssh["private_key_path"] = os.environ["JEPSEN_TPU_SSH_KEY"]
+    if os.environ.get("JEPSEN_TPU_SSH_PORT"):
+        ssh["port"] = int(os.environ["JEPSEN_TPU_SSH_PORT"])
+    t = {"nodes": NODES, "ssh": ssh}
+    t.update(kw)
+    return t
+
+
+def test_exec_roundtrip():
+    """exec returns trimmed stdout; nonzero exit raises
+    (core_test.clj ssh-test's exec assertions)."""
+    test = make_test()
+    sess = control.session(test, NODES[0])
+    try:
+        assert sess.exec("echo", "hello") == "hello"
+        assert sess.exec("hostname") == NODES[0]
+        with pytest.raises(control.CommandError):
+            sess.exec("false")
+    finally:
+        sess.disconnect()
+
+
+def test_shell_escaping():
+    """Arguments survive shell metacharacters intact."""
+    test = make_test()
+    sess = control.session(test, NODES[0])
+    try:
+        tricky = "a b;echo owned>\"'$x`y`"
+        assert sess.exec("echo", "-n", tricky) == tricky
+    finally:
+        sess.disconnect()
+
+
+def test_upload_download(tmp_path):
+    """scp round trip (core_test.clj ssh-test's upload/download)."""
+    test = make_test()
+    sess = control.session(test, NODES[0])
+    remote = f"/tmp/jepsen-tpu-it-{uuid.uuid4().hex}"
+    try:
+        src = tmp_path / "payload.txt"
+        src.write_text("integration payload\n")
+        sess.upload(str(src), remote)
+        back = tmp_path / "back.txt"
+        sess.download(remote, str(back))
+        assert back.read_text() == "integration payload\n"
+    finally:
+        sess.exec("rm", "-f", remote)
+        sess.disconnect()
+
+
+def test_sudo_and_cd():
+    test = make_test()
+    sess = control.session(test, NODES[0])
+    try:
+        assert sess.su().exec("whoami") == "root"
+        assert sess.cd("/tmp").exec("pwd") == "/tmp"
+    finally:
+        sess.disconnect()
+
+
+def test_on_nodes_fan_out():
+    """Parallel fan-out returns per-node results
+    (control.clj:435-451)."""
+    test = make_test()
+    out = control.on_nodes(test, lambda t, n:
+                           control.current_session().exec("hostname"))
+    assert out == {n: n for n in NODES}
+
+
+def test_full_run_over_ssh(tmp_path):
+    """Whole-lifecycle run with a file-touching DB over real SSH: OS
+    noop, DB setup/teardown on every node, log snarfing, in-process
+    client ops, artifacts persisted."""
+    from jepsen_tpu import checker as jchecker
+    from jepsen_tpu import core, db as jdb, generator as gen, net as jnet
+    from jepsen_tpu import os_setup, workloads
+    from jepsen_tpu.store import Store
+
+    marker = f"/tmp/jepsen-tpu-it-db-{uuid.uuid4().hex}"
+
+    class FileDB(jdb.DB, jdb.LogFiles):
+        def setup(self, test, node):
+            sess = control.current_session()
+            sess.exec("mkdir", "-p", marker)
+            sess.exec("sh", "-c",
+                      f"echo started on {node} > {marker}/db.log")
+
+        def teardown(self, test, node):
+            control.current_session().exec("rm", "-rf", marker)
+
+        def log_files(self, test, node):
+            return [f"{marker}/db.log"]
+
+    _db, client = workloads.atom_fixtures()
+    test = make_test(
+        name="ssh-itest",
+        concurrency=len(NODES),
+        db=FileDB(),
+        client=client,
+        net=jnet.noop(),
+        os=os_setup.noop(),
+        store=Store(tmp_path / "store"),
+        generator=gen.clients(gen.limit(100, gen.mix([
+            gen.repeat_gen({"f": "read"}),
+            lambda: {"f": "write",
+                     "value": __import__("random").randint(0, 4)},
+        ]))),
+        checker=jchecker.compose({"stats": jchecker.stats()}),
+    )
+    test = core.run(test)
+    assert test["results"]["valid?"] is True
+    d = test["store"].test_dir(test)
+    assert (d / "results.edn").exists()
+    # snarfed db logs from every node
+    for n in NODES:
+        assert (d / n / "db.log").exists(), f"missing snarfed log for {n}"

@@ -103,14 +103,15 @@ def test_ycql_client_ops():
                                "value": None, "process": 0})["value"] == 2
         m.close(test)
 
-        a = ycql.YCQLClient("append").open(test, "n1")
-        r = a.invoke(test, {"type": "invoke", "f": "txn", "process": 0,
-                            "value": [["append", 1, 10],
-                                      ["append", 2, 20],
-                                      ["r", 1, None]]})
+        lf = ycql.YCQLClient("long-fork").open(test, "n1")
+        w = lf.invoke(test, {"type": "invoke", "f": "write", "process": 0,
+                             "value": [["w", 21, 1]]})
+        assert w["type"] == "ok"
+        r = lf.invoke(test, {"type": "invoke", "f": "read", "process": 0,
+                             "value": [["r", 21, None], ["r", 22, None]]})
         assert r["type"] == "ok"
-        assert r["value"][2] == ["r", 1, [10]]
-        a.close(test)
+        assert r["value"] == [["r", 21, 1], ["r", 22, None]]
+        lf.close(test)
 
 
 def test_yugabyte_ycql_suite_end_to_end(tmp_path):
