@@ -1,0 +1,478 @@
+"""In-process fake servers for the small-suite protocols: ZooKeeper
+(jute), Consul (HTTP KV), Disque (RESP), RabbitMQ (AMQP 0-9-1). Each
+backs onto a lock-protected in-memory store so suite runs against them
+must check out linearizable/total-queue-clean."""
+
+from __future__ import annotations
+
+import base64
+import json
+import socketserver
+import struct
+import threading
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class _BaseFake:
+    handler: type
+
+    def __init__(self):
+        self._srv = _Server(("127.0.0.1", 0), self.handler)
+        self._srv.owner = self
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+# ---------------------------------------------------------------------
+# ZooKeeper (jute framing)
+
+ZOK, ZNONODE, ZBADVERSION, ZNODEEXISTS = 0, -101, -103, -110
+
+
+def _zbuf(b: bytes | None) -> bytes:
+    if b is None:
+        return struct.pack("!i", -1)
+    return struct.pack("!i", len(b)) + b
+
+
+def _zstat(version: int) -> bytes:
+    # czxid mzxid ctime mtime version cversion aversion ephemeralOwner
+    # dataLength numChildren pzxid
+    return struct.pack("!qqqqiiiqiiq", 0, 0, 0, 0, version, 0, 0, 0,
+                       0, 0, 0)
+
+
+class _ZKHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        srv = self.server.owner  # type: ignore
+        sock = self.request
+        buf = b""
+
+        def recvn(n):
+            nonlocal buf
+            while len(buf) < n:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            out, rest = buf[:n], buf[n:]
+            buf = rest
+            return out
+
+        def recv_packet():
+            (n,) = struct.unpack("!i", recvn(4))
+            return recvn(n)
+
+        def send_packet(payload):
+            sock.sendall(struct.pack("!i", len(payload)) + payload)
+
+        try:
+            recv_packet()  # ConnectRequest — accept anything
+            send_packet(struct.pack("!iiq", 0, 10000, 0x1234) +
+                        _zbuf(b"\0" * 16))
+            while True:
+                pkt = recv_packet()
+                xid, op = struct.unpack_from("!ii", pkt, 0)
+                body = pkt[8:]
+                if op == 11:        # ping
+                    send_packet(struct.pack("!iqi", -2, 0, ZOK))
+                    continue
+                if op == -11:       # close
+                    send_packet(struct.pack("!iqi", xid, 0, ZOK))
+                    return
+                err, payload = self._dispatch(srv, op, body)
+                send_packet(struct.pack("!iqi", xid, 0, err) + payload)
+        except ConnectionError:
+            pass
+
+    def _dispatch(self, srv, op, body):
+        with srv.lock:
+            (n,) = struct.unpack_from("!i", body, 0)
+            path = body[4:4 + n].decode()
+            rest = body[4 + n:]
+            node = srv.nodes.get(path)
+            if op == 1:             # create
+                if node is not None:
+                    return ZNODEEXISTS, b""
+                (dn,) = struct.unpack_from("!i", rest, 0)
+                data = rest[4:4 + dn] if dn >= 0 else b""
+                srv.nodes[path] = [data, 0]
+                return ZOK, _zbuf(path.encode())
+            if op == 2:             # delete
+                if node is None:
+                    return ZNONODE, b""
+                del srv.nodes[path]
+                return ZOK, b""
+            if op == 3:             # exists
+                if node is None:
+                    return ZNONODE, b""
+                return ZOK, _zstat(node[1])
+            if op == 4:             # getData
+                if node is None:
+                    return ZNONODE, b""
+                return ZOK, _zbuf(node[0]) + _zstat(node[1])
+            if op == 5:             # setData
+                (dn,) = struct.unpack_from("!i", rest, 0)
+                data = rest[4:4 + dn] if dn >= 0 else b""
+                (version,) = struct.unpack_from("!i", rest, 4 + max(dn, 0))
+                if node is None:
+                    return ZNONODE, b""
+                if version != -1 and version != node[1]:
+                    return ZBADVERSION, b""
+                node[0] = data
+                node[1] += 1
+                return ZOK, _zstat(node[1])
+            return -6, b""          # unimplemented
+
+
+class FakeZKServer(_BaseFake):
+    handler = _ZKHandler
+
+    def __init__(self):
+        self.nodes: dict[str, list] = {}   # path -> [data, version]
+        self.lock = threading.Lock()
+        super().__init__()
+
+
+# ---------------------------------------------------------------------
+# Consul HTTP KV
+
+
+class _ConsulHandler(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, code, obj):
+        data = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        srv = self.server.owner  # type: ignore
+        key = urlparse(self.path).path.removeprefix("/v1/kv/")
+        with srv.lock:
+            if key not in srv.kv:
+                self._reply(404, [])
+                return
+            val, idx = srv.kv[key]
+            self._reply(200, [{
+                "Key": key,
+                "Value": base64.b64encode(val).decode(),
+                "ModifyIndex": idx,
+            }])
+
+    def do_PUT(self):
+        srv = self.server.owner  # type: ignore
+        parsed = urlparse(self.path)
+        key = parsed.path.removeprefix("/v1/kv/")
+        qs = parse_qs(parsed.query)
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        with srv.lock:
+            if "cas" in qs:
+                want = int(qs["cas"][0])
+                cur = srv.kv.get(key, (None, 0))[1]
+                if cur != want:
+                    self._reply(200, False)
+                    return
+            srv.index += 1
+            srv.kv[key] = (body, srv.index)
+            self._reply(200, True)
+
+
+class FakeConsulServer:
+    def __init__(self):
+        self.kv: dict[str, tuple] = {}
+        self.index = 0
+        self.lock = threading.Lock()
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), _ConsulHandler)
+        self._srv.owner = self
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+# ---------------------------------------------------------------------
+# Disque (RESP)
+
+
+class _DisqueHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        srv = self.server.owner  # type: ignore
+        sock = self.request
+        buf = b""
+
+        def recvn(n):
+            nonlocal buf
+            while len(buf) < n:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            out, rest = buf[:n], buf[n:]
+            buf = rest
+            return out
+
+        def recv_line():
+            nonlocal buf
+            while b"\r\n" not in buf:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            line, buf = buf.split(b"\r\n", 1)
+            return line
+
+        def read_cmd():
+            line = recv_line()
+            if not line.startswith(b"*"):
+                raise ConnectionError
+            nargs = int(line[1:])
+            args = []
+            for _ in range(nargs):
+                ln = recv_line()
+                assert ln.startswith(b"$")
+                n = int(ln[1:])
+                args.append(recvn(n).decode())
+                recvn(2)
+            return args
+
+        try:
+            while True:
+                args = read_cmd()
+                cmd = args[0].upper()
+                with srv.lock:
+                    if cmd == "ADDJOB":
+                        _q, body = args[1], args[2]
+                        jid = f"D-{srv.next_id}"
+                        srv.next_id += 1
+                        srv.queue.append((jid, body))
+                        sock.sendall(f"+{jid}\r\n".encode())
+                    elif cmd == "GETJOB":
+                        qname = args[args.index("FROM") + 1]
+                        if srv.queue:
+                            jid, body = srv.queue.popleft()
+                            srv.unacked[jid] = body
+                            payload = (
+                                f"*1\r\n*3\r\n${len(qname)}\r\n{qname}"
+                                f"\r\n${len(jid)}\r\n{jid}\r\n"
+                                f"${len(body)}\r\n{body}\r\n")
+                            sock.sendall(payload.encode())
+                        else:
+                            sock.sendall(b"*-1\r\n")
+                    elif cmd == "ACKJOB":
+                        srv.unacked.pop(args[1], None)
+                        sock.sendall(b":1\r\n")
+                    elif cmd == "CLUSTER":
+                        sock.sendall(b"+OK\r\n")
+                    else:
+                        sock.sendall(
+                            f"-ERR unknown command {cmd}\r\n".encode())
+        except ConnectionError:
+            pass
+
+
+class FakeDisqueServer(_BaseFake):
+    handler = _DisqueHandler
+
+    def __init__(self):
+        self.queue: deque = deque()
+        self.unacked: dict = {}
+        self.next_id = 1
+        self.lock = threading.Lock()
+        super().__init__()
+
+
+# ---------------------------------------------------------------------
+# RabbitMQ (AMQP 0-9-1)
+
+FRAME_END = 0xCE
+
+
+def _shortstr(s: str) -> bytes:
+    b = s.encode()
+    return bytes([len(b)]) + b
+
+
+class _AMQPHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        srv = self.server.owner  # type: ignore
+        sock = self.request
+        buf = b""
+
+        def recvn(n):
+            nonlocal buf
+            while len(buf) < n:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            out, rest = buf[:n], buf[n:]
+            buf = rest
+            return out
+
+        def recv_frame():
+            head = recvn(7)
+            ftype, ch, size = struct.unpack("!BHI", head)
+            payload = recvn(size)
+            assert recvn(1)[0] == FRAME_END
+            return ftype, ch, payload
+
+        def send_frame(ftype, ch, payload):
+            sock.sendall(struct.pack("!BHI", ftype, ch, len(payload)) +
+                         payload + bytes([FRAME_END]))
+
+        def send_method(ch, cls, mth, args=b""):
+            send_frame(1, ch, struct.pack("!HH", cls, mth) + args)
+
+        try:
+            assert recvn(8) == b"AMQP\x00\x00\x09\x01"
+            # connection.start: versions, server-props {}, mechanisms,
+            # locales
+            send_method(0, 10, 10, bytes([0, 9]) +
+                        struct.pack("!I", 0) +
+                        struct.pack("!I", 5) + b"PLAIN" +
+                        struct.pack("!I", 5) + b"en_US")
+            recv_frame()                       # start-ok
+            send_method(0, 10, 30, struct.pack("!HIH", 0, 131072, 0))
+            recv_frame()                       # tune-ok
+            recv_frame()                       # open
+            send_method(0, 10, 41, _shortstr(""))
+            pending_publish = None
+            confirms = False
+            publish_seq = 0
+
+            def committed(qname, body):
+                nonlocal publish_seq
+                with srv.lock:
+                    srv.queues.setdefault(qname, deque()).append(body)
+                if confirms:
+                    publish_seq += 1
+                    send_method(1, 60, 80,        # basic.ack confirm
+                                struct.pack("!Q", publish_seq) + b"\0")
+
+            while True:
+                ftype, ch, payload = recv_frame()
+                if ftype == 2 and pending_publish is not None:
+                    (size,) = struct.unpack_from("!Q", payload, 4)
+                    pending_publish = (pending_publish[0], size, b"")
+                    if size == 0:
+                        committed(pending_publish[0], b"")
+                        pending_publish = None
+                    continue
+                if ftype == 3 and pending_publish is not None:
+                    q, size, got = pending_publish
+                    got += payload
+                    if len(got) >= size:
+                        committed(q, got)
+                        pending_publish = None
+                    else:
+                        pending_publish = (q, size, got)
+                    continue
+                if ftype != 1:
+                    continue
+                cls, mth = struct.unpack_from("!HH", payload, 0)
+                args = payload[4:]
+                if (cls, mth) == (20, 10):     # channel.open
+                    send_method(ch, 20, 11, struct.pack("!I", 0))
+                elif (cls, mth) == (50, 10):   # queue.declare
+                    n = args[2]
+                    qname = args[3:3 + n].decode()
+                    with srv.lock:
+                        srv.queues.setdefault(qname, deque())
+                    send_method(ch, 50, 11, _shortstr(qname) +
+                                struct.pack("!II", 0, 0))
+                elif (cls, mth) == (50, 30):   # queue.purge
+                    n = args[2]
+                    qname = args[3:3 + n].decode()
+                    with srv.lock:
+                        cnt = len(srv.queues.get(qname, ()))
+                        srv.queues[qname] = deque()
+                    send_method(ch, 50, 31, struct.pack("!I", cnt))
+                elif (cls, mth) == (60, 40):   # basic.publish
+                    off = 2
+                    n = args[off]
+                    off += 1 + n               # exchange
+                    n = args[off]
+                    routing = args[off + 1:off + 1 + n].decode()
+                    pending_publish = (routing, None, b"")
+                elif (cls, mth) == (60, 70):   # basic.get
+                    off = 2
+                    n = args[off]
+                    qname = args[off + 1:off + 1 + n].decode()
+                    with srv.lock:
+                        q = srv.queues.get(qname, deque())
+                        if q:
+                            body = q.popleft()
+                            tag = srv.next_tag
+                            srv.next_tag += 1
+                            srv.unacked[tag] = (qname, body)
+                        else:
+                            body = None
+                    if body is None:
+                        send_method(ch, 60, 72, _shortstr(""))
+                    else:
+                        send_method(ch, 60, 71,
+                                    struct.pack("!Q", tag) + b"\0" +
+                                    _shortstr("") + _shortstr(qname) +
+                                    struct.pack("!I", 0))
+                        send_frame(2, ch, struct.pack(
+                            "!HHQH", 60, 0, len(body), 0))
+                        if body:
+                            send_frame(3, ch, body)
+                elif (cls, mth) == (60, 80):   # basic.ack
+                    (tag,) = struct.unpack_from("!Q", args, 0)
+                    with srv.lock:
+                        srv.unacked.pop(tag, None)
+                elif (cls, mth) == (85, 10):   # confirm.select
+                    confirms = True
+                    send_method(ch, 85, 11)    # select-ok
+                elif (cls, mth) == (10, 50):   # connection.close
+                    send_method(0, 10, 51)
+                    return
+        except (ConnectionError, AssertionError):
+            pass
+
+
+class FakeAMQPServer(_BaseFake):
+    handler = _AMQPHandler
+
+    def __init__(self):
+        self.queues: dict[str, deque] = {}
+        self.unacked: dict = {}
+        self.next_tag = 1
+        self.lock = threading.Lock()
+        super().__init__()
