@@ -666,6 +666,35 @@ def repeat_gen(gen, n: int = -1):
     return Repeat(n, gen)
 
 
+class Cycle(Generator):
+    """Endlessly restart `source` when it's exhausted — the semantics
+    of the reference's `(cycle [...])` nemesis schedules
+    (etcd.clj:174-178). Unlike Repeat (which re-yields the FIRST op
+    forever, pure.clj:1075), Cycle consumes the whole sequence and
+    starts over. `source` must be a pure generator value (lists of op
+    maps/sleeps are), since each lap re-reads it."""
+
+    def __init__(self, source, current=None):
+        self.source = source
+        self.current = current if current is not None else source
+
+    def op(self, test, ctx):
+        res = op(self.current, test, ctx)
+        if res is None:
+            res = op(self.source, test, ctx)   # start the next lap
+            if res is None:
+                return None                    # source yields nothing
+        o, g2 = res
+        return (o, Cycle(self.source, g2))
+
+    def update(self, test, ctx, event):
+        return Cycle(self.source, update(self.current, test, ctx, event))
+
+
+def cycle(gen):
+    return Cycle(gen)
+
+
 class ProcessLimit(Generator):
     """Emit ops for at most n distinct processes (pure.clj:1104-1129)."""
 

@@ -15,11 +15,32 @@ cockroachdb, dgraph) build their maps from the shared workload library;
 
 from __future__ import annotations
 
+import importlib
 from typing import Any, Callable
 
 from .. import generator as gen
 from ..workloads import (adya, append, bank, causal_reverse, long_fork,
                          monotonic, register, set_workload, wr)
+
+#: Every per-DB suite module (the reference's 27 sibling subprojects,
+#: SURVEY.md §2.6; mongodb is the shared core behind the -rocks and
+#: -smartos variants).
+SUITES = (
+    "aerospike", "charybdefs", "chronos", "cockroach", "consul",
+    "crate", "dgraph", "disque", "elasticsearch", "etcd", "faunadb",
+    "galera", "hazelcast", "ignite", "logcabin", "mongodb",
+    "mongodb_rocks", "mongodb_smartos", "mysql_cluster", "percona",
+    "postgres_rds", "rabbitmq", "raftis", "rethinkdb", "robustirc",
+    "tidb", "yugabyte", "zookeeper",
+)
+
+
+def load_suite(name: str):
+    """Import a suite module by name (lazy: suites pull in their
+    drivers only when used)."""
+    if name not in SUITES:
+        raise ValueError(f"unknown suite {name!r}; have {SUITES}")
+    return importlib.import_module(f".{name}", __package__)
 
 
 def base_opts(**kw) -> dict:
@@ -68,11 +89,12 @@ def resolve_workload(args, tmap: dict, default: str) -> str:
 
 def nemesis_cycle(interval: float = 10) -> Any:
     """The standard start/stop nemesis schedule
-    (etcd.clj:174-178, combined.clj:26-28)."""
-    return gen.repeat_gen([gen.sleep(interval),
-                           {"type": "info", "f": "start"},
-                           gen.sleep(interval),
-                           {"type": "info", "f": "stop"}])
+    (etcd.clj:174-178, combined.clj:26-28). gen.cycle — NOT repeat_gen,
+    which re-yields the first sleep forever and never starts a fault."""
+    return gen.cycle([gen.sleep(interval),
+                      {"type": "info", "f": "start"},
+                      gen.sleep(interval),
+                      {"type": "info", "f": "stop"}])
 
 
 def suite_test(name: str, workload_name: str, opts: dict,
@@ -87,14 +109,23 @@ def suite_test(name: str, workload_name: str, opts: dict,
             f"have {sorted(workloads)}")
     wl = workloads[workload_name]()
     g = wl["generator"]
+    main_gen = gen.time_limit(
+        opts.get("time-limit", 60),
+        gen.clients(g, nemesis_cycle(opts.get("nemesis-interval", 10))))
+    if wl.get("final_generator") is not None:
+        # post-time-limit phase (queue drains, final reads): heal the
+        # nemesis first so a live partition can't wedge an until-ok
+        # final phase (the reference's std-gen shape)
+        main_gen = gen.phases(
+            main_gen,
+            gen.nemesis(gen.once({"type": "info", "f": "stop"})),
+            wl["final_generator"])
     test = {
         "name": f"{name} {workload_name}",
         "nodes": opts.get("nodes"),
         "concurrency": opts.get("concurrency", 5),
         "ssh": opts.get("ssh", {}),
-        "generator": gen.time_limit(
-            opts.get("time-limit", 60),
-            gen.clients(g, nemesis_cycle(opts.get("nemesis-interval", 10)))),
+        "generator": main_gen,
         "checker": wl["checker"],
         "workload": workload_name,
     }

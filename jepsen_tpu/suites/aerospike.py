@@ -1,0 +1,88 @@
+"""Aerospike suite.
+
+Counterpart of aerospike/src/jepsen/aerospike.clj (1,262 LoC, plus the
+TLA+ spec at aerospike/spec/aerospike.tla): deb-installed server with a
+mesh-seeded cluster, CAS-register (generation-check writes) and counter
+workloads. The wire protocol is Aerospike's bespoke binary info/data
+protocol — the client is pluggable (pass ``client`` in opts);
+install/cluster/workload wiring is complete.
+"""
+
+from __future__ import annotations
+
+from .. import cli as jcli
+from .. import control
+from .. import db as jdb
+from .. import nemesis as jnemesis, os_setup
+from . import base_opts, standard_workloads, suite_test
+
+LOGFILE = "/var/log/aerospike/aerospike.log"
+
+
+class AerospikeDB(jdb.DB, jdb.LogFiles):
+    def __init__(self, version: str = "3.5.4"):
+        self.version = version
+
+    def setup(self, test, node):
+        sess = control.current_session().su()
+        url = (f"https://www.aerospike.com/artifacts/aerospike-server-"
+               f"community/{self.version}/aerospike-server-community-"
+               f"{self.version}-debian7.tgz")
+        sess.exec("sh", "-c",
+                  f"wget -qO /tmp/aerospike.tgz {url} && "
+                  f"tar -xzf /tmp/aerospike.tgz -C /tmp && "
+                  f"dpkg -i /tmp/aerospike-server-community-*/"
+                  f"aerospike-server-*.deb")
+        nodes = test.get("nodes", [node])
+        mesh = "\n".join(
+            f"    mesh-seed-address-port {n} 3002" for n in nodes)
+        cfg = ("service {\n  paxos-single-replica-limit 1\n}\n"
+               "network {\n  service { address any\n port 3000 }\n"
+               "  heartbeat {\n    mode mesh\n    port 3002\n"
+               f"{mesh}\n    interval 150\n    timeout 10\n  }}\n}}\n"
+               "namespace jepsen {\n  replication-factor 3\n"
+               "  memory-size 1G\n  storage-engine memory\n}\n")
+        sess.exec("sh", "-c",
+                  f"cat > /etc/aerospike/aerospike.conf "
+                  f"<< 'EOF'\n{cfg}\nEOF")
+        sess.exec("service", "aerospike", "restart")
+
+    def teardown(self, test, node):
+        sess = control.current_session().su()
+        sess.exec_ok("service", "aerospike", "stop")
+
+    def log_files(self, test, node):
+        return [LOGFILE]
+
+
+def workloads(opts: dict | None = None) -> dict:
+    std = standard_workloads(opts)
+    return {k: std[k] for k in ("register", "set", "monotonic")}
+
+
+def aerospike_test(opts: dict | None = None) -> dict:
+    opts = base_opts(**(opts or {}))
+    wname = opts.get("workload", "register")
+    return suite_test(
+        "aerospike", wname, opts, workloads(opts),
+        db=AerospikeDB(opts.get("version", "3.5.4")),
+        client=opts.get("client"),
+        nemesis=jnemesis.partition_random_halves(),
+        os_setup=os_setup.debian())
+
+
+def main(argv=None) -> int:
+    from . import resolve_workload
+    return jcli.run_cli(
+        lambda tmap, args: aerospike_test(
+            {**tmap,
+             "workload": resolve_workload(args, tmap, "register")}),
+        name="aerospike",
+        opt_fn=lambda p: p.add_argument(
+            "--workload", default=None, choices=sorted(workloads())),
+        argv=argv)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,159 @@
+"""Chronos suite.
+
+Counterpart of chronos/src/jepsen/chronos/ (750 LoC): Chronos job
+scheduling over Mesos + ZooKeeper — jobs are scheduled via Chronos's
+HTTP API and the checker verifies every job ran on time by reading
+run-marker files off the nodes. The HTTP scheduling client is real
+(urllib); the mesos/zk stack installs are the DB layer.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+from .. import checker as jchecker
+from .. import cli as jcli
+from .. import client as jclient
+from .. import control
+from .. import db as jdb
+from .. import generator as gen
+from .. import nemesis as jnemesis, os_setup
+from . import base_opts, nemesis_cycle
+from .sql import resolve
+
+
+class ChronosDB(jdb.DB, jdb.LogFiles):
+    """zookeeper + mesos master/agent + chronos via apt
+    (chronos/src/jepsen/chronos.clj's setup)."""
+
+    def setup(self, test, node):
+        sess = control.current_session().su()
+        sess.exec("apt-get", "install", "-y",
+                  "zookeeperd", "mesos", "chronos")
+        nodes = test.get("nodes", [node])
+        zk = ",".join(f"{n}:2181" for n in nodes)
+        sess.exec("sh", "-c",
+                  f"echo zk://{zk}/mesos > /etc/mesos/zk")
+        sess.exec("service", "zookeeper", "restart")
+        sess.exec("service", "mesos-master", "restart")
+        sess.exec("service", "mesos-slave", "restart")
+        sess.exec("service", "chronos", "restart")
+
+    def teardown(self, test, node):
+        sess = control.current_session().su()
+        for svc in ("chronos", "mesos-slave", "mesos-master",
+                    "zookeeper"):
+            sess.exec_ok("service", svc, "stop")
+
+    def log_files(self, test, node):
+        return ["/var/log/chronos/chronos.log",
+                "/var/log/mesos/mesos-master.INFO"]
+
+
+class ChronosClient(jclient.Client):
+    """Schedules run-once jobs over the HTTP API; each job touches a
+    marker file the final read collects (chronos.clj's add-job! /
+    read-runs shape)."""
+
+    def __init__(self, port: int = 4400, node: str | None = None,
+                 timeout: float = 10.0):
+        self.port = port
+        self.node = node
+        self.timeout = timeout
+
+    def open(self, test, node):
+        return ChronosClient(self.port, node, self.timeout)
+
+    def invoke(self, test, op):
+        crash = "fail" if op["f"] == "read" else "info"
+        host, port = resolve(self.node, self.port, test or {})
+        try:
+            if op["f"] == "add":
+                j = op["value"]
+                body = json.dumps({
+                    "name": f"jepsen-{j}",
+                    "command": f"touch /tmp/chronos-run-{j}",
+                    "schedule": "R1//PT10S", "epsilon": "PT30S",
+                    "owner": "jepsen@localhost",
+                }).encode()
+                req = urllib.request.Request(
+                    f"http://{host}:{port}/scheduler/iso8601",
+                    data=body, method="POST",
+                    headers={"Content-Type": "application/json"})
+                urllib.request.urlopen(req, timeout=self.timeout).read()
+                return {**op, "type": "ok"}
+            if op["f"] == "read":
+                # collect run markers from every node over SSH
+                runs = set()
+                for n in test.get("nodes", []):
+                    sess = control.session(test, n)
+                    try:
+                        out = sess.exec_raw(
+                            "ls /tmp/ | grep chronos-run- || true").out
+                        for line in out.split():
+                            runs.add(int(line.rsplit("-", 1)[-1]))
+                    finally:
+                        sess.disconnect()
+                return {**op, "type": "ok", "value": sorted(runs)}
+            return {**op, "type": "fail", "error": f"unknown f {op['f']!r}"}
+        except urllib.error.HTTPError as e:
+            return {**op, "type": "fail" if 400 <= e.code < 500
+                    else crash, "error": f"http-{e.code}"}
+        except OSError as e:
+            return {**op, "type": crash, "error": str(e)[:160]}
+
+
+def generator():
+    import itertools
+    counter = itertools.count()
+
+    def add(test=None, ctx=None):
+        return {"type": "invoke", "f": "add", "value": next(counter)}
+
+    return gen.stagger(1.0, add)
+
+
+def final_read():
+    return gen.clients(gen.until_ok(gen.repeat_gen({"f": "read"})))
+
+
+def workloads(opts: dict | None = None) -> dict:
+    return {"jobs": lambda: {
+        "generator": generator(),
+        "checker": jchecker.set_checker()}}
+
+
+def chronos_test(opts: dict | None = None) -> dict:
+    opts = base_opts(**(opts or {}))
+    test = {
+        "name": "chronos jobs",
+        "os": os_setup.debian(),
+        "db": ChronosDB(),
+        "client": opts.get("client") or ChronosClient(),
+        "nemesis": jnemesis.partition_random_halves(),
+        "checker": jchecker.set_checker(),
+        "generator": gen.phases(
+            gen.time_limit(
+                opts.get("time-limit", 60),
+                gen.clients(generator(),
+                            nemesis_cycle(
+                                opts.get("nemesis-interval", 10)))),
+            gen.nemesis(gen.once({"type": "info", "f": "stop"})),
+            final_read()),
+        "workload": "jobs",
+    }
+    for k, v in opts.items():
+        test.setdefault(k, v)
+    return test
+
+
+def main(argv=None) -> int:
+    return jcli.run_cli(lambda tmap, args: chronos_test(tmap),
+                        name="chronos", argv=argv)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,93 @@
+"""CrateDB suite.
+
+Counterpart of crate/src/jepsen/crate/ (core + dirty_read +
+lost_updates + version_divergence, 1,060 LoC): a tarball-installed
+Crate cluster driven over its PostgreSQL wire port (5432 — the same
+pg-wire driver the cockroach suite uses; the reference goes through
+Crate's JDBC). The reference's anomaly hunts map onto the shared
+matrix: dirty-read ≈ register, lost-updates ≈ monotonic/wr,
+version-divergence ≈ long-fork.
+"""
+
+from __future__ import annotations
+
+from .. import cli as jcli
+from .. import control
+from .. import db as jdb
+from .. import nemesis as jnemesis, os_setup
+from ..control import util as cutil
+from . import base_opts, sql, standard_workloads, suite_test
+
+VERSION = "0.57.4"
+DIR = "/opt/crate"
+PIDFILE = f"{DIR}/crate.pid"
+LOGFILE = f"{DIR}/logs/crate.log"
+
+
+class CrateDB(jdb.DB, jdb.LogFiles):
+    def __init__(self, version: str = VERSION):
+        self.version = version
+
+    def setup(self, test, node):
+        sess = control.current_session().su()
+        url = (f"https://cdn.crate.io/downloads/releases/"
+               f"crate-{self.version}.tar.gz")
+        cutil.install_archive(sess, url, DIR)
+        nodes = test.get("nodes", [node])
+        hosts = ",".join(f"{n}:4300" for n in nodes)
+        cutil.start_daemon(
+            sess, f"{DIR}/bin/crate",
+            f"-Cnode.name={node}",
+            f"-Cnetwork.host={node}",
+            f"-Cdiscovery.seed_hosts={hosts}",
+            f"-Ccluster.initial_master_nodes={nodes[0]}",
+            "-Cpsql.enabled=true",
+            logfile=LOGFILE, pidfile=PIDFILE, chdir=DIR)
+
+    def teardown(self, test, node):
+        sess = control.current_session().su()
+        cutil.stop_daemon(sess, PIDFILE)
+        sess.exec("rm", "-rf", f"{DIR}/data")
+
+    def log_files(self, test, node):
+        return [LOGFILE]
+
+
+def workloads(opts: dict | None = None) -> dict:
+    std = standard_workloads(opts)
+    return {k: std[k] for k in
+            ("register", "set", "wr", "monotonic", "long-fork")}
+
+
+def default_client(workload: str, opts: dict):
+    return sql.client_for(
+        sql.PGDialect(port=5432, user="crate", database="doc"),
+        workload, opts)
+
+
+def crate_test(opts: dict | None = None) -> dict:
+    opts = base_opts(**(opts or {}))
+    wname = opts.get("workload", "register")
+    return suite_test(
+        "crate", wname, opts, workloads(opts),
+        db=CrateDB(opts.get("version", VERSION)),
+        client=opts.get("client") or default_client(wname, opts),
+        nemesis=jnemesis.partition_random_halves(),
+        os_setup=os_setup.debian())
+
+
+def main(argv=None) -> int:
+    from . import resolve_workload
+    return jcli.run_cli(
+        lambda tmap, args: crate_test(
+            {**tmap,
+             "workload": resolve_workload(args, tmap, "register")}),
+        name="crate",
+        opt_fn=lambda p: p.add_argument(
+            "--workload", default=None, choices=sorted(workloads())),
+        argv=argv)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -73,6 +73,14 @@ class DisqueDB(jdb.DB, jdb.LogFiles):
         return [LOGFILE]
 
 
+class AckIndeterminate(Exception):
+    """GETJOB delivered a job but the ACKJOB outcome is unknown."""
+
+    def __init__(self, value):
+        super().__init__("ack outcome unknown")
+        self.value = value
+
+
 class DisqueClient(jclient.Client):
     """Queue ops over ADDJOB/GETJOB/ACKJOB (disque.clj:140-231).
     GETJOB with a short timeout; jobs are acked after dequeue, so a
@@ -105,14 +113,21 @@ class DisqueClient(jclient.Client):
                 self.conn = None
 
     def _dequeue1(self):
-        """-> value | None (empty)."""
+        """-> value | None (empty). An error on the ACKJOB itself is
+        indeterminate — the server may have consumed the job — and
+        surfaces as AckIndeterminate so callers report "info", not a
+        definite fail (a false "fail" here reads as data loss to
+        total-queue)."""
         jobs = self.conn.command(
             "GETJOB", "TIMEOUT", self.getjob_timeout_ms,
             "FROM", QUEUE)
         if not jobs:
             return None
         _q, job_id, body = jobs[0]
-        self.conn.command("ACKJOB", job_id)
+        try:
+            self.conn.command("ACKJOB", job_id)
+        except (DriverError, OSError) as e:
+            raise AckIndeterminate(int(body)) from e
         return int(body)
 
     def _drain(self, test, op):
@@ -126,6 +141,11 @@ class DisqueClient(jclient.Client):
                 if v is None:
                     break
                 out.append(v)
+        except AckIndeterminate:
+            # the unknown element either redelivers (another drain gets
+            # it) or was consumed (the reference's failure mode too);
+            # the definitively-acked prefix stays in the completion
+            self.close(test)
         except (DBError, DriverError, OSError) as e:
             self.close(test)
             if not out:
@@ -142,7 +162,12 @@ class DisqueClient(jclient.Client):
                     "RETRY", 1)
                 return {**op, "type": "ok"}
             if op["f"] == "dequeue":
-                v = self._dequeue1()
+                try:
+                    v = self._dequeue1()
+                except AckIndeterminate:
+                    self.close(test)
+                    return {**op, "type": "info",
+                            "error": "ack-indeterminate"}
                 if v is None:
                     return {**op, "type": "fail", "error": "empty"}
                 return {**op, "type": "ok", "value": v}

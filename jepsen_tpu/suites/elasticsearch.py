@@ -1,0 +1,159 @@
+"""Elasticsearch suite.
+
+Counterpart of elasticsearch/src/jepsen/elasticsearch (862 LoC): a
+deb-installed ES cluster and the set workload that exposed its
+dirty-window data loss — documents indexed during partitions, a final
+refresh + search that must see every acknowledged doc. Client is plain
+HTTP (the reference goes through the native transport client).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+from .. import checker as jchecker
+from .. import cli as jcli
+from .. import client as jclient
+from .. import control
+from .. import db as jdb
+from .. import generator as gen
+from .. import nemesis as jnemesis, os_setup
+from ..workloads import set_workload
+from . import base_opts, nemesis_cycle
+from .sql import resolve
+
+VERSION = "1.5.0"
+LOGFILE = "/var/log/elasticsearch/elasticsearch.log"
+INDEX = "jepsen"
+
+
+class ElasticsearchDB(jdb.DB, jdb.LogFiles):
+    def __init__(self, version: str = VERSION):
+        self.version = version
+
+    def setup(self, test, node):
+        sess = control.current_session().su()
+        url = (f"https://download.elastic.co/elasticsearch/elasticsearch/"
+               f"elasticsearch-{self.version}.deb")
+        sess.exec("sh", "-c",
+                  f"wget -q -O /tmp/es.deb {url} && "
+                  f"dpkg -i --force-confnew /tmp/es.deb")
+        nodes = test.get("nodes", [node])
+        hosts = json.dumps([f"{n}:9300" for n in nodes])
+        cfg = "\n".join([
+            f"cluster.name: jepsen",
+            f"node.name: {node}",
+            f"network.host: {node}",
+            f"discovery.zen.ping.unicast.hosts: {hosts}",
+            f"discovery.zen.minimum_master_nodes: "
+            f"{len(nodes) // 2 + 1}",
+        ])
+        sess.exec("sh", "-c",
+                  f"cat > /etc/elasticsearch/elasticsearch.yml "
+                  f"<< 'EOF'\n{cfg}\nEOF")
+        sess.exec("service", "elasticsearch", "restart")
+
+    def teardown(self, test, node):
+        sess = control.current_session().su()
+        sess.exec_ok("service", "elasticsearch", "stop")
+        sess.exec("rm", "-rf", "/var/lib/elasticsearch/jepsen")
+
+    def log_files(self, test, node):
+        return [LOGFILE]
+
+
+class ESClient(jclient.Client):
+    """Set ops over the document API: add = index doc with id=value
+    (write concern: wait_for_active_shards), read = refresh + match_all
+    search."""
+
+    def __init__(self, port: int = 9200, node: str | None = None,
+                 timeout: float = 5.0):
+        self.port = port
+        self.node = node
+        self.timeout = timeout
+
+    def open(self, test, node):
+        return ESClient(self.port, node, self.timeout)
+
+    def _url(self, test, path: str) -> str:
+        host, port = resolve(self.node, self.port, test or {})
+        return f"http://{host}:{port}{path}"
+
+    def _request(self, test, path: str, body: dict | None = None,
+                 method: str = "GET") -> dict:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self._url(test, path), data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.loads(r.read() or b"{}")
+
+    def invoke(self, test, op):
+        crash = "fail" if op["f"] == "read" else "info"
+        try:
+            if op["f"] == "add":
+                v = int(op["value"])
+                self._request(test, f"/{INDEX}/doc/{v}?op_type=create",
+                              {"value": v}, "PUT")
+                return {**op, "type": "ok"}
+            if op["f"] == "read":
+                self._request(test, f"/{INDEX}/_refresh", None, "POST")
+                out = self._request(
+                    test, f"/{INDEX}/_search",
+                    {"size": 100000,
+                     "query": {"match_all": {}}}, "POST")
+                hits = out.get("hits", {}).get("hits", [])
+                return {**op, "type": "ok",
+                        "value": sorted(int(h["_id"]) for h in hits)}
+            return {**op, "type": "fail", "error": f"unknown f {op['f']!r}"}
+        except urllib.error.HTTPError as e:
+            if e.code == 409:   # op_type=create conflict: definite
+                return {**op, "type": "fail", "error": "conflict"}
+            if 400 <= e.code < 500:
+                return {**op, "type": "fail", "error": f"http-{e.code}"}
+            return {**op, "type": crash, "error": f"http-{e.code}"}
+        except OSError as e:
+            return {**op, "type": crash, "error": str(e)[:160]}
+
+
+def workloads(opts: dict | None = None) -> dict:
+    opts = opts or {}
+    return {"set": lambda: set_workload.test(
+        n=opts.get("set-size", 500))}
+
+
+def elasticsearch_test(opts: dict | None = None) -> dict:
+    opts = base_opts(**(opts or {}))
+    wl = workloads(opts)["set"]()
+    test = {
+        "name": "elasticsearch set",
+        "os": os_setup.debian(),
+        "db": ElasticsearchDB(opts.get("version", VERSION)),
+        "client": opts.get("client") or ESClient(),
+        "nemesis": jnemesis.partition_random_halves(),
+        "checker": jchecker.compose({
+            "set": wl["checker"],
+            "perf": jchecker.perf_checker(),
+        }),
+        "generator": gen.time_limit(
+            opts.get("time-limit", 60),
+            gen.clients(wl["generator"],
+                        nemesis_cycle(opts.get("nemesis-interval", 10)))),
+        "workload": "set",
+    }
+    for k, v in opts.items():
+        test.setdefault(k, v)
+    return test
+
+
+def main(argv=None) -> int:
+    return jcli.run_cli(lambda tmap, args: elasticsearch_test(tmap),
+                        name="elasticsearch", argv=argv)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -164,6 +164,18 @@ def cas(test=None, ctx=None):
             "value": [random.randint(0, 4), random.randint(0, 4)]}
 
 
+def workloads(opts: dict | None = None) -> dict:
+    """Registry-uniform view: etcd is the single canonical CAS-register
+    suite (etcd.clj:149-180)."""
+    opts = opts or {}
+
+    def register():
+        t = etcd_test(opts)
+        return {"generator": t["generator"], "checker": t["checker"]}
+
+    return {"register": register}
+
+
 def etcd_test(opts: dict | None = None) -> dict:
     """Full test map (etcd-test, etcd.clj:150-180)."""
     opts = base_opts(**(opts or {}))

@@ -1,0 +1,99 @@
+"""FaunaDB suite.
+
+Counterpart of faunadb/src/jepsen/faunadb/ (3,605 LoC, the largest
+remaining reference suite): deb-installed FaunaDB with a
+log-replicated cluster, driven over its HTTP+JSON query API with
+secret-key auth. The workload matrix maps the reference's
+register/set/bank/monotonic/pages families onto the shared library;
+FQL query construction is client-pluggable (pass ``client``) — the
+install/cluster/workload wiring is complete.
+"""
+
+from __future__ import annotations
+
+from .. import cli as jcli
+from .. import control
+from .. import db as jdb
+from .. import nemesis as jnemesis, os_setup
+from . import base_opts, standard_workloads, suite_test
+
+LOGFILE = "/var/log/faunadb/core.log"
+
+
+class FaunaDB(jdb.DB, jdb.LogFiles):
+    """deb install + faunadb.yml with the replica topology
+    (faunadb/src/jepsen/faunadb/auto.clj's install!/configure!)."""
+
+    def __init__(self, version: str = "2.5.5"):
+        self.version = version
+
+    def setup(self, test, node):
+        sess = control.current_session().su()
+        sess.exec("sh", "-c",
+                  "wget -qO- https://repo.fauna.com/faunadb-gpg-public"
+                  ".key | apt-key add -")
+        sess.exec("sh", "-c",
+                  'echo "deb [arch=amd64] https://repo.fauna.com/debian'
+                  ' stable non-free" > /etc/apt/sources.list.d/'
+                  'faunadb.list')
+        sess.exec("apt-get", "update")
+        sess.exec("apt-get", "install", "-y",
+                  f"faunadb={self.version}")
+        nodes = test.get("nodes", [node])
+        cfg = "\n".join([
+            "auth_root_key: secret",
+            f"network_broadcast_address: {node}",
+            f"network_host_id: {node}",
+            "network_listen_address: 0.0.0.0",
+            "storage_data_path: /var/lib/faunadb",
+            "log_path: /var/log/faunadb",
+        ])
+        sess.exec("sh", "-c",
+                  f"cat > /etc/faunadb.yml << 'EOF'\n{cfg}\nEOF")
+        sess.exec("service", "faunadb", "restart")
+        if node == nodes[0]:
+            sess.exec_ok("faunadb-admin", "init")
+        else:
+            sess.exec_ok("faunadb-admin", "join", nodes[0])
+
+    def teardown(self, test, node):
+        sess = control.current_session().su()
+        sess.exec_ok("service", "faunadb", "stop")
+        sess.exec("rm", "-rf", "/var/lib/faunadb")
+
+    def log_files(self, test, node):
+        return [LOGFILE]
+
+
+def workloads(opts: dict | None = None) -> dict:
+    std = standard_workloads(opts)
+    return {k: std[k] for k in
+            ("register", "set", "bank", "monotonic", "g2")}
+
+
+def faunadb_test(opts: dict | None = None) -> dict:
+    opts = base_opts(**(opts or {}))
+    wname = opts.get("workload", "register")
+    return suite_test(
+        "faunadb", wname, opts, workloads(opts),
+        db=FaunaDB(opts.get("version", "2.5.5")),
+        client=opts.get("client"),
+        nemesis=jnemesis.partition_random_halves(),
+        os_setup=os_setup.debian())
+
+
+def main(argv=None) -> int:
+    from . import resolve_workload
+    return jcli.run_cli(
+        lambda tmap, args: faunadb_test(
+            {**tmap,
+             "workload": resolve_workload(args, tmap, "register")}),
+        name="faunadb",
+        opt_fn=lambda p: p.add_argument(
+            "--workload", default=None, choices=sorted(workloads())),
+        argv=argv)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

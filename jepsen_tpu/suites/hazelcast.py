@@ -1,0 +1,99 @@
+"""Hazelcast suite.
+
+Counterpart of hazelcast/src/jepsen/hazelcast.clj (821 LoC + the
+SetUnionMergePolicy.java server extension): an embedded-jar server
+started per node with a TCP/IP member list, driven through locks,
+queues, CRDT-ish sets and unique-id generators. The client protocol is
+Hazelcast's JVM binary protocol — pluggable (pass ``client``);
+install/daemon/workload wiring is complete.
+"""
+
+from __future__ import annotations
+
+from .. import cli as jcli
+from .. import control
+from .. import db as jdb
+from .. import nemesis as jnemesis, os_setup
+from ..control import util as cutil
+from . import base_opts, standard_workloads, suite_test
+
+DIR = "/opt/hazelcast"
+VERSION = "3.10.3"
+PIDFILE = f"{DIR}/hazelcast.pid"
+LOGFILE = f"{DIR}/hazelcast.log"
+
+
+class HazelcastDB(jdb.DB, jdb.LogFiles):
+    """jar download + java -jar server with tcp-ip join config
+    (install!/db, hazelcast.clj:69-110)."""
+
+    def __init__(self, version: str = VERSION):
+        self.version = version
+
+    def setup(self, test, node):
+        sess = control.current_session().su()
+        sess.exec("apt-get", "install", "-y", "openjdk-8-jre-headless")
+        sess.exec("mkdir", "-p", DIR)
+        url = (f"https://repo1.maven.org/maven2/com/hazelcast/hazelcast/"
+               f"{self.version}/hazelcast-{self.version}.jar")
+        sess.exec("sh", "-c",
+                  f"test -f {DIR}/hazelcast.jar || "
+                  f"wget -qO {DIR}/hazelcast.jar {url}")
+        nodes = test.get("nodes", [node])
+        members = "\n".join(
+            f"          <member>{n}</member>" for n in nodes)
+        cfg = ("<hazelcast xmlns=\"http://www.hazelcast.com/schema/"
+               "config\">\n  <network>\n    <port>5701</port>\n"
+               "    <join>\n      <multicast enabled=\"false\"/>\n"
+               "      <tcp-ip enabled=\"true\">\n"
+               f"{members}\n      </tcp-ip>\n    </join>\n"
+               "  </network>\n</hazelcast>\n")
+        sess.exec("sh", "-c",
+                  f"cat > {DIR}/hazelcast.xml << 'EOF'\n{cfg}\nEOF")
+        cutil.start_daemon(
+            sess, "java",
+            f"-Dhazelcast.config={DIR}/hazelcast.xml",
+            "-cp", f"{DIR}/hazelcast.jar",
+            "com.hazelcast.core.server.StartServer",
+            logfile=LOGFILE, pidfile=PIDFILE, chdir=DIR)
+
+    def teardown(self, test, node):
+        sess = control.current_session().su()
+        cutil.stop_daemon(sess, PIDFILE)
+
+    def log_files(self, test, node):
+        return [LOGFILE]
+
+
+def workloads(opts: dict | None = None) -> dict:
+    std = standard_workloads(opts)
+    # hazelcast.clj's matrix: locks, queues, unique-ids, crdt sets —
+    # the shared analogues:
+    return {k: std[k] for k in ("set", "register", "monotonic")}
+
+
+def hazelcast_test(opts: dict | None = None) -> dict:
+    opts = base_opts(**(opts or {}))
+    wname = opts.get("workload", "set")
+    return suite_test(
+        "hazelcast", wname, opts, workloads(opts),
+        db=HazelcastDB(opts.get("version", VERSION)),
+        client=opts.get("client"),
+        nemesis=jnemesis.partition_random_halves(),
+        os_setup=os_setup.debian())
+
+
+def main(argv=None) -> int:
+    from . import resolve_workload
+    return jcli.run_cli(
+        lambda tmap, args: hazelcast_test(
+            {**tmap, "workload": resolve_workload(args, tmap, "set")}),
+        name="hazelcast",
+        opt_fn=lambda p: p.add_argument(
+            "--workload", default=None, choices=sorted(workloads())),
+        argv=argv)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

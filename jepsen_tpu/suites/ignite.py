@@ -1,0 +1,94 @@
+"""Apache Ignite suite.
+
+Counterpart of ignite/src/jepsen/ignite/ (549 LoC + the thick-client
+Client.java/Bank.java workload): a zip-installed Ignite node per host
+with static IP discovery, bank and register workloads. The client
+protocol is Ignite's JVM binary protocol — pluggable (pass
+``client``); install/daemon/workload wiring is complete.
+"""
+
+from __future__ import annotations
+
+from .. import cli as jcli
+from .. import control
+from .. import db as jdb
+from .. import nemesis as jnemesis, os_setup
+from ..control import util as cutil
+from . import base_opts, standard_workloads, suite_test
+
+DIR = "/opt/ignite"
+VERSION = "2.7.0"
+PIDFILE = f"{DIR}/ignite.pid"
+LOGFILE = f"{DIR}/ignite.log"
+
+
+class IgniteDB(jdb.DB, jdb.LogFiles):
+    def __init__(self, version: str = VERSION):
+        self.version = version
+
+    def setup(self, test, node):
+        sess = control.current_session().su()
+        sess.exec("apt-get", "install", "-y", "openjdk-8-jre-headless")
+        url = (f"https://archive.apache.org/dist/ignite/{self.version}/"
+               f"apache-ignite-{self.version}-bin.zip")
+        cutil.install_archive(sess, url, DIR)
+        nodes = test.get("nodes", [node])
+        addrs = "\n".join(
+            f"            <value>{n}:47500</value>" for n in nodes)
+        cfg = ("<beans xmlns=\"http://www.springframework.org/schema/"
+               "beans\">\n <bean class=\"org.apache.ignite."
+               "configuration.IgniteConfiguration\">\n  <property "
+               "name=\"discoverySpi\">\n   <bean class=\"org.apache."
+               "ignite.spi.discovery.tcp.TcpDiscoverySpi\">\n"
+               "    <property name=\"ipFinder\">\n     <bean class="
+               "\"org.apache.ignite.spi.discovery.tcp.ipfinder.vm."
+               "TcpDiscoveryVmIpFinder\">\n      <property name="
+               "\"addresses\">\n       <list>\n"
+               f"{addrs}\n       </list>\n      </property>\n     "
+               "</bean>\n    </property>\n   </bean>\n  </property>\n"
+               " </bean>\n</beans>\n")
+        sess.exec("sh", "-c",
+                  f"cat > {DIR}/config/jepsen.xml << 'EOF'\n{cfg}\nEOF")
+        cutil.start_daemon(
+            sess, f"{DIR}/bin/ignite.sh", f"{DIR}/config/jepsen.xml",
+            logfile=LOGFILE, pidfile=PIDFILE, chdir=DIR)
+
+    def teardown(self, test, node):
+        sess = control.current_session().su()
+        cutil.stop_daemon(sess, PIDFILE)
+        sess.exec("rm", "-rf", f"{DIR}/work")
+
+    def log_files(self, test, node):
+        return [LOGFILE]
+
+
+def workloads(opts: dict | None = None) -> dict:
+    std = standard_workloads(opts)
+    return {k: std[k] for k in ("bank", "register", "set")}
+
+
+def ignite_test(opts: dict | None = None) -> dict:
+    opts = base_opts(**(opts or {}))
+    wname = opts.get("workload", "bank")
+    return suite_test(
+        "ignite", wname, opts, workloads(opts),
+        db=IgniteDB(opts.get("version", VERSION)),
+        client=opts.get("client"),
+        nemesis=jnemesis.partition_random_halves(),
+        os_setup=os_setup.debian())
+
+
+def main(argv=None) -> int:
+    from . import resolve_workload
+    return jcli.run_cli(
+        lambda tmap, args: ignite_test(
+            {**tmap, "workload": resolve_workload(args, tmap, "bank")}),
+        name="ignite",
+        opt_fn=lambda p: p.add_argument(
+            "--workload", default=None, choices=sorted(workloads())),
+        argv=argv)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,152 @@
+"""LogCabin suite.
+
+Counterpart of logcabin/src/jepsen/logcabin.clj (246 LoC): the raft
+reference implementation, built from source, bootstrapped on node 0
+and reconfigured to the full member set; register workload over its
+tree store. LogCabin's client protocol is its own protobuf RPC — the
+wire client is pluggable (pass ``client``); the reference itself
+drives ops through the `logcabin` CLI binary, and so does the default
+client here (exec over SSH).
+"""
+
+from __future__ import annotations
+
+from .. import checker as jchecker
+from .. import cli as jcli
+from .. import client as jclient
+from .. import control
+from .. import db as jdb
+from .. import generator as gen
+from .. import independent, nemesis as jnemesis, os_setup
+from ..checker import models
+from ..control import util as cutil
+from . import base_opts, nemesis_cycle
+
+DIR = "/opt/logcabin"
+PIDFILE = f"{DIR}/logcabin.pid"
+LOGFILE = f"{DIR}/logcabin.log"
+
+
+class LogCabinDB(jdb.DB, jdb.LogFiles):
+    """git + scons build, bootstrap on node 0, daemonize
+    (install!/db, logcabin.clj:23-140)."""
+
+    def setup(self, test, node):
+        sess = control.current_session().su()
+        sess.exec("apt-get", "install", "-y", "git-core", "scons",
+                  "g++", "protobuf-compiler", "libprotobuf-dev",
+                  "libcrypto++-dev")
+        sess.exec("sh", "-c",
+                  f"test -d {DIR} || git clone "
+                  f"https://github.com/logcabin/logcabin {DIR}")
+        sess.exec("sh", "-c",
+                  f"cd {DIR} && git submodule update --init && scons")
+        nodes = test.get("nodes", [node])
+        sid = nodes.index(node) + 1 if node in nodes else 1
+        cfg = "\n".join([f"serverId = {sid}",
+                         f"listenAddresses = {node}:5254",
+                         f"storagePath = {DIR}/storage"])
+        sess.exec("sh", "-c",
+                  f"cat > {DIR}/logcabin.conf << 'EOF'\n{cfg}\nEOF")
+        if node == nodes[0]:
+            sess.exec(f"{DIR}/build/LogCabin",
+                      "--config", f"{DIR}/logcabin.conf", "--bootstrap")
+        cutil.start_daemon(
+            sess, f"{DIR}/build/LogCabin",
+            "--config", f"{DIR}/logcabin.conf",
+            logfile=LOGFILE, pidfile=PIDFILE, chdir=DIR)
+
+    def teardown(self, test, node):
+        sess = control.current_session().su()
+        cutil.stop_daemon(sess, PIDFILE)
+        sess.exec("rm", "-rf", f"{DIR}/storage")
+
+    def log_files(self, test, node):
+        return [LOGFILE]
+
+
+class LogCabinClient(jclient.Client):
+    """Register ops via the `logcabin` CLI over SSH (write/read a tree
+    path) — the reference shells out the same way for its smoke ops."""
+
+    def __init__(self, node: str | None = None):
+        self.node = node
+
+    def open(self, test, node):
+        return LogCabinClient(node)
+
+    def invoke(self, test, op):
+        v = op["value"]
+        k, val = (v.key, v.value) if independent.is_tuple(v) else (0, v)
+        lift = (lambda x: independent.tuple_(k, x)) \
+            if independent.is_tuple(v) else (lambda x: x)
+        sess = control.session(test, self.node)
+        cluster = ",".join(f"{n}:5254" for n in test.get("nodes", []))
+        try:
+            if op["f"] == "read":
+                res = sess.exec_raw(
+                    f"{DIR}/build/Examples/TreeOps "
+                    f"--cluster={cluster} read /r{k} 2>/dev/null")
+                out = res.out.strip()
+                return {**op, "type": "ok",
+                        "value": lift(int(out) if out else None)}
+            if op["f"] == "write":
+                sess.exec("sh", "-c",
+                          f"echo {int(val)} | "
+                          f"{DIR}/build/Examples/TreeOps "
+                          f"--cluster={cluster} write /r{k}")
+                return {**op, "type": "ok"}
+            return {**op, "type": "fail", "error": f"unknown f {op['f']!r}"}
+        except control.CommandError as e:
+            return {**op, "type": "fail", "error": str(e)[:120]}
+        except control.ConnectionError_ as e:
+            crash = "fail" if op["f"] == "read" else "info"
+            return {**op, "type": crash, "error": str(e)[:120]}
+        finally:
+            sess.disconnect()
+
+
+def workloads(opts: dict | None = None) -> dict:
+    from ..workloads.register import r, w
+
+    def register():
+        return {
+            "generator": independent.concurrent_generator(
+                2, range(10_000),
+                lambda k: gen.limit(100, gen.mix([r, w]))),
+            "checker": independent.checker(
+                jchecker.linearizable(models.register())),
+        }
+
+    return {"register": register}
+
+
+def logcabin_test(opts: dict | None = None) -> dict:
+    opts = base_opts(**(opts or {}))
+    wl = workloads(opts)["register"]()
+    test = {
+        "name": "logcabin register",
+        "os": os_setup.debian(),
+        "db": LogCabinDB(),
+        "client": opts.get("client") or LogCabinClient(),
+        "nemesis": jnemesis.partition_random_halves(),
+        "checker": wl["checker"],
+        "generator": gen.time_limit(
+            opts.get("time-limit", 60),
+            gen.clients(wl["generator"],
+                        nemesis_cycle(opts.get("nemesis-interval", 10)))),
+        "workload": "register",
+    }
+    for k, v in opts.items():
+        test.setdefault(k, v)
+    return test
+
+
+def main(argv=None) -> int:
+    return jcli.run_cli(lambda tmap, args: logcabin_test(tmap),
+                        name="logcabin", argv=argv)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

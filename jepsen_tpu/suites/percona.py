@@ -1,0 +1,89 @@
+"""Percona XtraDB Cluster suite.
+
+Counterpart of percona/src/jepsen/percona.clj (bank + sets over a
+galera-based XtraDB cluster, mysql protocol). Same shape as the galera
+suite with Percona's packages and bootstrap command.
+"""
+
+from __future__ import annotations
+
+from .. import cli as jcli
+from .. import control
+from .. import db as jdb
+from .. import nemesis as jnemesis, os_setup
+from . import base_opts, sql, standard_workloads, suite_test
+
+LOGFILE = "/var/log/mysql/error.log"
+
+
+class PerconaDB(jdb.DB, jdb.LogFiles):
+    def setup(self, test, node):
+        sess = control.current_session().su()
+        sess.exec("apt-get", "install", "-y", "percona-xtradb-cluster-57")
+        nodes = test.get("nodes", [node])
+        cluster = ",".join(nodes)
+        cfg = "\n".join([
+            "[mysqld]",
+            "wsrep_provider=/usr/lib/galera3/libgalera_smm.so",
+            f"wsrep_cluster_address=gcomm://{cluster}",
+            f"wsrep_node_address={node}",
+            "wsrep_sst_method=xtrabackup-v2",
+            "pxc_strict_mode=ENFORCING",
+            "binlog_format=ROW",
+            "default_storage_engine=InnoDB",
+            "innodb_autoinc_lock_mode=2",
+        ])
+        sess.exec("sh", "-c",
+                  f"cat > /etc/mysql/percona-xtradb-cluster.conf.d/"
+                  f"jepsen.cnf << 'EOF'\n{cfg}\nEOF")
+        if node == nodes[0]:
+            sess.exec("sh", "-c",
+                      "systemctl start mysql@bootstrap || "
+                      "/etc/init.d/mysql bootstrap-pxc")
+        else:
+            sess.exec("service", "mysql", "restart")
+
+    def teardown(self, test, node):
+        sess = control.current_session().su()
+        sess.exec_ok("service", "mysql", "stop")
+
+    def log_files(self, test, node):
+        return [LOGFILE]
+
+
+def workloads(opts: dict | None = None) -> dict:
+    std = standard_workloads(opts)
+    return {k: std[k] for k in ("set", "bank", "register", "sequential")}
+
+
+def default_client(workload: str, opts: dict):
+    return sql.client_for(
+        sql.MySQLDialect(port=3306, user="root", database="test"),
+        workload, opts)
+
+
+def percona_test(opts: dict | None = None) -> dict:
+    opts = base_opts(**(opts or {}))
+    wname = opts.get("workload", "bank")
+    return suite_test(
+        "percona", wname, opts, workloads(opts),
+        db=PerconaDB(),
+        client=opts.get("client") or default_client(wname, opts),
+        nemesis=jnemesis.partition_random_halves(),
+        os_setup=os_setup.debian())
+
+
+def main(argv=None) -> int:
+    from . import resolve_workload
+    return jcli.run_cli(
+        lambda tmap, args: percona_test(
+            {**tmap, "workload": resolve_workload(args, tmap, "bank")}),
+        name="percona",
+        opt_fn=lambda p: p.add_argument(
+            "--workload", default=None, choices=sorted(workloads())),
+        argv=argv)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -56,6 +56,14 @@ class RabbitDB(jdb.DB, jdb.LogFiles):
         return [LOGFILE]
 
 
+class AckIndeterminate(Exception):
+    """basic.get delivered a message but the ack outcome is unknown."""
+
+    def __init__(self, value):
+        super().__init__("ack outcome unknown")
+        self.value = value
+
+
 class RabbitClient(jclient.Client):
     """Durable-queue ops over AMQP publish/get/ack
     (rabbitmq.clj:135-175). basic.get + explicit ack after the value is
@@ -94,11 +102,17 @@ class RabbitClient(jclient.Client):
                 self.conn = None
 
     def _dequeue1(self):
+        """An error on the ack itself is indeterminate (the broker may
+        have consumed the message) — AckIndeterminate makes callers
+        report "info" rather than a definite fail."""
         got = self.conn.get(QUEUE)
         if got is None:
             return None
         tag, body = got
-        self.conn.ack(tag)
+        try:
+            self.conn.ack(tag)
+        except (DriverError, OSError) as e:
+            raise AckIndeterminate(int(body)) from e
         return int(body)
 
     def _drain(self, test, op):
@@ -114,6 +128,9 @@ class RabbitClient(jclient.Client):
                 if v is None:
                     break
                 out.append(v)
+        except AckIndeterminate:
+            self.close(test)   # acked prefix stays; unknown tail either
+            # redelivers or counts lost (the reference's mode too)
         except (DBError, DriverError, OSError) as e:
             self.close(test)
             if not out:
@@ -129,7 +146,12 @@ class RabbitClient(jclient.Client):
                                   persistent=True)
                 return {**op, "type": "ok"}
             if op["f"] == "dequeue":
-                v = self._dequeue1()
+                try:
+                    v = self._dequeue1()
+                except AckIndeterminate:
+                    self.close(test)
+                    return {**op, "type": "info",
+                            "error": "ack-indeterminate"}
                 if v is None:
                     return {**op, "type": "fail", "error": "empty"}
                 return {**op, "type": "ok", "value": v}

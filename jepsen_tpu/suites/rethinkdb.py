@@ -1,0 +1,86 @@
+"""RethinkDB suite.
+
+Counterpart of rethinkdb/src/jepsen/rethinkdb (529 LoC): apt-installed
+RethinkDB with a joined cluster, document CAS over write_acks=majority
+tables. ReQL is a bespoke term-tree protocol spoken by the official
+driver; the client here is pluggable (pass ``client`` in opts) while
+install/cluster/workload wiring is complete.
+"""
+
+from __future__ import annotations
+
+from .. import cli as jcli
+from .. import control
+from .. import db as jdb
+from .. import nemesis as jnemesis, os_setup
+from . import base_opts, standard_workloads, suite_test
+
+LOGFILE = "/var/log/rethinkdb.log"
+
+
+class RethinkDB(jdb.DB, jdb.LogFiles):
+    """apt repo + service, joining node 0 (install!/start!,
+    rethinkdb.clj:52-100)."""
+
+    def __init__(self, version: str = "2.3.4~0jessie"):
+        self.version = version
+
+    def setup(self, test, node):
+        sess = control.current_session().su()
+        sess.exec("sh", "-c",
+                  "wget -qO- https://download.rethinkdb.com/apt/"
+                  "pubkey.gpg | apt-key add -")
+        sess.exec("sh", "-c",
+                  'echo "deb https://download.rethinkdb.com/apt '
+                  'jessie main" > /etc/apt/sources.list.d/rethinkdb.list')
+        sess.exec("apt-get", "update")
+        sess.exec("apt-get", "install", "-y",
+                  f"rethinkdb={self.version}")
+        nodes = test.get("nodes", [node])
+        cfg = "\n".join([f"bind=all", f"server-name={node}",
+                         f"join={nodes[0]}:29015"])
+        sess.exec("sh", "-c",
+                  f"cat > /etc/rethinkdb/instances.d/jepsen.conf "
+                  f"<< 'EOF'\n{cfg}\nEOF")
+        sess.exec("service", "rethinkdb", "start")
+
+    def teardown(self, test, node):
+        sess = control.current_session().su()
+        sess.exec_ok("service", "rethinkdb", "stop")
+        sess.exec("rm", "-rf", "/var/lib/rethinkdb")
+
+    def log_files(self, test, node):
+        return [LOGFILE]
+
+
+def workloads(opts: dict | None = None) -> dict:
+    std = standard_workloads(opts)
+    return {k: std[k] for k in ("register", "set", "bank")}
+
+
+def rethinkdb_test(opts: dict | None = None) -> dict:
+    opts = base_opts(**(opts or {}))
+    wname = opts.get("workload", "register")
+    return suite_test(
+        "rethinkdb", wname, opts, workloads(opts),
+        db=RethinkDB(opts.get("version", "2.3.4~0jessie")),
+        client=opts.get("client"),
+        nemesis=jnemesis.partition_random_halves(),
+        os_setup=os_setup.debian())
+
+
+def main(argv=None) -> int:
+    from . import resolve_workload
+    return jcli.run_cli(
+        lambda tmap, args: rethinkdb_test(
+            {**tmap,
+             "workload": resolve_workload(args, tmap, "register")}),
+        name="rethinkdb",
+        opt_fn=lambda p: p.add_argument(
+            "--workload", default=None, choices=sorted(workloads())),
+        argv=argv)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

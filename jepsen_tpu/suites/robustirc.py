@@ -1,0 +1,99 @@
+"""RobustIRC suite.
+
+Counterpart of robustirc/src/jepsen/robustirc.clj (217 LoC + the
+gencert.go TLS helper): a raft-replicated IRC network whose messages
+must never be lost or reordered. RobustIRC clients speak HTTP+JSON
+(robustsession protocol) to post and fetch messages; the suite wires a
+message-set workload over it. TLS cert generation is handled by
+openssl on-node instead of the reference's Go helper.
+"""
+
+from __future__ import annotations
+
+from .. import cli as jcli
+from .. import control
+from .. import db as jdb
+from .. import nemesis as jnemesis, os_setup
+from ..control import util as cutil
+from ..workloads import queue as queue_wl
+from . import base_opts, standard_workloads, suite_test
+
+DIR = "/opt/robustirc"
+PIDFILE = f"{DIR}/robustirc.pid"
+LOGFILE = f"{DIR}/robustirc.log"
+
+
+class RobustIRCDB(jdb.DB, jdb.LogFiles):
+    """go install + self-signed cert + join node 0
+    (db, robustirc.clj:40-110)."""
+
+    def setup(self, test, node):
+        sess = control.current_session().su()
+        sess.exec("apt-get", "install", "-y", "golang", "openssl")
+        sess.exec("sh", "-c",
+                  f"test -x {DIR}/robustirc || "
+                  f"GOPATH={DIR}/go go install "
+                  f"github.com/robustirc/robustirc@latest")
+        sess.exec("mkdir", "-p", DIR)
+        # self-signed cert (replaces resources/gencert.go)
+        sess.exec("sh", "-c",
+                  f"test -f {DIR}/cert.pem || openssl req -x509 "
+                  f"-newkey rsa:2048 -keyout {DIR}/key.pem "
+                  f"-out {DIR}/cert.pem -days 1 -nodes "
+                  f"-subj /CN={node}")
+        nodes = test.get("nodes", [node])
+        args = [f"{DIR}/go/bin/robustirc",
+                "-network_name", "jepsen",
+                "-peer_addr", f"{node}:13001",
+                "-tls_cert_path", f"{DIR}/cert.pem",
+                "-tls_key_path", f"{DIR}/key.pem"]
+        if node != nodes[0]:
+            args += ["-join", f"{nodes[0]}:13001"]
+        else:
+            args += ["-singlenode"]
+        cutil.start_daemon(sess, *args, logfile=LOGFILE,
+                           pidfile=PIDFILE, chdir=DIR)
+
+    def teardown(self, test, node):
+        sess = control.current_session().su()
+        cutil.stop_daemon(sess, PIDFILE)
+        sess.exec("rm", "-rf", f"{DIR}/data")
+
+    def log_files(self, test, node):
+        return [LOGFILE]
+
+
+def workloads(opts: dict | None = None) -> dict:
+    opts = opts or {}
+    std = standard_workloads(opts)
+    # message delivery == set semantics: every acknowledged message
+    # must be in the final channel history
+    return {"set": std["set"],
+            "queue": lambda: queue_wl.test(opts.get("ops", 500))}
+
+
+def robustirc_test(opts: dict | None = None) -> dict:
+    opts = base_opts(**(opts or {}))
+    wname = opts.get("workload", "set")
+    return suite_test(
+        "robustirc", wname, opts, workloads(opts),
+        db=RobustIRCDB(),
+        client=opts.get("client"),
+        nemesis=jnemesis.partition_random_halves(),
+        os_setup=os_setup.debian())
+
+
+def main(argv=None) -> int:
+    from . import resolve_workload
+    return jcli.run_cli(
+        lambda tmap, args: robustirc_test(
+            {**tmap, "workload": resolve_workload(args, tmap, "set")}),
+        name="robustirc",
+        opt_fn=lambda p: p.add_argument(
+            "--workload", default=None, choices=sorted(workloads())),
+        argv=argv)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -126,6 +126,6 @@ def test(time_limit: float = 60, keys=None) -> dict:
                 gen.stagger(1, independent.concurrent_generator(
                     1, ks, lambda k: [gen.once(g)
                                       for g in (ri, cw1, r, cw2, r)])),
-                gen.repeat_gen([gen.sleep(10), {"type": "info", "f": "start"},
-                                gen.sleep(10), {"type": "info", "f": "stop"}]))),
+                gen.cycle([gen.sleep(10), {"type": "info", "f": "start"},
+                           gen.sleep(10), {"type": "info", "f": "stop"}]))),
     }

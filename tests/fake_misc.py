@@ -22,9 +22,10 @@ class _Server(socketserver.ThreadingTCPServer):
 
 class _BaseFake:
     handler: type
+    server_cls: type = _Server
 
     def __init__(self):
-        self._srv = _Server(("127.0.0.1", 0), self.handler)
+        self._srv = self.server_cls(("127.0.0.1", 0), self.handler)
         self._srv.owner = self
         self.port = self._srv.server_address[1]
         self._thread = threading.Thread(
@@ -40,6 +41,10 @@ class _BaseFake:
 
     def __exit__(self, *a):
         self.close()
+
+
+class _BaseHTTPFake(_BaseFake):
+    server_cls = ThreadingHTTPServer
 
 
 # ---------------------------------------------------------------------
@@ -202,27 +207,14 @@ class _ConsulHandler(BaseHTTPRequestHandler):
             self._reply(200, True)
 
 
-class FakeConsulServer:
+class FakeConsulServer(_BaseHTTPFake):
+    handler = _ConsulHandler
+
     def __init__(self):
         self.kv: dict[str, tuple] = {}
         self.index = 0
         self.lock = threading.Lock()
-        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), _ConsulHandler)
-        self._srv.owner = self
-        self.port = self._srv.server_address[1]
-        self._thread = threading.Thread(
-            target=self._srv.serve_forever, daemon=True)
-        self._thread.start()
-
-    def close(self):
-        self._srv.shutdown()
-        self._srv.server_close()
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *a):
-        self.close()
+        super().__init__()
 
 
 # ---------------------------------------------------------------------
@@ -474,5 +466,129 @@ class FakeAMQPServer(_BaseFake):
         self.queues: dict[str, deque] = {}
         self.unacked: dict = {}
         self.next_tag = 1
+        self.lock = threading.Lock()
+        super().__init__()
+
+
+# ---------------------------------------------------------------------
+# Redis-ish (raftis): SET/GET over RESP
+
+
+class _RedisHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        srv = self.server.owner  # type: ignore
+        sock = self.request
+        buf = b""
+
+        def recvn(n):
+            nonlocal buf
+            while len(buf) < n:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            out, rest = buf[:n], buf[n:]
+            buf = rest
+            return out
+
+        def recv_line():
+            nonlocal buf
+            while b"\r\n" not in buf:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            line, buf = buf.split(b"\r\n", 1)
+            return line
+
+        try:
+            while True:
+                line = recv_line()
+                if not line.startswith(b"*"):
+                    raise ConnectionError
+                args = []
+                for _ in range(int(line[1:])):
+                    ln = recv_line()
+                    n = int(ln[1:])
+                    args.append(recvn(n).decode())
+                    recvn(2)
+                cmd = args[0].upper()
+                with srv.lock:
+                    if cmd == "SET":
+                        srv.kv[args[1]] = args[2]
+                        sock.sendall(b"+OK\r\n")
+                    elif cmd == "GET":
+                        v = srv.kv.get(args[1])
+                        if v is None:
+                            sock.sendall(b"$-1\r\n")
+                        else:
+                            b = str(v).encode()
+                            sock.sendall(
+                                b"$%d\r\n%s\r\n" % (len(b), b))
+                    else:
+                        sock.sendall(b"-ERR unknown command\r\n")
+        except ConnectionError:
+            pass
+
+
+class FakeRedisServer(_BaseFake):
+    handler = _RedisHandler
+
+    def __init__(self):
+        self.kv: dict = {}
+        self.lock = threading.Lock()
+        super().__init__()
+
+
+# ---------------------------------------------------------------------
+# Elasticsearch-ish HTTP document store
+
+
+class _ESHandler(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, code, obj):
+        data = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_PUT(self):
+        srv = self.server.owner  # type: ignore
+        path = urlparse(self.path).path
+        parts = path.strip("/").split("/")
+        doc_id = parts[-1]
+        with srv.lock:
+            if "op_type=create" in self.path and doc_id in srv.docs:
+                self._reply(409, {"error": "document already exists"})
+                return
+            n = int(self.headers.get("Content-Length", 0))
+            srv.docs[doc_id] = json.loads(self.rfile.read(n) or b"{}")
+        self._reply(201, {"result": "created"})
+
+    def do_POST(self):
+        srv = self.server.owner  # type: ignore
+        path = urlparse(self.path).path
+        if path.endswith("/_refresh"):
+            self._reply(200, {})
+            return
+        if path.endswith("/_search"):
+            with srv.lock:
+                hits = [{"_id": k, "_source": v}
+                        for k, v in srv.docs.items()]
+            self._reply(200, {"hits": {"total": len(hits),
+                                       "hits": hits}})
+            return
+        self._reply(404, {"error": "no route"})
+
+
+class FakeESServer(_BaseHTTPFake):
+    handler = _ESHandler
+
+    def __init__(self):
+        self.docs: dict = {}
         self.lock = threading.Lock()
         super().__init__()

@@ -1,0 +1,144 @@
+"""In-process fake mongod: OP_MSG + BSON against an in-memory
+collection store, supporting the commands the suite client issues
+(insert/find/update/findAndModify/replSetInitiate)."""
+
+from __future__ import annotations
+
+import socketserver
+import struct
+import threading
+
+from jepsen_tpu.drivers.mongo import decode_doc, encode_doc
+
+OP_MSG = 2013
+
+
+class _MongoHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        srv = self.server.owner  # type: ignore
+        sock = self.request
+        buf = b""
+
+        def recvn(n):
+            nonlocal buf
+            while len(buf) < n:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            out, rest = buf[:n], buf[n:]
+            buf = rest
+            return out
+
+        try:
+            while True:
+                length, req_id, _rto, opcode = struct.unpack(
+                    "<iiii", recvn(16))
+                data = recvn(length - 16)
+                if opcode != OP_MSG:
+                    return
+                cmd, _ = decode_doc(data, 5)
+                reply = self._dispatch(srv, cmd)
+                body = encode_doc(reply)
+                payload = struct.pack("<I", 0) + b"\x00" + body
+                header = struct.pack("<iiii", 16 + len(payload),
+                                     1, req_id, OP_MSG)
+                sock.sendall(header + payload)
+        except ConnectionError:
+            pass
+
+    def _dispatch(self, srv, cmd: dict) -> dict:
+        name = next(iter(cmd))  # the command IS the first key
+        with srv.lock:
+            if name == "insert":
+                coll = srv.colls.setdefault(cmd["insert"], {})
+                for doc in cmd["documents"]:
+                    _id = doc.get("_id")
+                    if _id in coll:
+                        return {"ok": 1.0, "n": 0, "writeErrors": [
+                            {"index": 0, "code": 11000,
+                             "errmsg": "duplicate key"}]}
+                    coll[_id] = doc
+                return {"ok": 1.0, "n": len(cmd["documents"])}
+            if name == "find":
+                coll = srv.colls.get(cmd["find"], {})
+                docs = [d for d in coll.values()
+                        if _matches(d, cmd.get("filter") or {})]
+                return {"ok": 1.0,
+                        "cursor": {"id": 0, "ns": "jepsen",
+                                   "firstBatch": docs}}
+            if name == "update":
+                coll = srv.colls.setdefault(cmd["update"], {})
+                n = 0
+                for u in cmd["updates"]:
+                    matched = [d for d in coll.values()
+                               if _matches(d, u["q"])]
+                    if matched:
+                        for d in matched:
+                            _apply(d, u["u"])
+                            n += 1
+                    elif u.get("upsert"):
+                        doc = dict(u["q"])
+                        _apply(doc, u["u"])
+                        coll[doc.get("_id")] = doc
+                        n += 1
+                return {"ok": 1.0, "n": n}
+            if name == "findAndModify":
+                coll = srv.colls.setdefault(cmd["findAndModify"], {})
+                matched = [d for d in coll.values()
+                           if _matches(d, cmd.get("query") or {})]
+                if not matched:
+                    if cmd.get("upsert"):
+                        doc = dict(cmd.get("query") or {})
+                        _apply(doc, cmd["update"])
+                        coll[doc.get("_id")] = doc
+                        return {"ok": 1.0, "value": doc}
+                    return {"ok": 1.0, "value": None}
+                d = matched[0]
+                _apply(d, cmd["update"])
+                return {"ok": 1.0, "value": d}
+            if name == "replSetInitiate":
+                srv.rs_config = cmd["replSetInitiate"]
+                return {"ok": 1.0}
+            return {"ok": 0.0, "code": 59,
+                    "errmsg": f"no such command: {list(cmd)[0]}"}
+
+
+def _matches(doc: dict, q: dict) -> bool:
+    return all(doc.get(k) == v for k, v in q.items())
+
+
+def _apply(doc: dict, update: dict) -> None:
+    for k, v in update.get("$set", {}).items():
+        doc[k] = v
+    for k, v in update.items():
+        if not k.startswith("$"):
+            doc[k] = v
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class FakeMongoServer:
+    def __init__(self):
+        self.colls: dict[str, dict] = {}
+        self.rs_config = None
+        self.lock = threading.Lock()
+        self._srv = _Server(("127.0.0.1", 0), _MongoHandler)
+        self._srv.owner = self
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()

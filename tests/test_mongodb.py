@@ -1,0 +1,101 @@
+"""MongoDB driver (BSON + OP_MSG) and suite tests against the fake
+mongod."""
+
+from __future__ import annotations
+
+import pytest
+
+from jepsen_tpu import core, independent, net as jnet
+from jepsen_tpu.drivers import DBError, mongo
+from jepsen_tpu.store import Store
+from jepsen_tpu.suites import mongodb, mongodb_rocks, mongodb_smartos
+
+from fake_mongo import FakeMongoServer
+
+
+def test_bson_roundtrip():
+    doc = {"a": 1, "b": "two", "c": [1, 2, {"d": None}],
+           "e": {"f": True, "g": 2 ** 40}, "h": 1.5}
+    enc = mongo.encode_doc(doc)
+    out, off = mongo.decode_doc(enc)
+    assert out == doc
+    assert off == len(enc)
+
+
+def test_driver_insert_find_fam():
+    with FakeMongoServer() as srv:
+        c = mongo.connect("127.0.0.1", srv.port, database="jepsen")
+        c.insert("registers", [{"_id": 1, "value": 5}])
+        assert c.find("registers", {"_id": 1})[0]["value"] == 5
+        reply = c.find_and_modify("registers",
+                                  {"_id": 1, "value": 5},
+                                  {"$set": {"value": 6}})
+        assert reply["value"]["value"] == 6
+        miss = c.find_and_modify("registers",
+                                 {"_id": 1, "value": 5},
+                                 {"$set": {"value": 9}})
+        assert miss["value"] is None
+        with pytest.raises(DBError):
+            c.insert("registers", [{"_id": 1}])   # duplicate key
+        c.close()
+
+
+def hosts_for(srv):
+    return {n: ("127.0.0.1", srv.port)
+            for n in ("n1", "n2", "n3", "n4", "n5")}
+
+
+def test_client_register_cas():
+    with FakeMongoServer() as srv:
+        test = {"db-hosts": hosts_for(srv)}
+        c = mongodb.MongoClient("register").open(test, "n1")
+        kv = independent.tuple_(4, 7)
+        assert c.invoke(test, {"type": "invoke", "f": "write",
+                               "value": kv, "process": 0})["type"] == "ok"
+        r = c.invoke(test, {"type": "invoke", "f": "read",
+                            "value": independent.tuple_(4, None),
+                            "process": 0})
+        assert r["value"].value == 7
+        ok = c.invoke(test, {"type": "invoke", "f": "cas",
+                             "value": independent.tuple_(4, [7, 8]),
+                             "process": 0})
+        assert ok["type"] == "ok"
+        miss = c.invoke(test, {"type": "invoke", "f": "cas",
+                               "value": independent.tuple_(4, [7, 9]),
+                               "process": 0})
+        assert miss["type"] == "fail"
+        c.close(test)
+
+
+@pytest.mark.parametrize("make_test", [
+    mongodb.mongodb_test,
+    lambda o: mongodb_rocks.mongodb_rocks_test(o),
+    lambda o: mongodb_smartos.mongodb_smartos_test(o),
+])
+def test_mongodb_register_end_to_end(tmp_path, make_test):
+    with FakeMongoServer() as srv:
+        test = make_test({
+            "ssh": {"dummy": True}, "time-limit": 1.0,
+            "db-hosts": hosts_for(srv),
+        })
+        for k in ("db", "os", "nemesis"):
+            test.pop(k, None)
+        test["net"] = jnet.noop()
+        test["store"] = Store(tmp_path / "store")
+        test = core.run(test)
+    assert test["results"]["valid?"] is True
+
+
+def test_db_setup_against_dummy_remote():
+    from jepsen_tpu import control
+    test = mongodb.mongodb_test({"ssh": {"dummy": True}})
+    control.on_nodes(test, lambda t, n: t["db"].setup(t, n))
+    cmds = "\n".join(str(p) for _n, kind, p in test["remote"].actions
+                     if kind == "execute")
+    assert "mongod" in cmds
+    # rocks variant selects the rocksdb engine
+    t2 = mongodb_rocks.mongodb_rocks_test({"ssh": {"dummy": True}})
+    control.on_nodes(t2, lambda t, n: t["db"].setup(t, n))
+    cmds2 = "\n".join(str(p) for _n, kind, p in t2["remote"].actions
+                      if kind == "execute")
+    assert "rocksdb" in cmds2

@@ -1,0 +1,82 @@
+"""Second wave of per-DB suites (galera, percona, mysql-cluster, crate,
+elasticsearch, raftis): dummy-remote lifecycle smoke + end-to-end runs
+against the protocol fakes."""
+
+from __future__ import annotations
+
+import pytest
+
+from jepsen_tpu import core, net as jnet
+from jepsen_tpu.store import Store
+from jepsen_tpu.suites import (crate, elasticsearch, galera,
+                               mysql_cluster, percona, raftis)
+
+from fake_misc import FakeESServer, FakeRedisServer
+from fake_sql import FakeMySQLServer, FakePGServer
+
+
+def hosts_for(srv):
+    return {n: ("127.0.0.1", srv.port)
+            for n in ("n1", "n2", "n3", "n4", "n5")}
+
+
+@pytest.mark.parametrize("make_test,needle", [
+    (galera.galera_test, "galera"),
+    (percona.percona_test, "percona"),
+    (mysql_cluster.mysql_cluster_test, "ndb"),
+    (crate.crate_test, "crate"),
+    (elasticsearch.elasticsearch_test, "elasticsearch"),
+    (raftis.raftis_test, "raftis"),
+])
+def test_db_setup_against_dummy_remote(make_test, needle):
+    from jepsen_tpu import control
+    test = make_test({"ssh": {"dummy": True}})
+    control.on_nodes(test, lambda t, n: t["db"].setup(t, n))
+    cmds = "\n".join(str(p) for _n, kind, p in test["remote"].actions
+                     if kind == "execute")
+    assert needle in cmds
+
+
+def run_suite(tmp_path, make_test, srv, opts=None):
+    test = make_test({
+        "ssh": {"dummy": True}, "time-limit": 1.0,
+        "db-hosts": hosts_for(srv), **(opts or {}),
+    })
+    for k in ("db", "os", "nemesis"):
+        test.pop(k, None)
+    test["net"] = jnet.noop()
+    test["store"] = Store(tmp_path / "store")
+    return core.run(test)
+
+
+def test_raftis_register_end_to_end(tmp_path):
+    with FakeRedisServer() as srv:
+        test = run_suite(tmp_path, raftis.raftis_test, srv)
+    assert test["results"]["valid?"] is True
+
+
+def test_elasticsearch_set_end_to_end(tmp_path):
+    with FakeESServer() as srv:
+        test = run_suite(tmp_path, elasticsearch.elasticsearch_test, srv)
+    r = test["results"]
+    assert r["valid?"] is True, r
+    assert r["set"]["ok-count"] > 10
+
+
+def test_crate_register_end_to_end(tmp_path):
+    with FakePGServer() as srv:
+        test = run_suite(tmp_path, crate.crate_test, srv,
+                         {"workload": "register"})
+    assert test["results"]["valid?"] is True
+
+
+@pytest.mark.parametrize("make_test", [
+    galera.galera_test, percona.percona_test,
+    mysql_cluster.mysql_cluster_test,
+])
+def test_mysql_family_bank_end_to_end(tmp_path, make_test):
+    with FakeMySQLServer() as srv:
+        test = run_suite(tmp_path, make_test, srv, {"workload": "bank"})
+    r = test["results"]
+    assert r["valid?"] is True, r
+    assert r["read-count"] > 0

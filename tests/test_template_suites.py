@@ -1,0 +1,74 @@
+"""Final wave of per-DB suites (rethinkdb, aerospike, hazelcast,
+ignite, chronos, robustirc, logcabin, faunadb, charybdefs): dummy-remote
+lifecycle smoke tests and full dummy runs where the client needs only
+the control plane."""
+
+from __future__ import annotations
+
+import pytest
+
+from jepsen_tpu import core, net as jnet
+from jepsen_tpu.store import Store
+from jepsen_tpu.suites import (aerospike, charybdefs, chronos, faunadb,
+                               hazelcast, ignite, logcabin, rethinkdb,
+                               robustirc)
+
+ALL = (aerospike, charybdefs, chronos, faunadb, hazelcast, ignite,
+       logcabin, rethinkdb, robustirc)
+
+
+@pytest.mark.parametrize("make_test,needle", [
+    (rethinkdb.rethinkdb_test, "rethinkdb"),
+    (aerospike.aerospike_test, "aerospike"),
+    (hazelcast.hazelcast_test, "hazelcast"),
+    (ignite.ignite_test, "ignite"),
+    (chronos.chronos_test, "chronos"),
+    (robustirc.robustirc_test, "robustirc"),
+    (logcabin.logcabin_test, "logcabin"),
+    (faunadb.faunadb_test, "faunadb"),
+    (charybdefs.charybdefs_test, "faultfs"),
+])
+def test_db_setup_against_dummy_remote(make_test, needle):
+    from jepsen_tpu import control
+    test = make_test({"ssh": {"dummy": True}})
+    control.on_nodes(test, lambda t, n: t["db"].setup(t, n))
+    cmds = "\n".join(str(p) for _n, kind, p in test["remote"].actions
+                     if kind in ("execute", "upload"))
+    assert needle in cmds
+
+
+def test_every_suite_has_cli_and_workloads():
+    for mod in ALL:
+        assert callable(mod.main)
+        assert mod.workloads(), mod.__name__
+
+
+def test_charybdefs_full_dummy_run(tmp_path):
+    """The charybdefs suite runs end-to-end against the dummy remote:
+    faultfs install + mounts + fault flips all ride the control plane,
+    so the whole lifecycle exercises without a cluster."""
+    test = charybdefs.charybdefs_test({
+        "ssh": {"dummy": True}, "time-limit": 1.0,
+        "nodes": ["n1", "n2", "n3"], "concurrency": 3,
+    })
+    test["net"] = jnet.noop()
+    test["store"] = Store(tmp_path / "store")
+    test = core.run(test)
+    r = test["results"]
+    assert r["valid?"] is True, r
+    assert r["stats"]["count"] > 0
+    # the nemesis actually flipped faults through the ctl file
+    cmds = "\n".join(str(p) for _n, kind, p in test["remote"].actions
+                     if kind == "execute")
+    assert ".faultfs-ctl" in cmds
+
+
+def test_suite_registry_loads_every_module():
+    from jepsen_tpu import suites
+    assert len(suites.SUITES) == 28   # 27 reference suites + mongodb core
+    for name in suites.SUITES:
+        mod = suites.load_suite(name)
+        assert callable(mod.main), name
+        assert callable(mod.workloads), name
+    with pytest.raises(ValueError):
+        suites.load_suite("nope")
