@@ -118,7 +118,18 @@ class AppendChecker(Checker):
                 else cycle_anomalies_cpu)
         cycles = find(enc, realtime=self.realtime,
                       process_order=self.process_order)
-        return render_verdict(enc, cycles, self.prohibited)
+        from . import artifacts
+        divergent: list = []
+        if self.backend == "tpu" and cycles:
+            # Device path returns anomaly FLAGS; flagged histories run
+            # the host pass for witness cycles (rare positives — the
+            # fast path stays on device).
+            cycles, divergent = artifacts.device_host_refine(
+                cycles, lambda: cycle_anomalies_cpu(
+                    enc, realtime=self.realtime,
+                    process_order=self.process_order))
+        verdict = render_verdict(enc, cycles, self.prohibited)
+        return artifacts.attach(verdict, divergent, test, opts)
 
 
 def append_checker(anomalies: Iterable[str] = ("G1", "G2"),

@@ -432,7 +432,15 @@ class WrChecker(Checker):
                 else cycle_anomalies_cpu)
         cycles = find(enc, realtime=self.realtime,
                       process_order=self.process_order)
-        return render_wr_verdict(enc, cycles, self.prohibited)
+        from . import artifacts
+        divergent: list = []
+        if self.backend == "tpu" and cycles:
+            cycles, divergent = artifacts.device_host_refine(
+                cycles, lambda: cycle_anomalies_cpu(
+                    enc, realtime=self.realtime,
+                    process_order=self.process_order))
+        verdict = render_wr_verdict(enc, cycles, self.prohibited)
+        return artifacts.attach(verdict, divergent, test, opts)
 
 
 def rw_register_checker(anomalies: Iterable[str] = ("G2", "G1a", "G1b",

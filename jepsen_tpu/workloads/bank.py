@@ -59,6 +59,59 @@ class BankChecker(Checker):
                 "bad-read-count": len(bad_reads)}
 
 
+class BankPlot(Checker):
+    """Renders bank.png: every account's balance over time from the ok
+    reads, with nemesis shading — the reference's balance plot
+    (bank.clj:160-186, drawn through perf/plot!). Always valid; the
+    plot is the artifact."""
+
+    def __init__(self, nemeses=None):
+        self.nemeses = nemeses
+
+    def check(self, test, history, opts):
+        from ..checker import perf
+
+        path = perf._store_path(test, opts, "bank.png")
+        if path is None:
+            return {"valid?": True}
+        series: dict = {}
+        times: dict = {}
+        for op in history:
+            if op.get("type") != "ok" or op.get("f") != "read":
+                continue
+            t = perf.nanos_to_secs(op.get("time", 0))
+            for acct, bal in (op.get("value") or {}).items():
+                series.setdefault(acct, []).append(bal)
+                times.setdefault(acct, []).append(t)
+        if not series:
+            return {"valid?": True, "plot": None}
+        # OO matplotlib API, not pyplot: compose() runs checkers
+        # concurrently and pyplot's global figure registry is not
+        # thread-safe (same reason as perf._fig).
+        from matplotlib.backends.backend_agg import FigureCanvasAgg
+        from matplotlib.figure import Figure
+
+        fig = Figure(figsize=(10, 6))
+        FigureCanvasAgg(fig)
+        ax = fig.add_subplot(111)
+        for acct in sorted(series, key=repr):
+            ax.plot(times[acct], series[acct], lw=1,
+                    label=f"account {acct}")
+        nemeses = self.nemeses or (test.get("plot") or {}).get("nemeses")
+        perf._draw_nemeses(ax, history, nemeses, perf._t_max(history))
+        ax.set_xlabel("time (s)")
+        ax.set_ylabel("balance")
+        ax.set_title(test.get("name", "bank"))
+        ax.legend(loc="upper right", fontsize="small")
+        ax.grid(True, alpha=0.3)
+        fig.savefig(path, dpi=100)
+        return {"valid?": True, "plot": str(path)}
+
+
+def plot_checker(nemeses=None) -> Checker:
+    return BankPlot(nemeses)
+
+
 def checker(**kw) -> Checker:
     return BankChecker(**kw)
 
@@ -79,10 +132,18 @@ def generator(accounts=None, max_transfer=DEFAULT_MAX_TRANSFER):
 
 
 def test(accounts=None, total=DEFAULT_TOTAL,
-         max_transfer=DEFAULT_MAX_TRANSFER, **kw) -> dict:
+         max_transfer=DEFAULT_MAX_TRANSFER, plot: bool = True,
+         nemeses=None, **kw) -> dict:
+    """Partial test map; the checker composes the balance invariant
+    with the balance-over-time plot (bank.clj:188-201)."""
+    from ..checker import compose
+
     accounts = accounts or DEFAULT_ACCOUNTS
+    balance = checker(total=total, **kw)
     return {"generator": generator(accounts, max_transfer),
-            "checker": checker(total=total, **kw),
+            "checker": compose({"bank": balance,
+                                "plot": plot_checker(nemeses)}) if plot
+            else balance,
             "accounts": accounts,
             "total-amount": total,
             "max-transfer": max_transfer}

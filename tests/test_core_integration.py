@@ -142,7 +142,7 @@ def test_bank_workload_catches_broken_bank(tmp_path):
     )
     test = core.run(test)
     assert test["results"]["valid?"] is False
-    assert test["results"]["bad-read-count"] > 0
+    assert test["results"]["bank"]["bad-read-count"] > 0
 
 
 def test_set_workload(tmp_path):

@@ -79,4 +79,4 @@ def test_mysql_family_bank_end_to_end(tmp_path, make_test):
         test = run_suite(tmp_path, make_test, srv, {"workload": "bank"})
     r = test["results"]
     assert r["valid?"] is True, r
-    assert r["read-count"] > 0
+    assert r["bank"]["read-count"] > 0

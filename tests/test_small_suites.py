@@ -151,4 +151,4 @@ def test_postgres_rds_end_to_end(tmp_path):
                          {"workload": "bank"})
     r = test["results"]
     assert r["valid?"] is True, r
-    assert r["read-count"] > 0
+    assert r["bank"]["read-count"] > 0

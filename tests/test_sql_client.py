@@ -218,7 +218,7 @@ def test_cockroach_bank_end_to_end(tmp_path):
         test = run_suite(tmp_path, cockroach.cockroach_test, srv, "bank")
     r = test["results"]
     assert r["valid?"] is True, r
-    assert r["read-count"] > 0
+    assert r["bank"]["read-count"] > 0
 
 
 def test_tidb_append_end_to_end(tmp_path):

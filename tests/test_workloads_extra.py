@@ -312,3 +312,27 @@ def test_long_fork_full_run(tmp_path):
     res = test["results"]
     assert res["valid?"] is True
     assert res["reads-count"] > 0
+
+
+def test_bank_plot_renders(tmp_path):
+    """Balance-over-time plot (bank.clj:160-186): ok reads become
+    per-account series in bank.png."""
+    from jepsen_tpu.store import Store
+    from jepsen_tpu.workloads import bank as bank_wl
+
+    hist = []
+    bal = {0: 60, 1: 40}
+    for i in range(5):
+        bal = {0: bal[0] - 5, 1: bal[1] + 5}
+        hist.append({"type": "invoke", "f": "read", "process": 0,
+                     "time": i * 10**9, "index": 2 * i})
+        hist.append({"type": "ok", "f": "read", "process": 0,
+                     "value": dict(bal), "time": i * 10**9 + 100,
+                     "index": 2 * i + 1})
+    test = {"name": "bank-plot", "start-time": "t0",
+            "store": Store(tmp_path / "store")}
+    r = bank_wl.plot_checker().check(test, hist, {})
+    assert r["valid?"] is True
+    from pathlib import Path
+    assert Path(r["plot"]).exists()
+    assert Path(r["plot"]).name == "bank.png"
