@@ -119,7 +119,7 @@ class AppendChecker(Checker):
         cycles = find(enc, realtime=self.realtime,
                       process_order=self.process_order)
         from . import artifacts
-        divergent: list = []
+        divergent: dict = {}
         if self.backend == "tpu" and cycles:
             # Device path returns anomaly FLAGS; flagged histories run
             # the host pass for witness cycles (rare positives — the

@@ -74,28 +74,37 @@ def store_dir(test: dict, opts: dict | None) -> Path | None:
     """The elle/ directory for this (possibly independent-keyed) check,
     or None when the test has no store. Shares perf's
     subdirectory-resolution rule so per-key layouts can't drift."""
-    from ..perf import _store_path
-    return _store_path(test, opts or {}, "elle")
+    from ..perf import store_path
+    return store_path(test, opts or {}, "elle")
 
 
 def device_host_refine(device_cycles: dict,
-                       host_fn: Callable[[], dict]) -> tuple[dict, list]:
-    """Turn device anomaly FLAGS into host witness cycles. The parity
-    contract is device-flagged => host-witnessed; a device flag the
-    host pass can't reproduce is NOT silently dropped — it stays in the
-    result (flag-only) and is reported as a divergence, since it means
-    one of the two paths is wrong."""
+                       host_fn: Callable[[], dict]) -> tuple[dict, dict]:
+    """Turn device anomaly FLAGS into host witness cycles. Parity runs
+    both ways (SURVEY.md §4.3): a device flag the host can't reproduce
+    stays in the result (flag-only), and an anomaly the host finds that
+    the device missed is equally a divergence — both are reported,
+    since either direction means one of the two paths is wrong."""
     host = host_fn()
-    divergent = sorted(set(device_cycles) - set(host))
+    device_only = sorted(set(device_cycles) - set(host))
+    host_only = sorted(set(host) - set(device_cycles))
     merged = dict(host)
-    for name in divergent:
+    for name in device_only:
         log.warning("device flagged %s but host pass found no witness "
                     "— keeping the flag (kernel/host divergence?)", name)
         merged[name] = True
-    return merged, divergent
+    for name in host_only:
+        log.warning("host pass found %s the device did not flag "
+                    "(kernel false negative?)", name)
+    divergence = {}
+    if device_only:
+        divergence["device-only"] = device_only
+    if host_only:
+        divergence["host-only"] = host_only
+    return merged, divergence
 
 
-def attach(verdict: dict, divergent: list, test: dict,
+def attach(verdict: dict, divergent: dict | list, test: dict,
            opts: dict | None) -> dict:
     """Record divergences and write the elle/ artifacts for any
     anomalies in the verdict."""

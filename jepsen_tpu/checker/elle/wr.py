@@ -433,7 +433,7 @@ class WrChecker(Checker):
         cycles = find(enc, realtime=self.realtime,
                       process_order=self.process_order)
         from . import artifacts
-        divergent: list = []
+        divergent: dict = {}
         if self.backend == "tpu" and cycles:
             cycles, divergent = artifacts.device_host_refine(
                 cycles, lambda: cycle_anomalies_cpu(

@@ -211,6 +211,15 @@ def _t_max(history) -> float:
     return max((nanos_to_secs(o.get("time")) for o in history), default=1.0)
 
 
+# Public plotting surface for other checkers (e.g. the bank balance
+# plot): one figure/legend/nemesis-shading implementation, one
+# subdirectory-resolution rule.
+fig_ax = _fig
+finish = _finish
+t_max = _t_max
+draw_nemeses = _draw_nemeses
+
+
 def point_graph(test: dict, history: Sequence[dict], path,
                 nemeses=None) -> bool:
     """latency-raw.png: every completed invocation as a point, colored by
@@ -316,6 +325,9 @@ def _store_path(test: dict, opts: dict, filename: str):
     sub = (opts or {}).get("subdirectory")
     parts = [sub] if isinstance(sub, str) else list(sub or [])
     return store.path(test, *[str(p) for p in parts], filename)
+
+
+store_path = _store_path
 
 
 class LatencyGraph(Checker):

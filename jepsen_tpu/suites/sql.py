@@ -119,15 +119,19 @@ class PGDialect(Dialect):
 
 
 class MySQLDialect(Dialect):
-    """TiDB (mysql protocol, root/no password by default)."""
+    """TiDB (mysql protocol, root/no password by default).
+    `session_stmts` run once per connection — the hook tidb's
+    option sweeps use for `SET @@tidb_...` knobs (tidb/sql.clj)."""
 
     name = "mysql"
 
     def __init__(self, port: int = 4000, user: str = "root",
                  database: str = "test", password: str = "",
-                 timeout: float = 10.0):
+                 timeout: float = 10.0,
+                 session_stmts: list[str] | None = None):
         self.port, self.user, self.database = port, user, database
         self.password, self.timeout = password, timeout
+        self.session_stmts = list(session_stmts or [])
 
     def connect(self, node: str, test: dict):
         from ..drivers import mysql_wire
@@ -162,12 +166,17 @@ class SQLClient(jclient.Client):
 
     def __init__(self, dialect: Dialect, mode: str = "register",
                  accounts: list | None = None, total: int = 100,
-                 node: str | None = None):
+                 node: str | None = None,
+                 sql_opts: dict | None = None):
         self.dialect = dialect
         self.mode = mode
         self.accounts = accounts if accounts is not None else list(range(8))
         self.total = total
         self.node = node
+        # Workload-option knobs (tidb/core.clj:47-79 sweeps these):
+        #   read_lock:        None | "FOR UPDATE" (suffix on txn reads)
+        #   update_in_place:  bank transfers use server-side arithmetic
+        self.sql_opts = dict(sql_opts or {})
         self.conn = None
         self._setup_done = False
 
@@ -175,7 +184,11 @@ class SQLClient(jclient.Client):
 
     def open(self, test, node):
         return SQLClient(self.dialect, self.mode, self.accounts,
-                         self.total, node)
+                         self.total, node, self.sql_opts)
+
+    def _lock(self) -> str:
+        rl = self.sql_opts.get("read_lock")
+        return f" {rl}" if rl else ""
 
     def setup(self, test):
         pass  # schema created lazily on first invoke (first conn wins)
@@ -183,6 +196,8 @@ class SQLClient(jclient.Client):
     def _ensure_conn(self, test):
         if self.conn is None:
             self.conn = self.dialect.connect(self.node, test or {})
+            for stmt in getattr(self.dialect, "session_stmts", ()):
+                self.conn.query(stmt)
         if not self._setup_done:
             for stmt in self.dialect.setup_stmts():
                 self.conn.query(stmt)
@@ -280,7 +295,8 @@ class SQLClient(jclient.Client):
             c.query(d.begin())
             try:
                 rows = _rows(c.query(
-                    f"SELECT val FROM registers WHERE id = {int(k)}"))
+                    f"SELECT val FROM registers WHERE id = {int(k)}"
+                    f"{self._lock()}"))
                 cur = int(rows[0][0]) if rows and rows[0][0] is not None \
                     else None
                 if cur != old:
@@ -318,13 +334,15 @@ class SQLClient(jclient.Client):
                     out.append([mf, mk, mv])
                 elif mf == "r" and self.mode == "append":
                     rows = _rows(c.query(
-                        f"SELECT val FROM lists WHERE id = {int(mk)}"))
+                        f"SELECT val FROM lists WHERE id = {int(mk)}"
+                        f"{self._lock()}"))
                     txt = rows[0][0] if rows else None
                     vals = [int(x) for x in txt.split(",")] if txt else []
                     out.append([mf, mk, vals])
                 elif mf == "r":
                     rows = _rows(c.query(
-                        f"SELECT val FROM registers WHERE id = {int(mk)}"))
+                        f"SELECT val FROM registers WHERE id = {int(mk)}"
+                        f"{self._lock()}"))
                     rv = int(rows[0][0]) if rows and rows[0][0] is not None \
                         else None
                     out.append([mf, mk, rv])
@@ -358,15 +376,29 @@ class SQLClient(jclient.Client):
             c.query(d.begin())
             try:
                 rows = _rows(c.query(
-                    f"SELECT balance FROM accounts WHERE id = {frm}"))
+                    f"SELECT balance FROM accounts WHERE id = {frm}"
+                    f"{self._lock()}"))
                 bal = int(rows[0][0]) if rows else 0
                 if bal < amt:
                     c.query(d.rollback())
                     return {**op, "type": "fail", "error": "insufficient"}
-                c.query(f"UPDATE accounts SET balance = balance - {amt} "
-                        f"WHERE id = {frm}")
-                c.query(f"UPDATE accounts SET balance = balance + {amt} "
-                        f"WHERE id = {to}")
+                if self.sql_opts.get("update_in_place", True):
+                    # server-side arithmetic (tidb's update-in-place)
+                    c.query(f"UPDATE accounts SET balance = "
+                            f"balance - {amt} WHERE id = {frm}")
+                    c.query(f"UPDATE accounts SET balance = "
+                            f"balance + {amt} WHERE id = {to}")
+                else:
+                    # client-computed writes: read both, write both —
+                    # the lost-update-prone shape the sweep contrasts
+                    rows2 = _rows(c.query(
+                        f"SELECT balance FROM accounts WHERE id = {to}"
+                        f"{self._lock()}"))
+                    bal2 = int(rows2[0][0]) if rows2 else 0
+                    c.query(f"UPDATE accounts SET balance = {bal - amt} "
+                            f"WHERE id = {frm}")
+                    c.query(f"UPDATE accounts SET balance = "
+                            f"{bal2 + amt} WHERE id = {to}")
                 c.query(d.commit())
             except DBError:
                 self._try_rollback()
@@ -473,4 +505,5 @@ def client_for(dialect: Dialect, workload: str, opts: dict | None = None
     opts = opts or {}
     return SQLClient(dialect, MODES.get(workload, "register"),
                      accounts=opts.get("accounts"),
-                     total=opts.get("total-amount", 100))
+                     total=opts.get("total-amount", 100),
+                     sql_opts=opts.get("sql-opts"))

@@ -77,37 +77,136 @@ def workloads(opts: dict | None = None) -> dict:
              "sequential", "monotonic")}
 
 
+#: Per-workload option sweeps (tidb/core.clj:47-79 workload-options):
+#: each option maps to every value the sweep should try.
+WORKLOAD_OPTIONS: dict[str, dict[str, list]] = {
+    "append":     {"auto-retry": [True, False],
+                   "auto-retry-limit": [10, 0],
+                   "read-lock": [None, "FOR UPDATE"]},
+    "bank":       {"auto-retry": [True, False],
+                   "auto-retry-limit": [10, 0],
+                   "update-in-place": [True, False],
+                   "read-lock": [None, "FOR UPDATE"]},
+    "long-fork":  {"auto-retry": [True, False],
+                   "auto-retry-limit": [10, 0]},
+    "monotonic":  {"auto-retry": [True, False],
+                   "auto-retry-limit": [10, 0]},
+    "register":   {"auto-retry": [True, False],
+                   "auto-retry-limit": [10, 0],
+                   "read-lock": [None, "FOR UPDATE"]},
+    "wr":         {"auto-retry": [True, False],
+                   "auto-retry-limit": [10, 0],
+                   "read-lock": [None, "FOR UPDATE"]},
+    "set":        {"auto-retry": [True, False],
+                   "auto-retry-limit": [10, 0]},
+    "sequential": {"auto-retry": [True, False],
+                   "auto-retry-limit": [10, 0]},
+}
+
+
+def expected_to_pass_options() -> dict:
+    """Sweep restricted to combos expected valid: auto-retry off
+    (tidb/core.clj:81-86 workload-options-expected-to-pass)."""
+    return {w: {**o, "auto-retry": [False], "auto-retry-limit": [0]}
+            for w, o in WORKLOAD_OPTIONS.items()}
+
+
+def quick_options() -> dict:
+    """One representative combo per workload: defaults only, no read
+    locks (tidb/core.clj:88-105 quick-workload-options). update-in-place
+    stays True — the safe server-side-arithmetic default; False is the
+    deliberately lost-update-prone sweep variant."""
+    return {w: {k: [None] if k == "read-lock"
+                else [True] if k == "update-in-place"
+                else [v[0]]
+                for k, v in o.items()}
+            for w, o in WORKLOAD_OPTIONS.items()}
+
+
+def option_combos(options: dict[str, list]) -> list[dict]:
+    """Cartesian product of one workload's option values."""
+    import itertools
+    keys = sorted(options)
+    return [dict(zip(keys, vals))
+            for vals in itertools.product(*(options[k] for k in keys))]
+
+
+def _session_stmts(combo: dict) -> list[str]:
+    """The tidb session knobs an option combo sets on every connection
+    (tidb/sql.clj's set-session-variables)."""
+    stmts = []
+    if "auto-retry" in combo:
+        on = bool(combo["auto-retry"])
+        stmts.append(f"SET @@tidb_disable_txn_auto_retry = "
+                     f"{0 if on else 1}")
+    if combo.get("auto-retry-limit") is not None:
+        stmts.append(f"SET @@tidb_retry_limit = "
+                     f"{int(combo['auto-retry-limit'])}")
+    return stmts
+
+
 def default_client(workload: str, opts: dict):
     """mysql-protocol client on tidb-server's port (the reference
-    drives tidb through jdbc/mysql, tidb/src/tidb/sql.clj)."""
-    return sql.client_for(
-        sql.MySQLDialect(port=4000, user="root", database="test"),
-        workload, opts)
+    drives tidb through jdbc/mysql, tidb/src/tidb/sql.clj). Workload
+    options become session variables + client knobs."""
+    combo = opts.get("workload-options") or {}
+    dialect = sql.MySQLDialect(port=4000, user="root", database="test",
+                               session_stmts=_session_stmts(combo))
+    sql_opts = {"read_lock": combo.get("read-lock"),
+                "update_in_place": combo.get("update-in-place", True)}
+    return sql.client_for(dialect, workload,
+                          {**opts, "sql-opts": sql_opts})
 
 
 def tidb_test(opts: dict | None = None) -> dict:
     opts = base_opts(**(opts or {}))
     wname = opts.get("workload", "append")
-    return suite_test(
+    test = suite_test(
         "tidb", wname, opts, workloads(opts),
         db=TiDB(opts.get("version", VERSION)),
         client=opts.get("client") or default_client(wname, opts),
         nemesis=jnemesis.partition_random_halves(),
         os_setup=os_setup.debian())
+    combo = opts.get("workload-options")
+    if combo:
+        flavor = " ".join(f"{k}={v}" for k, v in sorted(combo.items()))
+        test["name"] = f"tidb {wname} {flavor}"
+        test["workload-options"] = combo
+    return test
+
+
+def all_tests(opts: dict | None = None, tier: str = "full") -> list[dict]:
+    """The workload x option sweep (tidb/core.clj's test-all): tier
+    "full" | "expected" | "quick" picks the option matrix."""
+    opts = dict(opts or {})
+    matrix = {"full": WORKLOAD_OPTIONS,
+              "expected": expected_to_pass_options(),
+              "quick": quick_options()}[tier]
+    wanted = ([opts["workload"]] if opts.get("workload")
+              else sorted(workloads()))
+    return [tidb_test({**opts, "workload": w, "workload-options": combo})
+            for w in wanted
+            for combo in option_combos(matrix.get(w, {}))]
 
 
 def main(argv=None) -> int:
     from . import resolve_workload
+
+    def opt_fn(p):
+        p.add_argument("--workload", default=None,
+                       choices=sorted(workloads()))
+        p.add_argument("--sweep", default="quick",
+                       choices=("full", "expected", "quick"),
+                       help="option-matrix tier for test-all")
+
     return jcli.run_cli(
         lambda tmap, args: tidb_test(
             {**tmap, "workload": resolve_workload(args, tmap, "append")}),
         name="tidb",
-        opt_fn=lambda p: p.add_argument(
-            "--workload", default=None, choices=sorted(workloads())),
-        tests_fn=lambda tmap, args: [
-            tidb_test({**tmap, "workload": w})
-            for w in ([args.workload] if getattr(
-                args, "workload", None) else sorted(workloads()))],
+        opt_fn=opt_fn,
+        tests_fn=lambda tmap, args: all_tests(
+            {**tmap, "workload": getattr(args, "workload", None)},
+            tier=getattr(args, "sweep", "quick")),
         argv=argv)
 
 

@@ -71,7 +71,7 @@ class BankPlot(Checker):
     def check(self, test, history, opts):
         from ..checker import perf
 
-        path = perf._store_path(test, opts, "bank.png")
+        path = perf.store_path(test, opts, "bank.png")
         if path is None:
             return {"valid?": True}
         series: dict = {}
@@ -85,26 +85,15 @@ class BankPlot(Checker):
                 times.setdefault(acct, []).append(t)
         if not series:
             return {"valid?": True, "plot": None}
-        # OO matplotlib API, not pyplot: compose() runs checkers
-        # concurrently and pyplot's global figure registry is not
-        # thread-safe (same reason as perf._fig).
-        from matplotlib.backends.backend_agg import FigureCanvasAgg
-        from matplotlib.figure import Figure
-
-        fig = Figure(figsize=(10, 6))
-        FigureCanvasAgg(fig)
-        ax = fig.add_subplot(111)
+        fig, ax = perf.fig_ax(test.get("name", "bank"), "balance",
+                              logy=False)
         for acct in sorted(series, key=repr):
             ax.plot(times[acct], series[acct], lw=1,
                     label=f"account {acct}")
         nemeses = self.nemeses or (test.get("plot") or {}).get("nemeses")
-        perf._draw_nemeses(ax, history, nemeses, perf._t_max(history))
-        ax.set_xlabel("time (s)")
-        ax.set_ylabel("balance")
-        ax.set_title(test.get("name", "bank"))
-        ax.legend(loc="upper right", fontsize="small")
+        perf.draw_nemeses(ax, history, nemeses, perf.t_max(history))
         ax.grid(True, alpha=0.3)
-        fig.savefig(path, dpi=100)
+        perf.finish(fig, ax, path)
         return {"valid?": True, "plot": str(path)}
 
 

@@ -35,6 +35,7 @@ class MiniDB:
 
     def __init__(self):
         self.tables: dict = {}
+        self.session_sets: list[str] = []   # SET stmts seen (tidb knobs)
         self.lock = threading.RLock()
 
     def create(self, name: str, cols: list[str], pk: list[str]):
@@ -74,6 +75,9 @@ class MiniDB:
             return [], [], "ROLLBACK"
         if u == "SELECT 1":
             return ["?column?"], [["1"]], "SELECT 1"
+        if u.startswith("SET "):
+            self.session_sets.append(sql)
+            return [], [], "SET"
         m = self._re_create.match(sql)
         if m:
             name, body = m.group(1).lower(), m.group(2)

@@ -117,6 +117,14 @@ def test_device_flag_without_host_witness_is_kept():
     merged, divergent = artifacts.device_host_refine(
         {"G1c": True, "G0": True},
         lambda: {"G1c": [{"cycle-txns": [1, 2, 1]}]})
-    assert divergent == ["G0"]
+    assert divergent == {"device-only": ["G0"]}
     assert merged["G0"] is True                      # flag kept
     assert isinstance(merged["G1c"], list)           # witness kept
+
+
+def test_host_only_anomaly_is_reported_as_divergence():
+    merged, divergent = artifacts.device_host_refine(
+        {"G1c": True},
+        lambda: {"G1c": [{"cycle-txns": [1, 2]}], "G0": True})
+    assert divergent == {"host-only": ["G0"]}
+    assert merged["G0"] is True

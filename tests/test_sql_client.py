@@ -227,3 +227,59 @@ def test_tidb_append_end_to_end(tmp_path):
     r = test["results"]
     assert r["valid?"] is True, r.get("anomaly-types")
     assert r["txn-count"] > 10
+
+
+# ---------------------------------------------------------------------
+# tidb workload-option sweeps (tidb/core.clj:47-105)
+
+
+def test_tidb_option_matrix_shapes():
+    from jepsen_tpu.suites import tidb as t
+
+    full = t.all_tests(tier="full")
+    expected = t.all_tests(tier="expected")
+    quick = t.all_tests(tier="quick")
+    # full: per-workload cartesian products
+    want_full = sum(
+        len(t.option_combos(t.WORKLOAD_OPTIONS[w]))
+        for w in t.workloads())
+    assert len(full) == want_full
+    # expected-to-pass pins auto-retry off
+    assert all(tm["workload-options"]["auto-retry"] is False
+               for tm in expected)
+    # quick: exactly one combo per workload
+    assert len(quick) == len(t.workloads())
+    # distinct names for distinct combos
+    assert len({tm["name"] for tm in full}) == len(full)
+
+
+def test_tidb_options_reach_the_wire(tmp_path):
+    """read-lock & session knobs must show up in the SQL stream."""
+    from jepsen_tpu.suites import tidb as t
+
+    with FakeMySQLServer() as srv:
+        test = run_suite(
+            tmp_path, t.tidb_test, srv, "register",
+            extra={"workload-options": {
+                "auto-retry": False, "auto-retry-limit": 0,
+                "read-lock": "FOR UPDATE"}})
+        db = srv.db
+    assert test["results"]["valid?"] is True
+    assert any("tidb_disable_txn_auto_retry = 1" in s
+               for s in db.session_sets)
+    assert any("tidb_retry_limit = 0" in s for s in db.session_sets)
+
+
+def test_bank_update_in_place_off(tmp_path):
+    """The client-computed-writes variant still conserves the total on
+    a serializable store."""
+    with FakeMySQLServer() as srv:
+        test = run_suite(
+            tmp_path, __import__("jepsen_tpu.suites.tidb",
+                                 fromlist=["tidb"]).tidb_test,
+            srv, "bank",
+            extra={"workload-options": {"update-in-place": False,
+                                        "read-lock": "FOR UPDATE"}})
+    r = test["results"]
+    assert r["valid?"] is True, r
+    assert r["bank"]["read-count"] > 0
