@@ -182,7 +182,7 @@ def main() -> int:
 
     devices = default_devices()
     n_dev = len(devices)
-    reps = int(os.environ.get("BENCH_REPS", 3))
+    reps = int(os.environ.get("BENCH_REPS", 5))
 
     out = bench_elle(n_dev, devices, reps)
     try:

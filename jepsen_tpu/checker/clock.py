@@ -11,7 +11,7 @@ from __future__ import annotations
 from typing import Sequence
 
 from . import Checker
-from .perf import _draw_nemeses, _fig, _finish, _store_path, nanos_to_secs
+from .perf import draw_nemeses, fig_ax, finish, store_path, nanos_to_secs
 
 
 def history_to_datasets(history: Sequence[dict]) -> dict:
@@ -55,15 +55,15 @@ def plot(test: dict, history: Sequence[dict], path,
         return False
     nodes = sorted(datasets, key=str)
     names = short_node_names(nodes)
-    fig, ax = _fig(f"{test.get('name', '')} clock skew", "Skew (s)", False)
+    fig, ax = fig_ax(f"{test.get('name', '')} clock skew", "Skew (s)", False)
     for node, name in zip(nodes, names):
         pts = datasets[node]
         ax.step([p[0] for p in pts], [p[1] for p in pts], where="post",
                 label=name)
     final_t = max((nanos_to_secs(o.get("time")) for o in history),
                   default=1.0)
-    _draw_nemeses(ax, history, nemeses, final_t)
-    _finish(fig, ax, path)
+    draw_nemeses(ax, history, nemeses, final_t)
+    finish(fig, ax, path)
     return True
 
 
@@ -71,7 +71,7 @@ class ClockPlot(Checker):
     """Checker wrapper (checker.clj:831-837)."""
 
     def check(self, test, history, opts):
-        p = _store_path(test, opts or {}, "clock-skew.png")
+        p = store_path(test, opts or {}, "clock-skew.png")
         if p is not None and history:
             plot(test, history, p,
                  (test.get("plot") or {}).get("nemeses"))

@@ -135,8 +135,8 @@ def render_svg(analysis: dict, history: Sequence[dict]) -> str:
 def render_analysis(test: dict, analysis: dict,
                     history: Sequence[dict], opts: dict | None = None):
     """Write linear.svg into the store; returns the path or None."""
-    from .perf import _store_path
-    p = _store_path(test, opts or {}, "linear.svg")
+    from .perf import store_path
+    p = store_path(test, opts or {}, "linear.svg")
     if p is None:
         return None
     p.write_text(render_svg(analysis, history))

@@ -154,7 +154,7 @@ def nemesis_activity(nemeses: Sequence[dict] | None,
     return out
 
 
-def _draw_nemeses(ax, history, nemeses, t_max: float) -> None:
+def draw_nemeses(ax, history, nemeses, t_max: float) -> None:
     """Shade activity intervals and draw event lines, one horizontal band
     per nemesis from the top of the axes (perf.clj:242-296)."""
     acts = nemesis_activity(nemeses, history)
@@ -182,7 +182,7 @@ def _draw_nemeses(ax, history, nemeses, t_max: float) -> None:
 # Renderers
 # ---------------------------------------------------------------------------
 
-def _fig(title: str, ylabel: str, logy: bool):
+def fig_ax(title: str, ylabel: str, logy: bool):
     # The OO API (Figure + Agg canvas), NOT pyplot: checkers render
     # concurrently (Compose.real_pmap, independent's bounded_pmap) and
     # pyplot's global figure registry is not thread-safe.
@@ -199,7 +199,7 @@ def _fig(title: str, ylabel: str, logy: bool):
     return fig, ax
 
 
-def _finish(fig, ax, path) -> None:
+def finish(fig, ax, path) -> None:
     handles, labels = ax.get_legend_handles_labels()
     if handles:
         ax.legend(loc="upper left", bbox_to_anchor=(1.01, 1.0),
@@ -207,17 +207,8 @@ def _finish(fig, ax, path) -> None:
     fig.savefig(path, bbox_inches="tight")
 
 
-def _t_max(history) -> float:
+def t_max(history) -> float:
     return max((nanos_to_secs(o.get("time")) for o in history), default=1.0)
-
-
-# Public plotting surface for other checkers (e.g. the bank balance
-# plot): one figure/legend/nemesis-shading implementation, one
-# subdirectory-resolution rule.
-fig_ax = _fig
-finish = _finish
-t_max = _t_max
-draw_nemeses = _draw_nemeses
 
 
 def point_graph(test: dict, history: Sequence[dict], path,
@@ -228,7 +219,7 @@ def point_graph(test: dict, history: Sequence[dict], path,
     lh = util.history_latencies(history)
     datasets = invokes_by_f_type(lh)
     markers = "osv^D*Pp"
-    fig, ax = _fig(f"{test.get('name', '')} latency", "Latency (ms)", True)
+    fig, ax = fig_ax(f"{test.get('name', '')} latency", "Latency (ms)", True)
     any_points = False
     for i, f in enumerate(fs_order(datasets)):
         for t in TYPES:
@@ -240,8 +231,8 @@ def point_graph(test: dict, history: Sequence[dict], path,
                        color=TYPE_COLORS[t], marker=markers[i % len(markers)],
                        label=f"{util.name_of(f)} {t}")
             any_points = True
-    _draw_nemeses(ax, history, nemeses, _t_max(history))
-    _finish(fig, ax, path)
+    draw_nemeses(ax, history, nemeses, t_max(history))
+    finish(fig, ax, path)
     return any_points
 
 
@@ -257,7 +248,7 @@ def quantiles_graph(test: dict, history: Sequence[dict], path,
     palette = ["#FF1E90", "#FFA400", "#81BFFC", "#53DF83", "#909090"]
     q_colors = {q: palette[i % len(palette)]
                 for i, q in enumerate(sorted(qs, reverse=True))}
-    fig, ax = _fig(f"{test.get('name', '')} latency", "Latency (ms)", True)
+    fig, ax = fig_ax(f"{test.get('name', '')} latency", "Latency (ms)", True)
     any_points = False
     markers = "osv^D*Pp"
     for i, f in enumerate(fs_order(by_f)):
@@ -269,8 +260,8 @@ def quantiles_graph(test: dict, history: Sequence[dict], path,
                     marker=markers[i % len(markers)], ms=4,
                     color=q_colors[q], label=f"{util.name_of(f)} {q}")
             any_points = True
-    _draw_nemeses(ax, history, nemeses, _t_max(history))
-    _finish(fig, ax, path)
+    draw_nemeses(ax, history, nemeses, t_max(history))
+    finish(fig, ax, path)
     return any_points
 
 
@@ -295,8 +286,8 @@ def rate_graph(test: dict, history: Sequence[dict], path,
     """rate.png: completion throughput (hz) by f and type
     (perf.clj:560-600)."""
     datasets = rates(history, dt)
-    t_max = _t_max(history)
-    fig, ax = _fig(f"{test.get('name', '')} rate", "Throughput (hz)", False)
+    tmax = t_max(history)
+    fig, ax = fig_ax(f"{test.get('name', '')} rate", "Throughput (hz)", False)
     markers = "osv^D*Pp"
     any_points = False
     for i, f in enumerate(fs_order(datasets)):
@@ -304,13 +295,13 @@ def rate_graph(test: dict, history: Sequence[dict], path,
             m = datasets[f].get(t)
             if not m:
                 continue
-            xs = buckets(dt, t_max)
+            xs = buckets(dt, tmax)
             ax.plot(xs, [m.get(x, 0.0) for x in xs],
                     marker=markers[i % len(markers)], ms=4,
                     color=TYPE_COLORS[t], label=f"{util.name_of(f)} {t}")
             any_points = True
-    _draw_nemeses(ax, history, nemeses, t_max)
-    _finish(fig, ax, path)
+    draw_nemeses(ax, history, nemeses, tmax)
+    finish(fig, ax, path)
     return any_points
 
 
@@ -318,16 +309,13 @@ def rate_graph(test: dict, history: Sequence[dict], path,
 # Checkers
 # ---------------------------------------------------------------------------
 
-def _store_path(test: dict, opts: dict, filename: str):
+def store_path(test: dict, opts: dict, filename: str):
     store = test.get("store")
     if store is None:
         return None
     sub = (opts or {}).get("subdirectory")
     parts = [sub] if isinstance(sub, str) else list(sub or [])
     return store.path(test, *[str(p) for p in parts], filename)
-
-
-store_path = _store_path
 
 
 class LatencyGraph(Checker):
@@ -339,8 +327,8 @@ class LatencyGraph(Checker):
 
     def check(self, test, history, opts):
         nemeses = self.nemeses or (test.get("plot") or {}).get("nemeses")
-        p1 = _store_path(test, opts, "latency-raw.png")
-        p2 = _store_path(test, opts, "latency-quantiles.png")
+        p1 = store_path(test, opts, "latency-raw.png")
+        p2 = store_path(test, opts, "latency-quantiles.png")
         if p1 is not None:
             point_graph(test, history, p1, nemeses)
             quantiles_graph(test, history, p2, nemeses)
@@ -355,7 +343,7 @@ class RateGraph(Checker):
 
     def check(self, test, history, opts):
         nemeses = self.nemeses or (test.get("plot") or {}).get("nemeses")
-        p = _store_path(test, opts, "rate.png")
+        p = store_path(test, opts, "rate.png")
         if p is not None:
             rate_graph(test, history, p, nemeses)
         return {"valid?": True}

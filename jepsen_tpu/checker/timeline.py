@@ -17,7 +17,7 @@ from typing import Sequence
 
 from .. import history as h
 from . import Checker
-from .perf import _store_path, nanos_to_ms
+from .perf import store_path, nanos_to_ms
 
 COL_WIDTH = 100    # px (timeline.clj:12: col-width 100)
 GUTTER = 106       # px between process columns (col-width + 6)
@@ -106,7 +106,7 @@ class Timeline(Checker):
     """Writes timeline.html into the store (html, timeline.clj:159)."""
 
     def check(self, test, history, opts):
-        p = _store_path(test, opts or {}, "timeline.html")
+        p = store_path(test, opts or {}, "timeline.html")
         if p is not None:
             p.write_text(render_html(test, history))
         return {"valid?": True}

@@ -1,7 +1,9 @@
 """Aerospike suite.
 
 Counterpart of aerospike/src/jepsen/aerospike.clj (1,262 LoC, plus the
-TLA+ spec at aerospike/spec/aerospike.tla): deb-installed server with a
+TLA+ spec at aerospike/spec/aerospike.tla — our model spec lives at
+suites/specs/aerospike.tla and makes the lost-acked-write claim the
+empirical register workload hunts): deb-installed server with a
 mesh-seeded cluster, CAS-register (generation-check writes) and counter
 workloads. The wire protocol is Aerospike's bespoke binary info/data
 protocol — the client is pluggable (pass ``client`` in opts);

@@ -32,7 +32,8 @@ class HazelcastDB(jdb.DB, jdb.LogFiles):
 
     def setup(self, test, node):
         sess = control.current_session().su()
-        sess.exec("apt-get", "install", "-y", "openjdk-8-jre-headless")
+        # jdk, not jre: the merge policy compiles on node (javac)
+        sess.exec("apt-get", "install", "-y", "openjdk-8-jdk-headless")
         sess.exec("mkdir", "-p", DIR)
         url = (f"https://repo1.maven.org/maven2/com/hazelcast/hazelcast/"
                f"{self.version}/hazelcast-{self.version}.jar")
@@ -47,13 +48,34 @@ class HazelcastDB(jdb.DB, jdb.LogFiles):
                "    <join>\n      <multicast enabled=\"false\"/>\n"
                "      <tcp-ip enabled=\"true\">\n"
                f"{members}\n      </tcp-ip>\n    </join>\n"
-               "  </network>\n</hazelcast>\n")
+               "  </network>\n"
+               # split-brain heals by set union on the workload maps —
+               # without this registration the policy is never invoked
+               "  <map name=\"jepsen*\">\n"
+               "    <merge-policy>jepsen.tpu.hazelcast."
+               "SetUnionMergePolicy</merge-policy>\n"
+               "  </map>\n</hazelcast>\n")
         sess.exec("sh", "-c",
                   f"cat > {DIR}/hazelcast.xml << 'EOF'\n{cfg}\nEOF")
+        # server-side split-brain merge policy for the CRDT set
+        # workload (resources/SetUnionMergePolicy.java) — compiled on
+        # node like the reference's server extension
+        import os.path as _p
+        src = _p.join(_p.dirname(__file__), "resources",
+                      "SetUnionMergePolicy.java")
+        plain = control.current_session()
+        plain.upload(src, "/tmp/SetUnionMergePolicy.java")
+        sess.exec("mkdir", "-p",
+                  f"{DIR}/classes/jepsen/tpu/hazelcast")
+        # loud failure: a missing policy would silently change the
+        # split-brain semantics the set workload tests
+        sess.exec("sh", "-c",
+                  f"cd /tmp && javac -cp {DIR}/hazelcast.jar "
+                  f"-d {DIR}/classes SetUnionMergePolicy.java")
         cutil.start_daemon(
             sess, "java",
             f"-Dhazelcast.config={DIR}/hazelcast.xml",
-            "-cp", f"{DIR}/hazelcast.jar",
+            "-cp", f"{DIR}/hazelcast.jar:{DIR}/classes",
             "com.hazelcast.core.server.StartServer",
             logfile=LOGFILE, pidfile=PIDFILE, chdir=DIR)
 

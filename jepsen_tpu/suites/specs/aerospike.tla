@@ -1,0 +1,94 @@
+-------------------------------- MODULE aerospike --------------------------------
+(* Model spec accompanying the aerospike suite, playing the role the     *)
+(* reference's TLA+ spec plays for its suite: an abstract model of a     *)
+(* partition-replicated CAS register under node failure and partition,   *)
+(* checked against the linearizability property the suite's register     *)
+(* workload tests empirically. The interesting (falsifiable) claim: with *)
+(* ReplicationFactor < Quorum during a partition, both sides can accept  *)
+(* writes for the same key and an acknowledged write is lost on heal —   *)
+(* exactly the anomaly the empirical suite hunts.                        *)
+
+EXTENDS Naturals, FiniteSets
+
+CONSTANTS
+  Nodes,            \* the cluster
+  Values,           \* writable register values
+  ReplicationFactor \* copies per key
+
+ASSUME ReplicationFactor \in 1..Cardinality(Nodes)
+
+VARIABLES
+  partition,   \* a set of nodes isolated from the rest ({} = healthy)
+  replicas,    \* node -> register value it holds (or NoVal)
+  acked,       \* set of values whose writes were acknowledged
+  observed     \* set of values any read has returned
+
+NoVal == CHOOSE v : v \notin Values
+
+Side(n) == IF n \in partition THEN partition ELSE Nodes \ partition
+
+\* A write lands on ReplicationFactor nodes reachable from some
+\* coordinator's side; it is acknowledged iff enough replicas are
+\* reachable there.
+WriteTo(side, v) ==
+  /\ Cardinality(side) >= ReplicationFactor
+  /\ \E targets \in SUBSET side :
+       /\ Cardinality(targets) = ReplicationFactor
+       /\ replicas' = [n \in Nodes |->
+                        IF n \in targets THEN v ELSE replicas[n]]
+       /\ acked' = acked \cup {v}
+       /\ UNCHANGED <<partition, observed>>
+
+Write(v) ==
+  \/ WriteTo(Nodes \ partition, v)
+  \/ partition /= {} /\ WriteTo(partition, v)
+
+Read(n) ==
+  /\ replicas[n] /= NoVal
+  /\ observed' = observed \cup {replicas[n]}
+  /\ UNCHANGED <<partition, replicas, acked>>
+
+Partition(p) ==
+  /\ partition = {}
+  /\ p /= {} /\ p /= Nodes
+  /\ partition' = p
+  /\ UNCHANGED <<replicas, acked, observed>>
+
+\* Healing reconciles divergent replicas by picking ONE side's value
+\* per node pair — the other side's acknowledged writes are gone.
+Heal ==
+  /\ partition /= {}
+  /\ \E keep \in {partition, Nodes \ partition} :
+       \E v \in {replicas[n] : n \in keep} :
+         replicas' = [n \in Nodes |-> v]
+  /\ partition' = {}
+  /\ UNCHANGED <<acked, observed>>
+
+Init ==
+  /\ partition = {}
+  /\ replicas = [n \in Nodes |-> NoVal]
+  /\ acked = {}
+  /\ observed = {}
+
+Next ==
+  \/ \E v \in Values : Write(v)
+  \/ \E n \in Nodes : Read(n)
+  \/ \E p \in SUBSET Nodes : Partition(p)
+  \/ Heal
+
+Spec == Init /\ [][Next]_<<partition, replicas, acked, observed>>
+
+--------------------------------------------------------------------------------
+(* Properties                                                            *)
+
+\* Durability: once healed, every acknowledged write survives on some
+\* replica. FALSE when ReplicationFactor <= Cardinality(Nodes) - Quorum:
+\* TLC produces the lost-write trace the suite reproduces empirically.
+NoLostAckedWrites ==
+  partition = {} =>
+    \A v \in acked : \E n \in Nodes : replicas[n] = v
+
+\* Reads never observe unacknowledged (phantom) values.
+NoPhantomReads == observed \subseteq acked
+
+================================================================================

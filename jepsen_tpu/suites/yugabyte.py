@@ -85,6 +85,19 @@ def workloads(opts: dict | None = None, api: str = "ysql") -> dict:
     return out
 
 
+#: nemesis-name -> constructor (run-jepsen.py's NEMESES sweep names;
+#: the process-level ones target yb-tserver like the reference's)
+NEMESES = {
+    "none": jnemesis.noop,
+    "partition": jnemesis.partition_random_halves,
+    "partition-half": jnemesis.partition_halves,
+    "partition-one": jnemesis.partition_random_node,
+    "partition-ring": jnemesis.partition_majorities_ring,
+    "pause-tserver": lambda: jnemesis.hammer_time("yb-tserver"),
+    "pause-master": lambda: jnemesis.hammer_time("yb-master"),
+}
+
+
 def default_client(api: str, workload: str, opts: dict):
     """YSQL speaks pg-wire on 5433 (yugabyte/src/yugabyte/ysql).
     YCQL speaks the CQL binary protocol on 9042 (yugabyte/ycql)."""
@@ -100,14 +113,19 @@ def yugabyte_test(opts: dict | None = None) -> dict:
     opts = base_opts(**(opts or {}))
     api = opts.get("api", "ysql")
     wname = opts.get("workload", "bank")
+    nemesis_name = opts.get("nemesis", "partition")
+    if nemesis_name not in NEMESES:
+        raise ValueError(f"unknown nemesis {nemesis_name!r}; "
+                         f"have {sorted(NEMESES)}")
     test = suite_test(
         f"yugabyte-{api}", wname, opts,
         workloads(opts, api),
         db=YugaByteDB(opts.get("version", VERSION)),
         client=opts.get("client") or default_client(api, wname, opts),
-        nemesis=jnemesis.partition_random_halves(),
+        nemesis=NEMESES[nemesis_name](),
         os_setup=os_setup.debian())
     test["api"] = api
+    test["nemesis-name"] = nemesis_name
     return test
 
 
@@ -126,15 +144,20 @@ def main(argv=None) -> int:
         p.add_argument("--workload", default=None,
                        choices=sorted(workloads()))
         p.add_argument("--api", default=None, choices=APIS)
+        p.add_argument("--nemesis", default="partition",
+                       choices=sorted(NEMESES))
 
     return jcli.run_cli(
         lambda tmap, args: yugabyte_test(
             {**tmap, "workload": resolve_workload(args, tmap, "bank"),
              "api": (getattr(args, "api", None) or tmap.get("api")
-                     or "ysql")}),
+                     or "ysql"),
+             "nemesis": getattr(args, "nemesis", "partition")}),
         name="yugabyte", opt_fn=opt_fn,
         tests_fn=lambda tmap, args: [
-            yugabyte_test({**tmap, "api": api, "workload": w})
+            yugabyte_test({**tmap, "api": api, "workload": w,
+                           "nemesis": getattr(args, "nemesis",
+                                              "partition")})
             for api in ([args.api] if getattr(args, "api", None)
                         else APIS)
             for w in ([args.workload] if getattr(args, "workload", None)

@@ -72,3 +72,36 @@ def test_suite_registry_loads_every_module():
         assert callable(mod.workloads), name
     with pytest.raises(ValueError):
         suites.load_suite("nope")
+
+
+def test_yugabyte_runner_cli_shapes():
+    """The CI sweep runner builds per-test subprocess commands with
+    nemesis/api/workload routing (run-jepsen.py analogue)."""
+    from jepsen_tpu.suites import yugabyte, yugabyte_runner
+    assert set(yugabyte.NEMESES) >= {"none", "partition",
+                                     "partition-ring"}
+    # nemesis choices resolve to constructible nemeses
+    for name, ctor in yugabyte.NEMESES.items():
+        assert ctor() is not None, name
+    assert callable(yugabyte_runner.main)
+
+
+def test_hazelcast_setup_compiles_merge_policy():
+    from jepsen_tpu import control
+    from jepsen_tpu.suites import hazelcast
+    test = hazelcast.hazelcast_test({"ssh": {"dummy": True}})
+    control.on_nodes(test, lambda t, n: t["db"].setup(t, n))
+    acts = test["remote"].actions
+    uploads = [p for _n, kind, p in acts if kind == "upload"]
+    cmds = "\n".join(str(p) for _n, kind, p in acts
+                     if kind == "execute")
+    assert any("SetUnionMergePolicy" in str(u) for u in uploads)
+    assert "javac" in cmds
+
+
+def test_aerospike_spec_exists():
+    from pathlib import Path
+    import jepsen_tpu.suites as s
+    spec = Path(s.__file__).parent / "specs" / "aerospike.tla"
+    text = spec.read_text()
+    assert "NoLostAckedWrites" in text and "MODULE aerospike" in text
