@@ -269,11 +269,33 @@ def analyze_store(store: Store, checker: str = "append",
                 mesh = parallel.make_mesh()
             except Exception:
                 pass
-            cycles_per_run = parallel.check_bucketed(encs, mesh)
+            # Histories too long for the dense [T,T] closure route
+            # through SCC condensation (the 100k-op path); the rest
+            # sweep the device in length buckets.
+            dense, dense_map, huge, huge_map = [], [], [], []
+            for d, enc in zip(mapping, encs):
+                if enc.n > parallel.DENSE_TXN_LIMIT:
+                    huge.append(enc)
+                    huge_map.append(d)
+                else:
+                    dense.append(enc)
+                    dense_map.append(d)
             # The checker class's own defaults, so batch verdicts match
             # single-run verdicts for the same history.
             prohibited = elle.AppendChecker().prohibited
-            for d, enc, cycles in zip(mapping, encs, cycles_per_run):
+            if dense:
+                cycles_per_run = parallel.check_bucketed(dense, mesh)
+                for d, enc, cycles in zip(dense_map, dense,
+                                          cycles_per_run):
+                    res = elle.render_verdict(enc, cycles, prohibited)
+                    worst = max(worst, emit(d, res))
+            for d, enc in zip(huge_map, huge):
+                # mesh=None: these are all past the dense limit, so
+                # check_long_history goes host-condensation; None just
+                # lets the per-SCC classify stage use default_devices()
+                # (the dp batch mesh would be wrong for B=1 anyway)
+                cycles = parallel.check_long_history(
+                    enc, None, dense_limit=parallel.DENSE_TXN_LIMIT)
                 res = elle.render_verdict(enc, cycles, prohibited)
                 worst = max(worst, emit(d, res))
         else:  # wr: edge lists are host-built; one device dispatch
