@@ -139,6 +139,25 @@ def _edges_one(appends: jnp.ndarray, reads: jnp.ndarray, n_keys: int,
     prev_w = W[k_idx, jnp.maximum(p_idx - 1, 0)]
     ww = scatter_edges(prev_w, a_txn, a_live & (a_pos >= 2))
 
+    # Power-of-two shortcut edges along each key's writer chain: an
+    # edge W[k,p] -> W[k,p+s] is implied by transitivity whenever every
+    # position p..p+s is live, so every closure is unchanged — but the
+    # effective graph diameter drops from the chain length to ~log of
+    # it, cutting squaring rounds (measured 8 -> 4 on the 5k-txn bench
+    # shape, chain length 80). Soundness needs the contiguity gate: a
+    # gap in the chain means no implied path, and a shortcut across it
+    # would invent reachability.
+    liveW = (W >= 0).astype(jnp.int32)          # [K, P+2]
+    C = jnp.cumsum(liveW, axis=1)
+    P = max_pos
+    s = 2
+    while s <= P:
+        src = W[:, 1:P + 1 - s]                 # pos p = 1..P-s
+        dst = W[:, 1 + s:P + 1]                 # pos p+s
+        run = (C[:, 1 + s:P + 1] - C[:, 0:P - s]) == s + 1
+        ww = ww | scatter_edges(src.ravel(), dst.ravel(), run.ravel())
+        s *= 2
+
     # wr: writer of pos -> reader (pos >= 1)
     rk = jnp.where(r_live, r_key, n_keys - 1)
     rp = jnp.where(r_live & (r_pos >= 1), r_pos, max_pos + 1)

@@ -283,20 +283,23 @@ def analyze_store(store: Store, checker: str = "append",
             # The checker class's own defaults, so batch verdicts match
             # single-run verdicts for the same history.
             prohibited = elle.AppendChecker().prohibited
+            cycles_by_dir: dict = {}
             if dense:
-                cycles_per_run = parallel.check_bucketed(dense, mesh)
-                for d, enc, cycles in zip(dense_map, dense,
-                                          cycles_per_run):
-                    res = elle.render_verdict(enc, cycles, prohibited)
-                    worst = max(worst, emit(d, res))
+                for d, cycles in zip(dense_map,
+                                     parallel.check_bucketed(dense,
+                                                             mesh)):
+                    cycles_by_dir[d] = cycles
             for d, enc in zip(huge_map, huge):
                 # mesh=None: these are all past the dense limit, so
                 # check_long_history goes host-condensation; None just
                 # lets the per-SCC classify stage use default_devices()
                 # (the dp batch mesh would be wrong for B=1 anyway)
-                cycles = parallel.check_long_history(
+                cycles_by_dir[d] = parallel.check_long_history(
                     enc, None, dense_limit=parallel.DENSE_TXN_LIMIT)
-                res = elle.render_verdict(enc, cycles, prohibited)
+            # one emit loop, in the original (sorted run-dir) order
+            for d, enc in zip(mapping, encs):
+                res = elle.render_verdict(enc, cycles_by_dir[d],
+                                          prohibited)
                 worst = max(worst, emit(d, res))
         else:  # wr: edge lists are host-built; one device dispatch
             cycles_per_run = elle_kernels.check_edge_batch(
