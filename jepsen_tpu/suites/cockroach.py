@@ -16,6 +16,7 @@ from .. import cli as jcli
 from .. import control
 from .. import db as jdb
 from .. import nemesis as jnemesis, os_setup
+from ..nemesis import clock as jclock
 from ..control import util as cutil
 from . import base_opts, sql, standard_workloads, suite_test
 
@@ -90,16 +91,35 @@ def default_client(workload: str, opts: dict):
         workload, opts)
 
 
+#: The reference cockroach suite's nemesis menu
+#: (cockroachdb/src/jepsen/cockroach/nemesis.clj): partitions, clock
+#: skew via the on-node bump/strobe helpers, and process pauses.
+NEMESES = {
+    "none": jnemesis.noop,
+    "partition": jnemesis.partition_random_halves,
+    "partition-half": jnemesis.partition_halves,
+    "partition-ring": jnemesis.partition_majorities_ring,
+    "clock": jclock.clock_nemesis,
+    "pause": lambda: jnemesis.hammer_time("cockroach"),
+}
+
+
 def cockroach_test(opts: dict | None = None) -> dict:
     opts = base_opts(**(opts or {}))
     wname = opts.get("workload", "register")
-    return suite_test(
+    nemesis_name = opts.get("nemesis", "partition")
+    if nemesis_name not in NEMESES:
+        raise ValueError(f"unknown nemesis {nemesis_name!r}; "
+                         f"have {sorted(NEMESES)}")
+    test = suite_test(
         "cockroach", wname, opts,
         workloads(opts),
         db=CockroachDB(opts.get("version", VERSION)),
         client=opts.get("client") or default_client(wname, opts),
-        nemesis=jnemesis.partition_random_halves(),
+        nemesis=NEMESES[nemesis_name](),
         os_setup=os_setup.debian())
+    test["nemesis-name"] = nemesis_name
+    return test
 
 
 def main(argv=None) -> int:
@@ -107,12 +127,18 @@ def main(argv=None) -> int:
     return jcli.run_cli(
         lambda tmap, args: cockroach_test(
             {**tmap,
-             "workload": resolve_workload(args, tmap, "register")}),
+             "workload": resolve_workload(args, tmap, "register"),
+             "nemesis": getattr(args, "nemesis", "partition")}),
         name="cockroach",
-        opt_fn=lambda p: p.add_argument(
-            "--workload", default=None, choices=sorted(workloads())),
+        opt_fn=lambda p: (
+            p.add_argument("--workload", default=None,
+                           choices=sorted(workloads())),
+            p.add_argument("--nemesis", default="partition",
+                           choices=sorted(NEMESES))),
         tests_fn=lambda tmap, args: [
-            cockroach_test({**tmap, "workload": w})
+            cockroach_test({**tmap, "workload": w,
+                            "nemesis": getattr(args, "nemesis",
+                                               "partition")})
             for w in ([args.workload] if getattr(
                 args, "workload", None) else sorted(workloads()))],
         argv=argv)

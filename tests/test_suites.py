@@ -262,3 +262,29 @@ def test_etcd_quorum_option():
     t = etcd.etcd_test({"quorum": True})
     assert t["client"].quorum is True
     assert etcd.etcd_test({})["client"].quorum is False
+
+
+def test_every_suite_test_map_constructs():
+    """<name>_test({"ssh": {"dummy": True}}) must build a full test map
+    (db/client/nemesis/generator/checker) for every registry suite —
+    the constructor smoke the per-suite tests can't cover for all 28."""
+    from jepsen_tpu import suites as S
+
+    for name in S.SUITES:
+        mod = S.load_suite(name)
+        fn_name = f"{name}_test"
+        fn = getattr(mod, fn_name, None)
+        assert fn is not None, f"{name} has no {fn_name}"
+        t = fn({"ssh": {"dummy": True}})
+        assert t.get("generator") is not None, name
+        assert t.get("checker") is not None, name
+        assert t.get("db") is not None, name
+
+
+def test_cockroach_nemesis_menu():
+    from jepsen_tpu.suites import cockroach as c
+    t = c.cockroach_test({"ssh": {"dummy": True}, "nemesis": "clock"})
+    assert t["nemesis-name"] == "clock"
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="unknown nemesis"):
+        c.cockroach_test({"ssh": {"dummy": True}, "nemesis": "bogus"})
