@@ -258,12 +258,19 @@ def classify_matrices_impl(ww, wr, rw, invoke_index, complete_index, process,
 
     wwr = ww | wr
     full = wwr | rw
-    c_full = _closure_batched(full, steps, constrain)
-    cycle = jnp.any(full & jnp.swapaxes(c_full, 1, 2) & nI, axis=(1, 2))
     if not classify:
+        c_full = _closure_batched(full, steps, constrain)
+        cycle = jnp.any(full & jnp.swapaxes(c_full, 1, 2) & nI,
+                        axis=(1, 2))
         return cycle.astype(jnp.int32) << CYCLE
+    # Chained warm starts: closure(A|B) == closure(closure(A)|B), so
+    # seeding each wider closure with the previous result is exact and
+    # each seeded closure converges in the few rounds its NEW edge
+    # class adds, instead of re-walking the whole graph three times.
     c_ww = _closure_batched(ww, steps, constrain)
-    c_wwr = _closure_batched(wwr, steps, constrain)
+    c_wwr = _closure_batched(c_ww | wr, steps, constrain)
+    c_full = _closure_batched(c_wwr | rw, steps, constrain)
+    cycle = jnp.any(full & jnp.swapaxes(c_full, 1, 2) & nI, axis=(1, 2))
     cT_wwr = jnp.swapaxes(c_wwr, 1, 2)
     g0 = jnp.any(ww & jnp.swapaxes(c_ww, 1, 2) & nI, axis=(1, 2))
     g1c = jnp.any(wr & cT_wwr, axis=(1, 2))
