@@ -130,6 +130,9 @@ def suite_test(name: str, workload_name: str, opts: dict,
         "workload": workload_name,
     }
     # Omit unset roles so core.run's defaults (noop db/os/...) apply.
+    # A workload entry may carry its own default client (e.g. per-mode
+    # wire clients); an explicit `client` argument wins.
+    client = client if client is not None else wl.get("client")
     for key, val in (("db", db), ("client", client),
                      ("nemesis", nemesis), ("os", os_setup)):
         if val is not None:

@@ -1,19 +1,26 @@
 """RethinkDB suite.
 
 Counterpart of rethinkdb/src/jepsen/rethinkdb (529 LoC): apt-installed
-RethinkDB with a joined cluster, document CAS over write_acks=majority
-tables. ReQL is a bespoke term-tree protocol spoken by the official
-driver; the client here is pluggable (pass ``client`` in opts) while
-install/cluster/workload wiring is complete.
+RethinkDB with a joined cluster, driven over the ReQL wire protocol
+directly (drivers.reql — V1_0 SCRAM handshake + JSON term queries)
+with hard durability and majority reads, the write_acks=majority shape
+the reference tests.
 """
 
 from __future__ import annotations
 
+from .. import checker as jchecker
 from .. import cli as jcli
+from .. import client as jclient
 from .. import control
 from .. import db as jdb
-from .. import nemesis as jnemesis, os_setup
-from . import base_opts, standard_workloads, suite_test
+from .. import generator as gen
+from .. import independent, nemesis as jnemesis, os_setup
+from ..checker import models
+from ..drivers import DBError, DriverError
+from ..workloads import set_workload
+from . import base_opts, suite_test
+from .sql import resolve
 
 LOGFILE = "/var/log/rethinkdb.log"
 
@@ -53,9 +60,110 @@ class RethinkDB(jdb.DB, jdb.LogFiles):
         return [LOGFILE]
 
 
+DB_NAME = "jepsen"
+
+
+class RethinkClient(jclient.Client):
+    """Document ops over ReQL: reads are majority-read GETs, writes are
+    hard-durability inserts with conflict replace — the write-then-
+    read-your-majority shape the reference's register workload uses.
+    (CAS needs ReQL lambda terms; the reference sweeps r/w too.)"""
+
+    def __init__(self, mode: str = "register", port: int = 28015,
+                 node: str | None = None, timeout: float = 5.0):
+        self.mode = mode
+        self.port = port
+        self.node = node
+        self.timeout = timeout
+        self.conn = None
+        self._setup_done = False
+
+    def open(self, test, node):
+        return RethinkClient(self.mode, self.port, node, self.timeout)
+
+    def _ensure_conn(self, test):
+        if self.conn is None:
+            from ..drivers import reql
+            host, port = resolve(self.node, self.port, test or {})
+            self.conn = reql.connect(host, port, timeout=self.timeout)
+        if not self._setup_done:
+            self.conn.db_create(DB_NAME)
+            for tbl in ("registers", "sets"):
+                self.conn.table_create(DB_NAME, tbl)
+            self._setup_done = True
+
+    def close(self, test):
+        if self.conn is not None:
+            try:
+                self.conn.close()
+            finally:
+                self.conn = None
+
+    def invoke(self, test, op):
+        read_only = op.get("f") == "read"
+        try:
+            self._ensure_conn(test)
+            if self.mode == "set":
+                return self._set(op)
+            return self._register(op)
+        except DBError as e:
+            return {**op, "type": "fail",
+                    "error": f"reql-{e.code}: {e.message[:120]}"}
+        except (DriverError, OSError) as e:
+            self.close(test)
+            return {**op, "type": "fail" if read_only else "info",
+                    "error": str(e)[:160]}
+
+    def _register(self, op):
+        v = op["value"]
+        k, val = (v.key, v.value) if independent.is_tuple(v) else (0, v)
+        lift = (lambda x: independent.tuple_(k, x)) \
+            if independent.is_tuple(v) else (lambda x: x)
+        c = self.conn
+        if op["f"] == "read":
+            doc = c.get(DB_NAME, "registers", int(k))
+            out = doc.get("val") if isinstance(doc, dict) else None
+            return {**op, "type": "ok", "value": lift(out)}
+        if op["f"] == "write":
+            c.insert(DB_NAME, "registers",
+                     {"id": int(k), "val": int(val)},
+                     conflict="replace", durability="hard")
+            return {**op, "type": "ok"}
+        return {**op, "type": "fail", "error": f"unknown f {op['f']!r}"}
+
+    def _set(self, op):
+        c = self.conn
+        if op["f"] == "add":
+            c.insert(DB_NAME, "sets", {"id": int(op["value"])},
+                     conflict="error", durability="hard")
+            return {**op, "type": "ok"}
+        if op["f"] == "read":
+            docs = c.run(c.table(DB_NAME, "sets"),
+                         {"read_mode": "majority"})
+            return {**op, "type": "ok",
+                    "value": sorted(int(d["id"]) for d in docs)}
+        return {**op, "type": "fail", "error": f"unknown f {op['f']!r}"}
+
+
 def workloads(opts: dict | None = None) -> dict:
-    std = standard_workloads(opts)
-    return {k: std[k] for k in ("register", "set", "bank")}
+    opts = opts or {}
+    from ..workloads.register import r, w
+
+    def register():
+        return {
+            "generator": independent.concurrent_generator(
+                2, range(10_000),
+                lambda k: gen.limit(100, gen.mix([r, w]))),
+            "checker": independent.checker(
+                jchecker.linearizable(models.register())),
+            "client": RethinkClient("register"),
+        }
+
+    def set_():
+        wl = set_workload.test(n=opts.get("set-size", 500))
+        return {**wl, "client": RethinkClient("set")}
+
+    return {"register": register, "set": set_}
 
 
 def rethinkdb_test(opts: dict | None = None) -> dict:

@@ -2,21 +2,30 @@
 
 Counterpart of robustirc/src/jepsen/robustirc.clj (217 LoC + the
 gencert.go TLS helper): a raft-replicated IRC network whose messages
-must never be lost or reordered. RobustIRC clients speak HTTP+JSON
-(robustsession protocol) to post and fetch messages; the suite wires a
-message-set workload over it. TLS cert generation is handled by
+must never be lost or reordered. The client speaks the robustsession
+HTTP+JSON protocol directly (create session / post message / stream
+messages) — each set-add is a PRIVMSG to the test channel, the final
+read drains the channel backlog. TLS cert generation is handled by
 openssl on-node instead of the reference's Go helper.
 """
 
 from __future__ import annotations
 
+import json
+import socket
+import ssl
+import urllib.error
+import urllib.request
+
 from .. import cli as jcli
+from .. import client as jclient
 from .. import control
 from .. import db as jdb
 from .. import nemesis as jnemesis, os_setup
 from ..control import util as cutil
 from ..workloads import queue as queue_wl
 from . import base_opts, standard_workloads, suite_test
+from .sql import resolve
 
 DIR = "/opt/robustirc"
 PIDFILE = f"{DIR}/robustirc.pid"
@@ -63,12 +72,126 @@ class RobustIRCDB(jdb.DB, jdb.LogFiles):
         return [LOGFILE]
 
 
+CHANNEL = "#jepsen"
+
+
+class RobustIRCClient(jclient.Client):
+    """Set ops over the robustsession protocol
+    (github.com/robustirc/robustirc: POST /robustirc/v1/session,
+    POST .../message, GET .../messages): add = PRIVMSG with the value,
+    read = drain the message stream and collect the values seen."""
+
+    def __init__(self, port: int = 13001, node: str | None = None,
+                 timeout: float = 5.0, tls: bool = True):
+        self.port = port
+        self.node = node
+        self.timeout = timeout
+        self.tls = tls
+        self.session = None        # (sessionid, sessionauth)
+        # IRC servers do not echo a session's own PRIVMSGs back to it,
+        # so a read unions the drained stream (everyone else's
+        # messages) with this client's own acknowledged sends.
+        self.sent_acked: set[int] = set()
+
+    def open(self, test, node):
+        return RobustIRCClient(self.port, node, self.timeout, self.tls)
+
+    def _ctx(self):
+        if not self.tls:
+            return None
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False       # self-signed per-test certs
+        ctx.verify_mode = ssl.CERT_NONE
+        return ctx
+
+    def _url(self, test, path: str) -> str:
+        host, port = resolve(self.node, self.port, test or {})
+        scheme = "https" if self.tls else "http"
+        return f"{scheme}://{host}:{port}/robustirc/v1{path}"
+
+    def _request(self, test, path: str, body: dict | None = None,
+                 method: str = "GET"):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self._url(test, path), data=data, method=method,
+            headers={"Content-Type": "application/json",
+                     **({"X-Session-Auth": self.session[1]}
+                        if self.session else {})})
+        return urllib.request.urlopen(req, timeout=self.timeout,
+                                      context=self._ctx())
+
+    def _ensure_session(self, test):
+        if self.session is None:
+            with self._request(test, "/session", {}, "POST") as r:
+                out = json.loads(r.read())
+            self.session = (out["Sessionid"], out["Sessionauth"])
+            for line in (f"NICK j{self.session[0][-6:]}",
+                         f"USER jepsen 0 * :jepsen",
+                         f"JOIN {CHANNEL}"):
+                self._post_message(test, line)
+
+    def _post_message(self, test, line: str) -> None:
+        sid = self.session[0]
+        self._request(test, f"/{sid}/message",
+                      {"Data": line}, "POST").read()
+
+    def _drain_messages(self, test) -> list[int]:
+        """Stream ndjson messages until the server closes or the socket
+        times out; collect PRIVMSG payload ints."""
+        sid = self.session[0]
+        vals = []
+        try:
+            with self._request(test, f"/{sid}/messages?lastseen=0.0"
+                               ) as r:
+                for raw in r:
+                    try:
+                        msg = json.loads(raw)
+                    except json.JSONDecodeError:
+                        continue
+                    data = msg.get("Data", "")
+                    if "PRIVMSG" in data and ":" in data:
+                        tail = data.rsplit(":", 1)[1].strip()
+                        if tail.lstrip("-").isdigit():
+                            vals.append(int(tail))
+        except (TimeoutError, socket.timeout):
+            pass  # long-poll stream: timeout ends the drain
+        return sorted(set(vals))
+
+    def invoke(self, test, op):
+        crash = "fail" if op["f"] == "read" else "info"
+        try:
+            self._ensure_session(test)
+            if op["f"] == "add":
+                v = int(op["value"])
+                self._post_message(test, f"PRIVMSG {CHANNEL} :{v}")
+                self.sent_acked.add(v)
+                return {**op, "type": "ok"}
+            if op["f"] == "read":
+                seen = set(self._drain_messages(test)) | self.sent_acked
+                return {**op, "type": "ok", "value": sorted(seen)}
+            return {**op, "type": "fail", "error": f"unknown f {op['f']!r}"}
+        except urllib.error.HTTPError as e:
+            self.session = None
+            return {**op, "type": "fail" if 400 <= e.code < 500
+                    else crash, "error": f"http-{e.code}"}
+        except OSError as e:
+            self.session = None
+            return {**op, "type": crash, "error": str(e)[:160]}
+
+
 def workloads(opts: dict | None = None) -> dict:
     opts = opts or {}
     std = standard_workloads(opts)
-    # message delivery == set semantics: every acknowledged message
-    # must be in the final channel history
-    return {"set": std["set"],
+    tls = opts.get("tls", True)
+
+    def set_():
+        # message delivery == set semantics: every acknowledged message
+        # must be in the final channel history
+        return {**std["set"](), "client": RobustIRCClient(tls=tls)}
+
+    # queue has no robustsession client (IRC has no dequeue); it stays
+    # pluggable via opts["client"]
+    return {"set": set_,
             "queue": lambda: queue_wl.test(opts.get("ops", 500))}
 
 

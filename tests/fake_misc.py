@@ -592,3 +592,209 @@ class FakeESServer(_BaseHTTPFake):
         self.docs: dict = {}
         self.lock = threading.Lock()
         super().__init__()
+
+
+# ---------------------------------------------------------------------
+# RethinkDB-ish (ReQL wire protocol: V1_0 SCRAM handshake + JSON terms)
+
+
+class _ReqlHandler(socketserver.BaseRequestHandler):
+    PASSWORD = ""
+
+    def handle(self):
+        srv = self.server.owner  # type: ignore
+        sock = self.request
+        buf = b""
+
+        def recvn(n):
+            nonlocal buf
+            while len(buf) < n:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            out, rest = buf[:n], buf[n:]
+            buf = rest
+            return out
+
+        def recv_nul():
+            nonlocal buf
+            while b"\0" not in buf:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            frame, buf = buf.split(b"\0", 1)
+            return json.loads(frame)
+
+        import hashlib as _hl
+        import hmac as _hm
+
+        try:
+            (magic,) = struct.unpack("<I", recvn(4))
+            assert magic == 0x34C2BDC3
+            sock.sendall(json.dumps(
+                {"success": True, "min_protocol_version": 0,
+                 "max_protocol_version": 0,
+                 "server_version": "fake"}).encode() + b"\0")
+            first = recv_nul()
+            cf_bare = first["authentication"].split(",", 2)[2]
+            cnonce = dict(p.split("=", 1)
+                          for p in cf_bare.split(","))["r"]
+            snonce = cnonce + base64.b64encode(b"serverside").decode()
+            salt = b"0123456789abcdef"
+            it = 4096
+            server_first = (f"r={snonce},"
+                            f"s={base64.b64encode(salt).decode()},i={it}")
+            sock.sendall(json.dumps(
+                {"success": True,
+                 "authentication": server_first}).encode() + b"\0")
+            final = recv_nul()["authentication"]
+            fparts = dict(p.split("=", 1) for p in final.split(","))
+            final_bare = final[:final.rindex(",p=")]
+            auth_msg = ",".join((cf_bare, server_first,
+                                 final_bare)).encode()
+            salted = _hl.pbkdf2_hmac("sha256", self.PASSWORD.encode(),
+                                     salt, it)
+            skey = _hm.digest(salted, b"Server Key", "sha256")
+            ssig = _hm.digest(skey, auth_msg, "sha256")
+            sock.sendall(json.dumps(
+                {"success": True, "authentication":
+                 "v=" + base64.b64encode(ssig).decode()}).encode() +
+                b"\0")
+            cursors: dict = {}
+            while True:
+                (token,) = struct.unpack("<Q", recvn(8))
+                (n,) = struct.unpack("<I", recvn(4))
+                q = json.loads(recvn(n))
+                resp = self._dispatch(srv, q, cursors)
+                payload = json.dumps(resp).encode()
+                sock.sendall(struct.pack("<Q", token) +
+                             struct.pack("<I", len(payload)) + payload)
+        except (ConnectionError, AssertionError):
+            pass
+
+    def _dispatch(self, srv, q, cursors):
+        qtype = q[0]
+        if qtype == 2:                    # CONTINUE: drain stashed rows
+            rest = cursors.pop("rows", [])
+            return {"t": 2, "r": rest}
+        term = q[1]
+        with srv.lock:
+            resp = self._eval(srv, term)
+        # exercise the client's SUCCESS_PARTIAL/CONTINUE path: split
+        # multi-row sequences into a partial first batch + a remainder
+        if resp.get("t") == 2 and len(resp.get("r", [])) > 1:
+            cursors["rows"] = resp["r"][1:]
+            return {"t": 3, "r": resp["r"][:1]}
+        return resp
+
+    def _eval(self, srv, term):
+        # terms: [DB_CREATE,[db]] [TABLE_CREATE,[[DB,[db]],t]]
+        # [TABLE,[[DB,[db]],t]] [GET,[table,k]] [INSERT,[table,doc],opts]
+        tt = term[0]
+        args = term[1] if len(term) > 1 else []
+        opts = term[2] if len(term) > 2 else {}
+        if tt == 57:       # DB_CREATE
+            return {"t": 1, "r": [{"dbs_created": 1}]}
+        if tt == 60:       # TABLE_CREATE
+            tbl = args[1]
+            srv.tables.setdefault(tbl, {})
+            return {"t": 1, "r": [{"tables_created": 1}]}
+        if tt == 15:       # TABLE scan
+            tbl = srv.tables.get(args[1], {})
+            return {"t": 2, "r": list(tbl.values())}
+        if tt == 16:       # GET
+            tbl = srv.tables.get(args[0][1][1], {})
+            doc = tbl.get(args[1])
+            return {"t": 1, "r": [doc]}
+        if tt == 56:       # INSERT
+            tbl = srv.tables.setdefault(args[0][1][1], {})
+            doc = args[1]
+            conflict = opts.get("conflict", "error")
+            if doc["id"] in tbl and conflict == "error":
+                return {"t": 1, "r": [{"errors": 1, "inserted": 0,
+                                      "first_error": "Duplicate key"}]}
+            tbl[doc["id"]] = doc
+            return {"t": 1, "r": [{"errors": 0, "inserted": 1}]}
+        return {"t": 18, "r": [f"unsupported term {tt}"]}
+
+
+class FakeReqlServer(_BaseFake):
+    handler = _ReqlHandler
+
+    def __init__(self):
+        self.tables: dict[str, dict] = {}
+        self.lock = threading.Lock()
+        super().__init__()
+
+
+# ---------------------------------------------------------------------
+# RobustIRC-ish robustsession HTTP API (plain HTTP; the client's tls
+# flag is off in tests)
+
+
+class _RobustIRCHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, code, obj):
+        data = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_POST(self):
+        srv = self.server.owner  # type: ignore
+        path = urlparse(self.path).path
+        n = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(n) or b"{}")
+        with srv.lock:
+            if path == "/robustirc/v1/session":
+                sid = f"0x{srv.next_sid:x}"
+                srv.next_sid += 1
+                srv.sessions[sid] = True
+                self._reply(200, {"Sessionid": sid,
+                                  "Sessionauth": f"auth-{sid}",
+                                  "Prefix": "fake"})
+                return
+            if path.endswith("/message"):
+                data = body.get("Data", "")
+                if data.startswith("PRIVMSG"):
+                    srv.messages.append(data)
+                self._reply(200, {})
+                return
+        self._reply(404, {"error": "no route"})
+
+    def do_GET(self):
+        srv = self.server.owner  # type: ignore
+        if "/messages" in self.path:
+            with srv.lock:
+                lines = list(srv.messages)
+            # backlog then close (the real server long-polls; closing
+            # ends the client's drain loop cleanly)
+            payload = b"".join(
+                json.dumps({"Data": ln}).encode() + b"\n"
+                for ln in lines)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+            return
+        self._reply(404, {"error": "no route"})
+
+
+class FakeRobustIRCServer(_BaseHTTPFake):
+    handler = _RobustIRCHandler
+
+    def __init__(self):
+        self.sessions: dict = {}
+        self.messages: list[str] = []
+        self.next_sid = 1
+        self.lock = threading.Lock()
+        super().__init__()

@@ -105,3 +105,74 @@ def test_aerospike_spec_exists():
     spec = Path(s.__file__).parent / "specs" / "aerospike.tla"
     text = spec.read_text()
     assert "NoLostAckedWrites" in text and "MODULE aerospike" in text
+
+
+def test_rethinkdb_client_and_suite_end_to_end(tmp_path):
+    """ReQL driver + client: register and set workloads against the
+    fake ReQL server, suite end-to-end valid."""
+    from fake_misc import FakeReqlServer
+    from jepsen_tpu import independent
+
+    with FakeReqlServer() as srv:
+        test = {"db-hosts": {n: ("127.0.0.1", srv.port)
+                             for n in ("n1", "n2", "n3", "n4", "n5")}}
+        c = rethinkdb.RethinkClient("register").open(test, "n1")
+        kv = independent.tuple_(3, 9)
+        assert c.invoke(test, {"type": "invoke", "f": "write",
+                               "value": kv, "process": 0})["type"] == "ok"
+        r = c.invoke(test, {"type": "invoke", "f": "read",
+                            "value": independent.tuple_(3, None),
+                            "process": 0})
+        assert r["value"].value == 9
+        c.close(test)
+
+        s = rethinkdb.RethinkClient("set").open(test, "n1")
+        assert s.invoke(test, {"type": "invoke", "f": "add",
+                               "value": 7, "process": 0})["type"] == "ok"
+        assert s.invoke(test, {"type": "invoke", "f": "read",
+                               "value": None,
+                               "process": 0})["value"] == [7]
+        s.close(test)
+
+    # fresh server for the suite run: the manual ops above would read
+    # as unexpected set elements otherwise
+    with FakeReqlServer() as srv:
+        hosts = {n: ("127.0.0.1", srv.port)
+                 for n in ("n1", "n2", "n3", "n4", "n5")}
+        t = rethinkdb.rethinkdb_test({
+            "ssh": {"dummy": True}, "time-limit": 1.0,
+            "db-hosts": hosts})
+        for k in ("db", "os", "nemesis"):
+            t.pop(k, None)
+        t["net"] = jnet.noop()
+        t["store"] = Store(tmp_path / "store")
+        t = core.run(t)
+    assert t["results"]["valid?"] is True
+
+
+def test_robustirc_client_and_suite_end_to_end(tmp_path):
+    from fake_misc import FakeRobustIRCServer
+
+    with FakeRobustIRCServer() as srv:
+        test = {"db-hosts": {n: ("127.0.0.1", srv.port)
+                             for n in ("n1", "n2", "n3", "n4", "n5")}}
+        c = robustirc.RobustIRCClient(tls=False).open(test, "n1")
+        assert c.invoke(test, {"type": "invoke", "f": "add",
+                               "value": 5, "process": 0})["type"] == "ok"
+        r = c.invoke(test, {"type": "invoke", "f": "read",
+                            "value": None, "process": 0})
+        assert r["type"] == "ok" and 5 in r["value"]
+
+    # fresh server for the suite run (see rethinkdb test note)
+    with FakeRobustIRCServer() as srv:
+        hosts = {n: ("127.0.0.1", srv.port)
+                 for n in ("n1", "n2", "n3", "n4", "n5")}
+        t = robustirc.robustirc_test({
+            "ssh": {"dummy": True}, "time-limit": 1.0, "tls": False,
+            "db-hosts": hosts})
+        for k in ("db", "os", "nemesis"):
+            t.pop(k, None)
+        t["net"] = jnet.noop()
+        t["store"] = Store(tmp_path / "store")
+        t = core.run(t)
+    assert t["results"]["valid?"] is True
