@@ -3,18 +3,22 @@
 Counterpart of faunadb/src/jepsen/faunadb/ (3,605 LoC, the largest
 remaining reference suite): deb-installed FaunaDB with a
 log-replicated cluster, driven over its HTTP+JSON query API with
-secret-key auth. The workload matrix maps the reference's
-register/set/bank/monotonic/pages families onto the shared library;
-FQL query construction is client-pluggable (pass ``client``) — the
-install/cluster/workload wiring is complete.
+secret-key auth (the reference's JVM driver is the same HTTP endpoint,
+client.clj:36-60). FaunaClient speaks the FQL wire-JSON protocol via
+drivers.fauna_http and maps the register (register.clj:31-62), set
+(set.clj:35-60), bank (bank.clj:80-140), monotonic and g2 families;
+pass ``client`` to substitute your own.
 """
 
 from __future__ import annotations
 
 from .. import cli as jcli
+from .. import client as jclient
 from .. import control
 from .. import db as jdb
+from .. import independent
 from .. import nemesis as jnemesis, os_setup
+from ..drivers import DBError, DriverError
 from . import base_opts, standard_workloads, suite_test
 
 LOGFILE = "/var/log/faunadb/core.log"
@@ -65,10 +69,220 @@ class FaunaDB(jdb.DB, jdb.LogFiles):
         return [LOGFILE]
 
 
+class FaunaClient(jclient.Client):
+    """Workload ops over the FQL wire protocol (drivers.fauna_http).
+
+    Each mode mirrors its reference client: register
+    (register.clj:31-62, CAS via Let/Select/If over data.register),
+    set (set.clj:35-60, class + all-elements index, reads paginate the
+    index), bank (bank.clj:80-140, transfer aborts when the balance
+    would go negative), monotonic (counter via Add), g2
+    (g2.clj, predicate emptiness check then insert)."""
+
+    PORT = 8443
+
+    def __init__(self, mode: str = "register", accounts: list | None = None,
+                 total: int = 100, node: str | None = None,
+                 timeout: float = 10.0):
+        self.mode = mode
+        self.accounts = accounts if accounts is not None else list(range(8))
+        self.total = total
+        self.node = node
+        self.timeout = timeout
+        self.conn = None
+        self._setup_done = False
+
+    def open(self, test, node):
+        return FaunaClient(self.mode, self.accounts, self.total, node,
+                           self.timeout)
+
+    def _ensure_conn(self, test):
+        from ..drivers import fauna_http as q
+        from .sql import resolve
+        if self.conn is None:
+            host, port = resolve(self.node, self.PORT, test or {})
+            # register/set read through /linearized like the reference
+            self.conn = q.connect(
+                host, port, linearized=self.mode in ("register", "set"),
+                timeout=self.timeout)
+        if not self._setup_done:
+            self._setup(q)
+            self._setup_done = True
+
+    def _upsert_class(self, q, name: str):
+        self.conn.query(q.if_(q.exists(q.class_(name)), None,
+                              q.create_class({"name": name})))
+
+    def _setup(self, q):
+        if self.mode in ("register", "monotonic"):
+            self._upsert_class(q, "test")
+        elif self.mode == "set":
+            self._upsert_class(q, "elements")
+            self.conn.query(q.if_(
+                q.exists(q.index("all-elements")), None,
+                q.create_index({
+                    "name": "all-elements",
+                    "source": q.class_("elements"),
+                    "active": True,
+                    "values": [{"field": ["data", "value"]}]})))
+        elif self.mode == "bank":
+            self._upsert_class(q, "accounts")
+            for i, a in enumerate(self.accounts):
+                ref = q.ref_(q.class_("accounts"), a)
+                bal = self.total if i == 0 else 0
+                self.conn.query(q.when(
+                    q.not_(q.exists(ref)),
+                    q.create(ref, {"data": {"balance": bal}})))
+        elif self.mode == "g2":
+            for name in ("a", "b"):
+                self._upsert_class(q, name)
+                self.conn.query(q.if_(
+                    q.exists(q.index(f"{name}-by-key")), None,
+                    q.create_index({
+                        "name": f"{name}-by-key",
+                        "source": q.class_(name),
+                        "active": True,
+                        "terms": [{"field": ["data", "key"]}]})))
+
+    def close(self, test):
+        self.conn = None
+
+    def invoke(self, test, op):
+        read_only = op.get("f") == "read"
+        try:
+            self._ensure_conn(test)
+            return self._dispatch(op)
+        except DBError as e:
+            return {**op, "type": "fail",
+                    "error": f"fauna-{e.code}: {e.message[:120]}"}
+        except (DriverError, OSError) as e:
+            self.close(test)
+            return {**op, "type": "fail" if read_only else "info",
+                    "error": str(e)[:160]}
+
+    def _dispatch(self, op):
+        from ..drivers import fauna_http as q
+        f = op["f"]
+        v = op.get("value")
+        if self.mode == "register":
+            k, val = (v.key, v.value) if independent.is_tuple(v) else (0, v)
+            lift = (lambda x: independent.tuple_(k, x)) \
+                if independent.is_tuple(v) else (lambda x: x)
+            ref = q.ref_(q.class_("test"), k)
+            if f == "read":
+                res = self.conn.query(q.if_(q.exists(ref), q.get_(ref)))
+                reg = (res or {}).get("data", {}).get("register") \
+                    if isinstance(res, dict) else None
+                return {**op, "type": "ok", "value": lift(reg)}
+            if f == "write":
+                self.conn.query(q.if_(
+                    q.exists(ref),
+                    q.update(ref, {"data": {"register": val}}),
+                    q.create(ref, {"data": {"register": val}})))
+                return {**op, "type": "ok"}
+            if f == "cas":
+                old, new = val
+                res = self.conn.query(q.if_(
+                    q.exists(ref),
+                    q.let({"reg": q.select(["data", "register"],
+                                           q.get_(ref))},
+                          q.if_(q.equals(old, q.var("reg")),
+                                q.update(ref,
+                                         {"data": {"register": new}}),
+                                False)),
+                    False))
+                return {**op, "type": "ok" if res else "fail"}
+        elif self.mode == "set":
+            if f == "add":
+                self.conn.query(q.create(q.ref_(q.class_("elements"), v),
+                                         {"data": {"value": v}}))
+                return {**op, "type": "ok"}
+            if f == "read":
+                vals = self.conn.query_all(q.match(q.index("all-elements")))
+                return {**op, "type": "ok", "value": set(vals)}
+        elif self.mode == "bank":
+            cls = q.class_("accounts")
+            if f == "read":
+                res = self.conn.query([
+                    q.when(q.exists(q.ref_(cls, a)),
+                           [a, q.select(["data", "balance"],
+                                        q.get_(q.ref_(cls, a)))])
+                    for a in self.accounts])
+                return {**op, "type": "ok",
+                        "value": {p[0]: p[1] for p in res if p}}
+            if f == "transfer":
+                frm, to, amt = v["from"], v["to"], v["amount"]
+                try:
+                    self.conn.query(q.let(
+                        {"a": q.subtract(
+                            q.select(["data", "balance"],
+                                     q.get_(q.ref_(cls, frm))), amt)},
+                        q.if_(q.lt(q.var("a"), 0),
+                              q.abort("balance would go negative"),
+                              q.do(
+                                  q.update(q.ref_(cls, frm),
+                                           {"data": {"balance":
+                                                     q.var("a")}}),
+                                  q.update(q.ref_(cls, to),
+                                           {"data": {"balance": q.add(
+                                               q.select(
+                                                   ["data", "balance"],
+                                                   q.get_(q.ref_(cls,
+                                                                 to))),
+                                               amt)}})))))
+                    return {**op, "type": "ok"}
+                except DBError as e:
+                    if "would go negative" in e.message:
+                        return {**op, "type": "fail", "error": "negative"}
+                    raise
+        elif self.mode == "monotonic":
+            ref = q.ref_(q.class_("test"), 0)
+            if f == "read":
+                res = self.conn.query(q.if_(
+                    q.exists(ref),
+                    q.select(["data", "value"], q.get_(ref)), 0))
+                return {**op, "type": "ok", "value": res}
+            if f == "inc":
+                res = self.conn.query(q.if_(
+                    q.exists(ref),
+                    q.select(["data", "value"], q.update(
+                        ref, {"data": {"value": q.add(
+                            q.select(["data", "value"], q.get_(ref)),
+                            1)}})),
+                    q.select(["data", "value"],
+                             q.create(ref, {"data": {"value": 1}}))))
+                return {**op, "type": "ok", "value": res}
+        elif self.mode == "g2":
+            if f == "insert":
+                k, ids = (v.key, v.value) if independent.is_tuple(v) \
+                    else (v[0], v[1])
+                a_id, b_id = ids
+                tbl = "a" if a_id is not None else "b"
+                the_id = a_id if a_id is not None else b_id
+                empty = lambda n: q.equals(  # noqa: E731
+                    q.select(["data"],
+                             q.paginate(q.match(q.index(f"{n}-by-key"),
+                                                k), size=1)), [])
+                res = self.conn.query(q.if_(
+                    q.and_(empty("a"), empty("b")),
+                    q.do(q.create(q.ref_(q.class_(tbl), the_id),
+                                  {"data": {"key": k, "id": the_id}}),
+                         True),
+                    False))
+                return {**op, "type": "ok" if res else "fail"}
+        return {**op, "type": "fail", "error": f"unknown f {f!r}"}
+
+
 def workloads(opts: dict | None = None) -> dict:
     std = standard_workloads(opts)
-    return {k: std[k] for k in
-            ("register", "set", "bank", "monotonic", "g2")}
+    out = {}
+    for k in ("register", "set", "bank", "monotonic", "g2"):
+        def make(name=k):
+            pkg = dict(std[name]())
+            pkg.setdefault("client", FaunaClient(mode=name))
+            return pkg
+        out[k] = make
+    return out
 
 
 def faunadb_test(opts: dict | None = None) -> dict:

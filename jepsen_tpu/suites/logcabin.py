@@ -11,14 +11,12 @@ client here (exec over SSH).
 
 from __future__ import annotations
 
-from .. import checker as jchecker
 from .. import cli as jcli
 from .. import client as jclient
 from .. import control
 from .. import db as jdb
 from .. import generator as gen
 from .. import independent, nemesis as jnemesis, os_setup
-from ..checker import models
 from ..control import util as cutil
 from . import base_opts, nemesis_cycle
 
@@ -65,15 +63,31 @@ class LogCabinDB(jdb.DB, jdb.LogFiles):
         return [LOGFILE]
 
 
+#: TreeOps conditional-write failure: the register held a different
+#: value than the CAS precondition demanded (cas-msg-pattern,
+#: logcabin.clj:152-155) — a *definite* failure.
+CAS_FAILED = "as required"
+#: Client-side op timeout (timeout-msg-pattern, logcabin.clj:157-158).
+#: The reference maps this to :fail with :value :timed-out.
+TIMED_OUT = "timeout elapsed"
+OP_TIMEOUT = 3  # seconds (op-timeout, logcabin.clj:160-162)
+
+
 class LogCabinClient(jclient.Client):
-    """Register ops via the `logcabin` CLI over SSH (write/read a tree
-    path) — the reference shells out the same way for its smoke ops."""
+    """Register ops via the on-node `TreeOps` binary over SSH — exactly
+    how the reference drives LogCabin (logcabin-get!/set!/cas!,
+    logcabin.clj:164-209): reads and writes through the tree store, CAS
+    via TreeOps' `-p path:oldvalue` conditional write."""
 
     def __init__(self, node: str | None = None):
         self.node = node
 
     def open(self, test, node):
         return LogCabinClient(node)
+
+    def _treeops(self, cluster: str) -> str:
+        return (f"{DIR}/build/Examples/TreeOps "
+                f"--cluster={cluster} -q -t {OP_TIMEOUT}")
 
     def invoke(self, test, op):
         v = op["value"]
@@ -82,23 +96,41 @@ class LogCabinClient(jclient.Client):
             if independent.is_tuple(v) else (lambda x: x)
         sess = control.session(test, self.node)
         cluster = ",".join(f"{n}:5254" for n in test.get("nodes", []))
+        top = self._treeops(cluster)
         try:
             if op["f"] == "read":
-                res = sess.exec_raw(
-                    f"{DIR}/build/Examples/TreeOps "
-                    f"--cluster={cluster} read /r{k} 2>/dev/null")
+                res = sess.exec_raw(f"{top} read /r{k}")
+                if res.exit != 0:
+                    raise control.CommandError(
+                        "treeops read", res.exit, res.out, res.err,
+                        self.node)
                 out = res.out.strip()
                 return {**op, "type": "ok",
                         "value": lift(int(out) if out else None)}
             if op["f"] == "write":
                 sess.exec("sh", "-c",
-                          f"echo {int(val)} | "
-                          f"{DIR}/build/Examples/TreeOps "
-                          f"--cluster={cluster} write /r{k}")
+                          f"echo -n {int(val)} | {top} write /r{k}")
+                return {**op, "type": "ok"}
+            if op["f"] == "cas":
+                old, new = val
+                sess.exec("sh", "-c",
+                          f"echo -n {int(new)} | "
+                          f"{top} -p /r{k}:{int(old)} write /r{k}")
                 return {**op, "type": "ok"}
             return {**op, "type": "fail", "error": f"unknown f {op['f']!r}"}
         except control.CommandError as e:
-            return {**op, "type": "fail", "error": str(e)[:120]}
+            msg = (e.err or e.out or "").strip()
+            if op["f"] == "cas" and CAS_FAILED in msg:
+                return {**op, "type": "fail", "error": "cas-mismatch"}
+            if TIMED_OUT in msg:
+                # reference maps client timeouts to :fail/:timed-out
+                # (logcabin.clj:240-243)
+                return {**op, "type": "fail", "error": "timed-out"}
+            if op["f"] == "read":
+                return {**op, "type": "fail", "error": str(e)[:120]}
+            # a failed write/cas exec is indeterminate: TreeOps may
+            # have committed before dying
+            return {**op, "type": "info", "error": str(e)[:120]}
         except control.ConnectionError_ as e:
             crash = "fail" if op["f"] == "read" else "info"
             return {**op, "type": crash, "error": str(e)[:120]}
@@ -107,15 +139,14 @@ class LogCabinClient(jclient.Client):
 
 
 def workloads(opts: dict | None = None) -> dict:
-    from ..workloads.register import r, w
+    from ..workloads import register as register_wl
 
     def register():
+        # r/w/cas mix against the CAS-register model, per the
+        # reference's CASClient (logcabin.clj:212-250)
         return {
-            "generator": independent.concurrent_generator(
-                2, range(10_000),
-                lambda k: gen.limit(100, gen.mix([r, w]))),
-            "checker": independent.checker(
-                jchecker.linearizable(models.register())),
+            "generator": register_wl.generator(2, 10_000, 100),
+            "checker": register_wl.checker(),
         }
 
     return {"register": register}

@@ -9,12 +9,10 @@ the reference tests.
 
 from __future__ import annotations
 
-from .. import checker as jchecker
 from .. import cli as jcli
 from .. import client as jclient
 from .. import control
 from .. import db as jdb
-from .. import generator as gen
 from .. import independent, nemesis as jnemesis, os_setup
 from ..checker import models
 from ..drivers import DBError, DriverError
@@ -147,15 +145,15 @@ class RethinkClient(jclient.Client):
 
 def workloads(opts: dict | None = None) -> dict:
     opts = opts or {}
+    from ..workloads import register as register_wl
     from ..workloads.register import r, w
 
     def register():
+        # cas-less mix: ReQL updates are last-write-wins documents
         return {
-            "generator": independent.concurrent_generator(
-                2, range(10_000),
-                lambda k: gen.limit(100, gen.mix([r, w]))),
-            "checker": independent.checker(
-                jchecker.linearizable(models.register())),
+            "generator": register_wl.generator(2, 10_000, 100,
+                                               ops=[r, w]),
+            "checker": register_wl.checker(model=models.register()),
             "client": RethinkClient("register"),
         }
 

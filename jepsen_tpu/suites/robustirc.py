@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 import socket
 import ssl
+import uuid
 import urllib.error
 import urllib.request
 
@@ -78,8 +79,11 @@ CHANNEL = "#jepsen"
 class RobustIRCClient(jclient.Client):
     """Set ops over the robustsession protocol
     (github.com/robustirc/robustirc: POST /robustirc/v1/session,
-    POST .../message, GET .../messages): add = PRIVMSG with the value,
-    read = drain the message stream and collect the values seen."""
+    POST .../message, GET .../messages), mirroring the reference's
+    SetClient (robustirc.clj:150-180): add = `TOPIC #jepsen :v` (topic
+    changes are broadcast to every member *including the setter*, so a
+    reader sees its own adds — unlike PRIVMSG), read = drain the
+    message stream and collect TOPIC payload ints."""
 
     def __init__(self, port: int = 13001, node: str | None = None,
                  timeout: float = 5.0, tls: bool = True):
@@ -88,21 +92,19 @@ class RobustIRCClient(jclient.Client):
         self.timeout = timeout
         self.tls = tls
         self.session = None        # (sessionid, sessionauth)
-        # IRC servers do not echo a session's own PRIVMSGs back to it,
-        # so a read unions the drained stream (everyone else's
-        # messages) with this client's own acknowledged sends.
-        self.sent_acked: set[int] = set()
+        if tls:
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False   # self-signed per-test certs
+            ctx.verify_mode = ssl.CERT_NONE
+            self._sslctx = ctx
+        else:
+            self._sslctx = None
 
     def open(self, test, node):
         return RobustIRCClient(self.port, node, self.timeout, self.tls)
 
     def _ctx(self):
-        if not self.tls:
-            return None
-        ctx = ssl.create_default_context()
-        ctx.check_hostname = False       # self-signed per-test certs
-        ctx.verify_mode = ssl.CERT_NONE
-        return ctx
+        return self._sslctx
 
     def _url(self, test, path: str) -> str:
         host, port = resolve(self.node, self.port, test or {})
@@ -135,11 +137,25 @@ class RobustIRCClient(jclient.Client):
         self._request(test, f"/{sid}/message",
                       {"Data": line}, "POST").read()
 
-    def _drain_messages(self, test) -> list[int]:
-        """Stream ndjson messages until the server closes or the socket
-        times out; collect PRIVMSG payload ints."""
+    @staticmethod
+    def _topic_payload(data: str) -> str | None:
+        """IRC line -> TOPIC payload (filter-topic/extract-topic,
+        robustirc.clj:138-148: second token is TOPIC for reflected
+        lines, first for raw ones; payload after the last colon)."""
+        toks = data.split()
+        if len(toks) < 2 or ":" not in data:
+            return None
+        if toks[0] != "TOPIC" and toks[1] != "TOPIC":
+            return None
+        return data.rsplit(":", 1)[1].strip()
+
+    def _drain_until(self, test, sentinel: str) -> tuple[list[int], bool]:
+        """Stream ndjson messages, collecting TOPIC payload ints, until
+        the sentinel topic is seen (-> complete backlog), the server
+        closes, or the socket times out (-> partial)."""
         sid = self.session[0]
         vals = []
+        complete = False
         try:
             with self._request(test, f"/{sid}/messages?lastseen=0.0"
                                ) as r:
@@ -148,14 +164,17 @@ class RobustIRCClient(jclient.Client):
                         msg = json.loads(raw)
                     except json.JSONDecodeError:
                         continue
-                    data = msg.get("Data", "")
-                    if "PRIVMSG" in data and ":" in data:
-                        tail = data.rsplit(":", 1)[1].strip()
-                        if tail.lstrip("-").isdigit():
-                            vals.append(int(tail))
+                    tail = self._topic_payload(msg.get("Data", ""))
+                    if tail is None:
+                        continue
+                    if tail == sentinel:
+                        complete = True
+                        break
+                    if tail.lstrip("-").isdigit():
+                        vals.append(int(tail))
         except (TimeoutError, socket.timeout):
-            pass  # long-poll stream: timeout ends the drain
-        return sorted(set(vals))
+            pass  # long-poll stream: timeout ends the drain early
+        return sorted(set(vals)), complete
 
     def invoke(self, test, op):
         crash = "fail" if op["f"] == "read" else "info"
@@ -163,12 +182,20 @@ class RobustIRCClient(jclient.Client):
             self._ensure_session(test)
             if op["f"] == "add":
                 v = int(op["value"])
-                self._post_message(test, f"PRIVMSG {CHANNEL} :{v}")
-                self.sent_acked.add(v)
+                self._post_message(test, f"TOPIC {CHANNEL} :{v}")
                 return {**op, "type": "ok"}
             if op["f"] == "read":
-                seen = set(self._drain_messages(test)) | self.sent_acked
-                return {**op, "type": "ok", "value": sorted(seen)}
+                # A sentinel topic marks where the backlog ends: a
+                # drain that never sees it is partial and must not be
+                # reported as a definitive read (set-checker would
+                # count committed adds as lost).
+                sentinel = f"end-{uuid.uuid4().hex[:12]}"
+                self._post_message(test, f"TOPIC {CHANNEL} :{sentinel}")
+                seen, complete = self._drain_until(test, sentinel)
+                if not complete:
+                    return {**op, "type": "fail",
+                            "error": "partial-backlog"}
+                return {**op, "type": "ok", "value": seen}
             return {**op, "type": "fail", "error": f"unknown f {op['f']!r}"}
         except urllib.error.HTTPError as e:
             self.session = None

@@ -37,18 +37,21 @@ def rand_op(test=None, ctx=None):
 
 
 def generator(threads_per_key: int = 2, key_count: int = 10,
-              ops_per_key: int = 100):
+              ops_per_key: int = 100, ops=None):
     """Concurrent per-key generators over a rotating key space
-    (linearizable_register.clj:34-50)."""
+    (linearizable_register.clj:34-50). `ops` restricts the op mix
+    (e.g. ``[r, w]`` for stores without CAS)."""
+    op_gen = rand_op if ops is None else gen.mix(list(ops))
     return independent.concurrent_generator(
         threads_per_key, range(key_count),
-        lambda k: gen.limit(ops_per_key, rand_op))
+        lambda k: gen.limit(ops_per_key, op_gen))
 
 
-def checker(backend: str = "cpu", algorithm: str = "competition"):
+def checker(backend: str = "cpu", algorithm: str = "competition",
+            model=None):
     return independent.checker(
-        linearizable(models.cas_register(), algorithm=algorithm,
-                     backend=backend))
+        linearizable(model if model is not None else models.cas_register(),
+                     algorithm=algorithm, backend=backend))
 
 
 def test(threads_per_key: int = 2, key_count: int = 10,

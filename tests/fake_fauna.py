@@ -1,0 +1,320 @@
+"""In-process fake FaunaDB: evaluates the FQL wire-JSON forms the
+drivers.fauna_http constructors emit against an in-memory store, with
+per-query atomicity (mutation journal rolled back on Abort) — enough to
+run the faunadb suite's client end-to-end, including the bank
+workload's abort-on-negative path."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class Abort(Exception):
+    def __init__(self, msg):
+        super().__init__(msg)
+        self.msg = msg
+
+
+class BadRequest(Exception):
+    def __init__(self, code, msg):
+        super().__init__(msg)
+        self.code = code
+        self.msg = msg
+
+
+def _ref_json(cls: str, id: str) -> dict:
+    return {"@ref": {"id": str(id),
+                     "class": {"@ref": {"id": cls,
+                                        "class": {"@ref":
+                                                  {"id": "classes"}}}}}}
+
+
+class FaunaStore:
+    def __init__(self):
+        self.classes: set[str] = set()
+        self.indexes: dict[str, dict] = {}
+        self.instances: dict[tuple, dict] = {}   # (cls, id) -> data
+        self.ts = 0
+        self.lock = threading.RLock()
+        self.journal: list | None = None
+
+    # -- journaling (per-query atomicity) ------------------------------
+
+    def _log(self, key):
+        if self.journal is not None:
+            old = self.instances.get(key)
+            self.journal.append(
+                (key, None if old is None else json.loads(
+                    json.dumps(old))))
+
+    def run(self, expr):
+        with self.lock:
+            self.journal = []
+            try:
+                return self.eval(expr, {})
+            except Abort:
+                for key, old in reversed(self.journal):
+                    if old is None:
+                        self.instances.pop(key, None)
+                    else:
+                        self.instances[key] = old
+                raise
+            finally:
+                self.journal = None
+
+    # -- expression evaluation -----------------------------------------
+
+    def _to_ref(self, v):
+        """Evaluated value -> (cls, id) tuple."""
+        if isinstance(v, tuple) and v and v[0] == "ref":
+            return v[1], v[2]
+        raise BadRequest("invalid expression", f"not a ref: {v!r}")
+
+    def _doc(self, cls, id):
+        data = self.instances[(cls, str(id))]
+        return {"ref": _ref_json(cls, id), "ts": self.ts,
+                "data": json.loads(json.dumps(data))}
+
+    def eval(self, x, env):
+        if isinstance(x, list):
+            return [self.eval(v, env) for v in x]
+        if not isinstance(x, dict):
+            return x
+
+        if "object" in x:
+            return {k: self.eval(v, env) for k, v in x["object"].items()}
+        if "var" in x:
+            name = x["var"]
+            if name not in env:
+                raise BadRequest("invalid expression", f"unbound {name}")
+            return env[name]
+        if "let" in x:
+            env = dict(env)
+            for k, v in x["let"].items():
+                env[k] = self.eval(v, env)
+            return self.eval(x["in"], env)
+        if "if" in x:
+            return self.eval(x["then"] if self.eval(x["if"], env)
+                             else x["else"], env)
+        if "do" in x:
+            out = None
+            for e in x["do"]:
+                out = self.eval(e, env)
+            return out
+        if "equals" in x:
+            vals = [self.eval(v, env) for v in x["equals"]]
+            return all(v == vals[0] for v in vals)
+        if "add" in x:
+            return sum(self.eval(v, env) for v in x["add"])
+        if "subtract" in x:
+            vals = [self.eval(v, env) for v in x["subtract"]]
+            out = vals[0]
+            for v in vals[1:]:
+                out -= v
+            return out
+        if "lt" in x:
+            vals = [self.eval(v, env) for v in x["lt"]]
+            return all(a < b for a, b in zip(vals, vals[1:]))
+        if "and" in x:
+            return all(self.eval(v, env) for v in x["and"])
+        if "not" in x:
+            return not self.eval(x["not"], env)
+        if "abort" in x:
+            raise Abort(self.eval(x["abort"], env))
+
+        if "create_class" in x:
+            params = self.eval(x["create_class"], env)
+            self.classes.add(params["name"])
+            return {"ref": _ref_json("classes", params["name"])}
+        if "create_index" in x:
+            params = self.eval(x["create_index"], env)
+            src = params["source"]
+            if isinstance(src, tuple) and src[0] == "class":
+                src = src[1]
+            elif isinstance(src, dict) and "class" in src:
+                src = src["class"]
+            params["source"] = src
+            self.indexes[params["name"]] = params
+            return {"ref": _ref_json("indexes", params["name"])}
+
+        if "create" in x:
+            cls, id = self._to_ref(self.eval(x["create"], env))
+            key = (cls, str(id))
+            if key in self.instances:
+                raise BadRequest("instance already exists",
+                                 "document exists")
+            params = self.eval(x.get("params"), env) or {}
+            self._log(key)
+            self.ts += 1
+            self.instances[key] = params.get("data", {})
+            return self._doc(cls, id)
+        if "update" in x:
+            cls, id = self._to_ref(self.eval(x["update"], env))
+            key = (cls, str(id))
+            if key not in self.instances:
+                raise BadRequest("instance not found", "not found")
+            params = self.eval(x.get("params"), env) or {}
+            self._log(key)
+            self.ts += 1
+            self.instances[key].update(params.get("data", {}))
+            return self._doc(cls, id)
+        if "delete" in x:
+            cls, id = self._to_ref(self.eval(x["delete"], env))
+            key = (cls, str(id))
+            if key not in self.instances:
+                raise BadRequest("instance not found", "not found")
+            self._log(key)
+            self.ts += 1
+            doc = self._doc(cls, id)
+            del self.instances[key]
+            return doc
+        if "get" in x:
+            cls, id = self._to_ref(self.eval(x["get"], env))
+            if (cls, str(id)) not in self.instances:
+                raise BadRequest("instance not found", "not found")
+            return self._doc(cls, id)
+        if "exists" in x:
+            v = self.eval(x["exists"], env)
+            if isinstance(v, tuple):
+                if v[0] == "ref":
+                    return (v[1], str(v[2])) in self.instances
+                if v[0] == "class":
+                    return v[1] in self.classes
+                if v[0] == "index":
+                    return v[1] in self.indexes
+            raise BadRequest("invalid expression", f"exists? {v!r}")
+        if "select" in x:
+            path = self.eval(x["select"], env)
+            obj = self.eval(x["from"], env)
+            for p in path:
+                try:
+                    obj = obj[p]
+                except (KeyError, IndexError, TypeError):
+                    raise BadRequest("value not found",
+                                     f"no path {path}")
+            return obj
+        if "match" in x:
+            idx = self.eval(x["match"], env)
+            if not (isinstance(idx, tuple) and idx[0] == "index"):
+                raise BadRequest("invalid expression", "match wants index")
+            terms = [self.eval(t, env) for t in x.get("terms", [])]
+            return ("match", idx[1], tuple(terms))
+        if "paginate" in x:
+            m = self.eval(x["paginate"], env)
+            if not (isinstance(m, tuple) and m[0] == "match"):
+                raise BadRequest("invalid expression", "paginate wants set")
+            _, iname, terms = m
+            idx = self.indexes.get(iname)
+            if idx is None:
+                raise BadRequest("instance not found", f"index {iname}")
+            rows = []
+            for (cls, id), data in sorted(self.instances.items()):
+                if cls != idx["source"]:
+                    continue
+                if terms:
+                    tvals = tuple(
+                        self._field(data, t["field"])
+                        for t in idx.get("terms", []))
+                    if tvals != terms:
+                        continue
+                if idx.get("values"):
+                    vals = [self._field(data, v["field"])
+                            for v in idx["values"]]
+                    rows.append(vals[0] if len(vals) == 1 else vals)
+                else:
+                    rows.append(_ref_json(cls, id))
+            size = x.get("size", 64)
+            # single page (size bounds tested by the driver's cursor
+            # loop terminating on a missing `after`)
+            return {"data": rows[:size]}
+
+        if "class" in x and set(x) <= {"class"}:
+            return ("class", x["class"])
+        if "index" in x and set(x) <= {"index"}:
+            return ("index", x["index"])
+        if "ref" in x:
+            base = self.eval(x["ref"], env)
+            if isinstance(base, tuple) and base[0] == "class":
+                return ("ref", base[1], str(x.get("id")))
+            raise BadRequest("invalid expression", f"ref base {base!r}")
+        if "time" in x:
+            return self.eval(x["time"], env)
+        if "at" in x:
+            return self.eval(x["expr"], env)
+        raise BadRequest("invalid expression", f"unknown form {x!r}")
+
+    @staticmethod
+    def _field(data, path):
+        obj = {"data": data}
+        for p in path:
+            obj = obj.get(p) if isinstance(obj, dict) else None
+            if obj is None:
+                return None
+        return obj
+
+
+class FakeFaunaServer:
+    """`with FakeFaunaServer() as srv:` — .port, one shared store."""
+
+    def __init__(self):
+        self.store = FaunaStore()
+        store = self.store
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    expr = json.loads(self.rfile.read(n))
+                except Exception:
+                    return self._err(400, "invalid expression", "bad json")
+                if not self.headers.get("Authorization", ""). \
+                        startswith("Basic "):
+                    return self._err(401, "unauthorized", "no secret")
+                try:
+                    res = store.run(expr)
+                except Abort as e:
+                    return self._err(400, "transaction aborted", e.msg)
+                except BadRequest as e:
+                    return self._err(400, e.code, e.msg)
+                body = json.dumps({"resource": self._enc(res)}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            @staticmethod
+            def _enc(v):
+                if isinstance(v, tuple) and v and v[0] == "ref":
+                    return _ref_json(v[1], v[2])
+                if isinstance(v, tuple):
+                    return list(v)
+                return v
+
+            def _err(self, status, code, desc):
+                body = json.dumps({"errors": [
+                    {"code": code, "description": desc}]}).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        self.thread = threading.Thread(target=self.httpd.serve_forever,
+                                       daemon=True)
+
+    def __enter__(self):
+        self.thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        return False

@@ -764,8 +764,9 @@ class _RobustIRCHandler(BaseHTTPRequestHandler):
                 return
             if path.endswith("/message"):
                 data = body.get("Data", "")
-                if data.startswith("PRIVMSG"):
-                    srv.messages.append(data)
+                if data.startswith(("PRIVMSG", "TOPIC")):
+                    # reflect like a real server: ":prefix CMD ..."
+                    srv.messages.append(f":fake!j@fake {data}")
                 self._reply(200, {})
                 return
         self._reply(404, {"error": "no route"})

@@ -1,0 +1,159 @@
+"""FaunaDB wire driver + suite client against the fake server, and the
+faunadb suite end-to-end (faunadb/src/jepsen/faunadb/ counterparts)."""
+
+from __future__ import annotations
+
+import pytest
+
+from jepsen_tpu import core, independent, net as jnet
+from jepsen_tpu.drivers import DBError, fauna_http as q
+from jepsen_tpu.store import Store
+from jepsen_tpu.suites import faunadb
+
+from fake_fauna import FakeFaunaServer
+
+
+def hosts_for(srv):
+    return {n: ("127.0.0.1", srv.port)
+            for n in ("n1", "n2", "n3", "n4", "n5")}
+
+
+def test_driver_crud_roundtrip():
+    with FakeFaunaServer() as srv:
+        c = q.connect("127.0.0.1", srv.port)
+        c.query(q.create_class({"name": "test"}))
+        assert c.query(q.exists(q.class_("test"))) is True
+        ref = q.ref_(q.class_("test"), 1)
+        c.query(q.create(ref, {"data": {"register": 3}}))
+        doc = c.query(q.get_(ref))
+        assert doc["data"]["register"] == 3
+        assert isinstance(doc["ref"], q.Ref) and doc["ref"].id == "1"
+        c.query(q.update(ref, {"data": {"register": 4}}))
+        assert c.query(q.select(["data", "register"], q.get_(ref))) == 4
+        with pytest.raises(DBError) as ei:
+            c.query(q.get_(q.ref_(q.class_("test"), 99)))
+        assert ei.value.code == "instance not found"
+
+
+def test_driver_abort_rolls_back():
+    with FakeFaunaServer() as srv:
+        c = q.connect("127.0.0.1", srv.port)
+        c.query(q.create_class({"name": "t"}))
+        ref = q.ref_(q.class_("t"), 1)
+        c.query(q.create(ref, {"data": {"v": 1}}))
+        with pytest.raises(DBError) as ei:
+            c.query(q.do(q.update(ref, {"data": {"v": 9}}),
+                         q.abort("nope")))
+        assert ei.value.code == "transaction aborted"
+        # the update inside the aborted query must not be visible
+        assert c.query(q.select(["data", "v"], q.get_(ref))) == 1
+
+
+def test_client_register_cas():
+    with FakeFaunaServer() as srv:
+        test = {"db-hosts": hosts_for(srv)}
+        c = faunadb.FaunaClient("register").open(test, "n1")
+        kv = independent.tuple_(2, 3)
+        assert c.invoke(test, {"type": "invoke", "f": "write",
+                               "value": kv, "process": 0})["type"] == "ok"
+        r = c.invoke(test, {"type": "invoke", "f": "read",
+                            "value": independent.tuple_(2, None),
+                            "process": 0})
+        assert r["type"] == "ok" and r["value"].value == 3
+        ok = c.invoke(test, {"type": "invoke", "f": "cas",
+                             "value": independent.tuple_(2, [3, 4]),
+                             "process": 0})
+        assert ok["type"] == "ok"
+        miss = c.invoke(test, {"type": "invoke", "f": "cas",
+                               "value": independent.tuple_(2, [3, 5]),
+                               "process": 0})
+        assert miss["type"] == "fail"
+        # unwritten key reads nil
+        r0 = c.invoke(test, {"type": "invoke", "f": "read",
+                             "value": independent.tuple_(7, None),
+                             "process": 0})
+        assert r0["type"] == "ok" and r0["value"].value is None
+
+
+def test_client_set_add_read():
+    with FakeFaunaServer() as srv:
+        test = {"db-hosts": hosts_for(srv)}
+        c = faunadb.FaunaClient("set").open(test, "n1")
+        for v in (1, 5, 9):
+            assert c.invoke(test, {"type": "invoke", "f": "add",
+                                   "value": v,
+                                   "process": 0})["type"] == "ok"
+        r = c.invoke(test, {"type": "invoke", "f": "read", "value": None,
+                            "process": 0})
+        assert r["type"] == "ok" and r["value"] == {1, 5, 9}
+
+
+def test_client_bank_transfer_and_abort():
+    with FakeFaunaServer() as srv:
+        test = {"db-hosts": hosts_for(srv)}
+        c = faunadb.FaunaClient("bank").open(test, "n1")
+        r = c.invoke(test, {"type": "invoke", "f": "read", "value": None,
+                            "process": 0})
+        assert sum(r["value"].values()) == 100
+        t = c.invoke(test, {"type": "invoke", "f": "transfer",
+                            "process": 0,
+                            "value": {"from": 0, "to": 3, "amount": 30}})
+        assert t["type"] == "ok"
+        # overdraw: bank.clj's abort path -> definite :fail :negative
+        bad = c.invoke(test, {"type": "invoke", "f": "transfer",
+                              "process": 0,
+                              "value": {"from": 3, "to": 0,
+                                        "amount": 31}})
+        assert bad["type"] == "fail" and bad["error"] == "negative"
+        r = c.invoke(test, {"type": "invoke", "f": "read", "value": None,
+                            "process": 0})
+        assert sum(r["value"].values()) == 100 and r["value"][3] == 30
+
+
+def test_client_monotonic_inc():
+    with FakeFaunaServer() as srv:
+        test = {"db-hosts": hosts_for(srv)}
+        c = faunadb.FaunaClient("monotonic").open(test, "n1")
+        assert c.invoke(test, {"type": "invoke", "f": "read",
+                               "value": None,
+                               "process": 0})["value"] == 0
+        vals = [c.invoke(test, {"type": "invoke", "f": "inc",
+                                "value": None, "process": 0})["value"]
+                for _ in range(3)]
+        assert vals == [1, 2, 3]
+
+
+def test_client_g2_at_most_one_insert_per_key():
+    with FakeFaunaServer() as srv:
+        test = {"db-hosts": hosts_for(srv)}
+        c = faunadb.FaunaClient("g2").open(test, "n1")
+        first = c.invoke(test, {"type": "invoke", "f": "insert",
+                                "process": 0,
+                                "value": independent.tuple_(1, [5, None])})
+        assert first["type"] == "ok"
+        second = c.invoke(test, {"type": "invoke", "f": "insert",
+                                 "process": 0,
+                                 "value": independent.tuple_(
+                                     1, [None, 6])})
+        assert second["type"] == "fail"
+        other = c.invoke(test, {"type": "invoke", "f": "insert",
+                                "process": 0,
+                                "value": independent.tuple_(2, [None, 7])})
+        assert other["type"] == "ok"
+
+
+def test_faunadb_suite_end_to_end(tmp_path):
+    with FakeFaunaServer() as srv:
+        opts = {
+            "workload": "set",
+            "ssh": {"dummy": True}, "time-limit": 1.0,
+            "extra": {"net": jnet.noop(),
+                      "store": Store(tmp_path / "store")},
+            "db-hosts": hosts_for(srv),
+        }
+        test = faunadb.faunadb_test(opts)
+        for k in ("db", "os", "nemesis"):
+            test.pop(k, None)
+        test = core.run(test)
+    r = test["results"]
+    assert r["valid?"] is True, r

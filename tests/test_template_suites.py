@@ -176,3 +176,100 @@ def test_robustirc_client_and_suite_end_to_end(tmp_path):
         t["store"] = Store(tmp_path / "store")
         t = core.run(t)
     assert t["results"]["valid?"] is True
+
+
+def test_logcabin_client_treeops_commands_and_cas_classification():
+    """The logcabin client drives TreeOps the way the reference does
+    (logcabin-get!/set!/cas!, logcabin.clj:164-209): conditional writes
+    via `-p path:old`, CAS mismatches are definite failures, timeouts
+    map to fail/timed-out, and other write errors are indeterminate."""
+    from jepsen_tpu import control
+    from jepsen_tpu.suites import logcabin
+
+    test = logcabin.logcabin_test({"ssh": {"dummy": True},
+                                   "nodes": ["n1", "n2"]})
+    c = test["client"].open(test, "n1")
+
+    assert c.invoke(test, {"type": "invoke", "f": "write", "value": 3,
+                           "process": 0})["type"] == "ok"
+    assert c.invoke(test, {"type": "invoke", "f": "cas", "value": [1, 2],
+                           "process": 0})["type"] == "ok"
+    r = c.invoke(test, {"type": "invoke", "f": "read", "value": None,
+                        "process": 0})
+    assert r["type"] == "ok" and r["value"] is None
+    cmds = [p for _n, kind, p in test["remote"].actions
+            if kind == "execute"]
+    joined = "\n".join(cmds)
+    assert "-p /r0:1" in joined          # CAS precondition flag
+    assert "echo -n 2" in joined         # new value via stdin
+    assert f"-t {logcabin.OP_TIMEOUT}" in joined
+
+    class FailingRemote(control.DummyRemote):
+        def __init__(self, err):
+            super().__init__()
+            self.errmsg = err
+
+        def execute(self, spec, cmd, stdin=""):
+            super().execute(spec, cmd, stdin)
+            return control.Result("", self.errmsg, 1)
+
+    def classify(f, value, err):
+        t = dict(test)
+        t["remote"] = FailingRemote(err)
+        cl = logcabin.LogCabinClient("n1")
+        return cl.invoke(t, {"type": "invoke", "f": f, "value": value,
+                             "process": 0})
+
+    cas_err = ("Exiting due to LogCabin::Client::Exception: Path "
+               "'/r0' has value '3', not '1' as required")
+    out = classify("cas", [1, 2], cas_err)
+    assert out["type"] == "fail" and out["error"] == "cas-mismatch"
+
+    to_err = ("Exiting due to LogCabin::Client::Exception: "
+              "Client-specified timeout elapsed")
+    assert classify("write", 3, to_err)["error"] == "timed-out"
+    assert classify("write", 3, to_err)["type"] == "fail"
+
+    # any other failed write is indeterminate
+    assert classify("write", 3, "boom")["type"] == "info"
+    # reads never took effect; plain fail
+    assert classify("read", None, "boom")["type"] == "fail"
+
+
+def test_robustirc_topic_parsing_and_partial_backlog():
+    """Reads ride TOPIC broadcasts (reflected to the setter, unlike
+    PRIVMSG) and a sentinel terminates the drain; a stream that ends
+    without the sentinel is a partial backlog -> fail, never a
+    definitive short read."""
+    from fake_misc import FakeRobustIRCServer
+
+    tp = robustirc.RobustIRCClient._topic_payload
+    assert tp(":n1!j@h TOPIC #jepsen :17") == "17"
+    assert tp("TOPIC #jepsen :17") == "17"
+    assert tp(":n1!j@h PRIVMSG #jepsen :17") is None
+    assert tp("PING :abc") is None
+
+    with FakeRobustIRCServer() as srv:
+        test = {"db-hosts": {n: ("127.0.0.1", srv.port)
+                             for n in ("n1",)}}
+        c = robustirc.RobustIRCClient(tls=False).open(test, "n1")
+        # own adds are visible to the adder via topic reflection
+        assert c.invoke(test, {"type": "invoke", "f": "add",
+                               "value": 9, "process": 0})["type"] == "ok"
+        r = c.invoke(test, {"type": "invoke", "f": "read",
+                            "value": None, "process": 0})
+        assert r["type"] == "ok" and r["value"] == [9]
+
+        # drop the sentinel from the backlog: the read must refuse to
+        # report the partial drain as ok
+        real_append = srv.messages.append
+
+        class _Dropping(list):
+            def append(self, item):
+                if "end-" not in item:
+                    real_append(item)
+
+        srv.messages = _Dropping(srv.messages)
+        bad = c.invoke(test, {"type": "invoke", "f": "read",
+                              "value": None, "process": 0})
+        assert bad["type"] == "fail" and bad["error"] == "partial-backlog"
