@@ -29,6 +29,10 @@ import time
 from pathlib import Path
 
 
+def _accel(devices) -> bool:
+    return bool(devices) and devices[0].platform != "cpu"
+
+
 def bench_elle(n_dev: int, devices, reps: int) -> dict:
     import jax
     import numpy as np
@@ -39,9 +43,14 @@ def bench_elle(n_dev: int, devices, reps: int) -> dict:
     # 32 histories per device: the north-star regime is big batched
     # sweeps, and MXU utilization keeps climbing to ~B=32/dev
     # (8: ~43/s, 16: ~52/s, 32: ~59/s, 64: ~65/s on one v5e chip).
-    B = int(os.environ.get("BENCH_B", 32 * max(1, n_dev)))
-    T = int(os.environ.get("BENCH_T", 5000))
-    K = int(os.environ.get("BENCH_K", 64))
+    # On the CPU fallback (TPU transport down) the same shape would run
+    # for tens of minutes — scale down and let the "backend" field mark
+    # the number as not-the-headline.
+    accel = _accel(devices)
+    B = int(os.environ.get("BENCH_B",
+                           32 * max(1, n_dev) if accel else 8))
+    T = int(os.environ.get("BENCH_T", 5000 if accel else 512))
+    K = int(os.environ.get("BENCH_K", 64 if accel else 16))
 
     batch = synth.synth_valid_batch(B=B, T=T, K=K, seed=0)
     shape = batch["shape"]
@@ -78,11 +87,11 @@ def bench_elle(n_dev: int, devices, reps: int) -> dict:
     }
 
 
-def bench_knossos(reps: int) -> dict:
+def bench_knossos(reps: int, accel: bool = True) -> dict:
     from jepsen_tpu.checker import models
     from jepsen_tpu.checker.knossos import analysis, dense, synth
 
-    B = int(os.environ.get("BENCH_KN_B", 100))
+    B = int(os.environ.get("BENCH_KN_B", 100 if accel else 20))
     OPS = int(os.environ.get("BENCH_KN_OPS", 1000))
     CONC = int(os.environ.get("BENCH_KN_CONC", 10))
 
@@ -103,12 +112,57 @@ def bench_knossos(reps: int) -> dict:
         analysis(models.cas_register(), h)
     t_cpu = time.perf_counter() - t0
 
-    return {
+    out = {
         "metric": f"knossos-cas histories/sec ({OPS}-op, conc {CONC})",
         "tpu": round(B / best_tpu, 2),
         "cpu_wgl": round(B / t_cpu, 2),
         "unit": "histories/sec",
         "speedup_vs_cpu": round(t_cpu / best_tpu, 3),
+    }
+    try:
+        out["conc20"] = bench_knossos_conc20(reps, accel)
+    except Exception as e:
+        out["conc20"] = {"error": repr(e)[:200]}
+    return out
+
+
+def bench_knossos_conc20(reps: int, accel: bool = True) -> dict:
+    """Histories past the dense grid's 14-slot budget (VERDICT r2 item
+    10): concurrency 20 with indeterminate ops, routed through the
+    tiered device path (dense -> bounded frontier -> CPU re-run of
+    overflows) vs the CPU WGL engine, whose cost degenerates on exactly
+    this shape."""
+    from jepsen_tpu.checker import linearizable, models
+    from jepsen_tpu.checker.knossos import analysis, synth
+
+    B = int(os.environ.get("BENCH_KN20_B", 40 if accel else 8))
+    OPS = int(os.environ.get("BENCH_KN20_OPS", 400))
+    hists = synth.synth_register_batch(
+        B=B, n_ops=OPS, n_procs=20, info_prob=0.03, seed=7)
+
+    c = linearizable(models.cas_register(), backend="tpu")
+    res = c.check_batch({}, hists, {})          # compile + warm
+    analyzers = {}
+    for r in res:
+        analyzers[r.get("analyzer", "cpu")] = \
+            analyzers.get(r.get("analyzer", "cpu"), 0) + 1
+    best = float("inf")
+    for _ in range(max(2, reps // 2)):
+        t0 = time.perf_counter()
+        c.check_batch({}, hists, {})
+        best = min(best, time.perf_counter() - t0)
+
+    t0 = time.perf_counter()
+    cpu_res = [analysis(models.cas_register(), h) for h in hists]
+    t_cpu = time.perf_counter() - t0
+    assert [r["valid?"] for r in res] == [r["valid?"] for r in cpu_res]
+
+    return {
+        "metric": f"conc-20 {OPS}-op histories/sec (tiered device path)",
+        "tpu": round(B / best, 2),
+        "cpu_wgl": round(B / t_cpu, 2),
+        "speedup_vs_cpu": round(t_cpu / best, 3),
+        "tiers": analyzers,
     }
 
 
@@ -119,7 +173,7 @@ def bench_long_history(reps: int) -> dict:
     from jepsen_tpu import parallel
     from jepsen_tpu.checker.elle import synth
 
-    T = int(os.environ.get("BENCH_LONG_T", 50_000))
+    T = int(os.environ.get("BENCH_LONG_T", 50_000))  # host condensation
     enc = synth.synth_encoded_history(T, K=64)
     enc_bad = synth.synth_encoded_history(T, K=64, inject_cycle=True)
 
@@ -153,8 +207,9 @@ def bench_end_to_end(n_dev: int, devices) -> dict:
     from jepsen_tpu import ingest, parallel
     from jepsen_tpu.checker.elle import synth
 
-    B = int(os.environ.get("BENCH_E2E_B", 64))
-    T = int(os.environ.get("BENCH_E2E_T", 1000))
+    accel = _accel(devices)
+    B = int(os.environ.get("BENCH_E2E_B", 64 if accel else 16))
+    T = int(os.environ.get("BENCH_E2E_T", 1000 if accel else 384))
     root = Path(tempfile.mkdtemp(prefix="bench-e2e-"))
     try:
         import json as _json
@@ -193,26 +248,220 @@ def bench_end_to_end(n_dev: int, devices) -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
-def main() -> int:
-    from jepsen_tpu.devices import default_devices
+def _write_synth_store(root: Path, B: int, T: int, K: int,
+                       bad_every: int) -> list[Path]:
+    """Materialize B serial list-append runs as history.jsonl dirs —
+    the same execution shape as synth_encoded_history (txn i appends
+    (key (i+rot)%K, pos i//K+1) and externally reads a key it has seen),
+    written as raw JSON lines without per-op dict churn. Every
+    `bad_every`-th history gets one read observing a position one ahead
+    of commit order: a ww/wr (G1c) cycle for the classify pass to find."""
+    dirs = []
+    for h in range(B):
+        rot = h % K
+        corrupt = bad_every and h % bad_every == bad_every - 1
+        lines = []
+        for i in range(T):
+            ak = (i + rot) % K
+            ap = i // K + 1
+            rk = (i * 7 + 3 + rot) % K
+            first = (rk - rot) % K
+            rp = (i - 1 - first) // K + 1 if i > first else 0
+            if corrupt and i == T // 2:
+                rk, rp = ak, ap + 1
+            obs = list(range(1, rp + 1))
+            p = i % 5
+            lines.append(
+                f'{{"type":"invoke","process":{p},"f":"txn",'
+                f'"value":[["append",{ak},{ap}],["r",{rk},null]],'
+                f'"time":{2 * i * 1000},"index":{2 * i}}}')
+            lines.append(
+                f'{{"type":"ok","process":{p},"f":"txn",'
+                f'"value":[["append",{ak},{ap}],["r",{rk},{obs}]],'
+                f'"time":{(2 * i + 1) * 1000},"index":{2 * i + 1}}}')
+        d = root / f"run-{h:05d}"
+        d.mkdir()
+        (d / "history.jsonl").write_text("\n".join(lines) + "\n")
+        dirs.append(d)
+    return dirs
 
-    devices = default_devices()
+
+def bench_north_star(n_dev: int, devices) -> dict:
+    """BASELINE.json's target shape, end to end through analyze-store
+    semantics: a store of 10k-op (5k-txn) list-append histories (1%
+    seeded with a G1c cycle) -> process-pool ingest -> detect sweep ->
+    classify re-dispatch of the positives -> rendered verdicts. Reports
+    histories/sec against the north-star fair share (10k histories/60 s,
+    chip-scaled) and an MFU estimate from the closure FLOPs model."""
+    import shutil
+    import tempfile
+
+    from jepsen_tpu import ingest, parallel
+    from jepsen_tpu.checker import elle
+    from jepsen_tpu.checker.elle import kernels as K_
+
+    accel = _accel(devices)
+    many_cores = (os.cpu_count() or 1) >= 8
+    B = int(os.environ.get("BENCH_NS_B",
+                           1000 if accel and many_cores else
+                           256 if accel else 12))
+    T = int(os.environ.get("BENCH_NS_T", 5000 if accel else 384))
+    K = int(os.environ.get("BENCH_NS_K", 64 if accel else 16))
+    budget = int(os.environ.get("BENCH_NS_BUDGET",
+                                1 << 30 if accel else 1 << 27))
+    bad_every = int(os.environ.get("BENCH_NS_BAD_EVERY",
+                                   min(100, max(2, B // 6))))
+
+    root = Path(tempfile.mkdtemp(prefix="bench-ns-"))
+    try:
+        dirs = _write_synth_store(root, B, T, K, bad_every)
+        mesh = parallel.make_mesh(devices) if n_dev > 1 else None
+        prohibited = elle.AppendChecker().prohibited
+
+        t0 = time.perf_counter()
+        encs = ingest.parallel_encode(dirs, checker="append")
+        t_ingest = time.perf_counter() - t0
+        bad = [e for e in encs if isinstance(e, Exception)]
+        assert not bad, bad[:1]
+
+        # Warm the (bucket-shaped) compile caches outside the timed
+        # region: one compile amortizes over the whole sweep in a real
+        # 10k-history store.
+        warm = encs[:max(1, (mesh.devices.shape[0] if mesh else 1))]
+        parallel.check_bucketed(warm, mesh, budget_cells=budget)
+        parallel.check_bucketed([encs[bad_every - 1]] if bad_every and
+                                len(encs) >= bad_every else warm,
+                                mesh, budget_cells=budget)
+
+        t0 = time.perf_counter()
+        cycles = parallel.check_bucketed(encs, mesh, budget_cells=budget)
+        t_check = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        verdicts = [elle.render_verdict(e, c, prohibited)
+                    for e, c in zip(encs, cycles)]
+        t_render = time.perf_counter() - t0
+
+        n_bad = sum(1 for v in verdicts if v["valid?"] is False)
+        expect_bad = B // bad_every if bad_every else 0
+        assert n_bad == expect_bad, (n_bad, expect_bad)
+        assert all("G1c" in v["anomaly-types"] for v in verdicts
+                   if v["valid?"] is False)
+
+        total = t_ingest + t_check + t_render
+        rate = B / total
+        target = 10_000 / 60.0 * (n_dev / 8.0)
+        # MFU from the closure FLOPs model: the detect pass squares one
+        # [T_pad, T_pad] bf16 matrix ~`rounds` times per history at
+        # 2·T³ FLOPs per squaring (assumed rounds below — the kernel
+        # early-exits at the fixpoint, measured 4-6 on this shape).
+        t_pad = K_.pad_to(T, 128)
+        rounds = float(os.environ.get("BENCH_NS_ROUNDS", 5))
+        peak = float(os.environ.get("BENCH_PEAK_TFLOPS", 197)) * 1e12
+        mfu = (B * rounds * 2 * t_pad ** 3) / (t_check * peak * n_dev) \
+            if accel else None
+        return {
+            "metric": f"north-star store->verdict histories/sec "
+                      f"({B}x{T}-txn, {n_dev} dev)",
+            "value": round(rate, 2),
+            "unit": "histories/sec",
+            "vs_baseline": round(rate / target, 3),
+            "ingest_secs": round(t_ingest, 3),
+            "check_secs": round(t_check, 3),
+            "render_secs": round(t_render, 3),
+            "invalid_found": n_bad,
+            "mfu_estimate": round(mfu, 4) if mfu is not None else None,
+            "mfu_model": f"{rounds:g} rounds x 2T^3 bf16, "
+                         f"peak {peak / 1e12:g} TF/chip",
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def run_benches() -> int:
+    """The child-process body: probe-guarded device init, then every
+    bench phase, one JSON line out. Any failure still reports."""
+    from jepsen_tpu import devices as devmod
+
+    try:
+        devices = devmod.default_devices(probe=True)
+    except Exception as e:
+        print(json.dumps({
+            "metric": "elle-append histories/sec", "value": 0.0,
+            "unit": "histories/sec", "vs_baseline": 0.0,
+            "error": f"device init failed: {e!r}"[:300]}))
+        return 0
     n_dev = len(devices)
+    platform = devices[0].platform if devices else "none"
     reps = int(os.environ.get("BENCH_REPS", 5))
 
-    out = bench_elle(n_dev, devices, reps)
     try:
-        out["knossos"] = bench_knossos(reps)
-    except Exception as e:  # elle metric must still report
-        out["knossos"] = {"error": repr(e)[:200]}
-    try:
-        out["long_history"] = bench_long_history(reps)
+        out = bench_elle(n_dev, devices, reps)
     except Exception as e:
-        out["long_history"] = {"error": repr(e)[:200]}
-    try:
-        out["end_to_end"] = bench_end_to_end(n_dev, devices)
-    except Exception as e:
-        out["end_to_end"] = {"error": repr(e)[:200]}
+        out = {"metric": f"elle-append histories/sec ({n_dev} dev)",
+               "value": 0.0, "unit": "histories/sec", "vs_baseline": 0.0,
+               "error": repr(e)[:300]}
+    out["backend"] = platform
+    if devmod.backend_error:
+        out["tpu_error"] = devmod.backend_error
+    for name, fn, args in (
+            ("knossos", bench_knossos, (reps, _accel(devices))),
+            ("long_history", bench_long_history, (reps,)),
+            ("end_to_end", bench_end_to_end, (n_dev, devices)),
+            ("north_star", bench_north_star, (n_dev, devices))):
+        try:
+            out[name] = fn(*args)
+        except Exception as e:  # the elle metric must still report
+            out[name] = {"error": repr(e)[:200]}
+    print(json.dumps(out))
+    return 0
+
+
+def main() -> int:
+    """Supervisor: run the benches in a CHILD process under a wall-clock
+    budget, and on timeout/crash retry once pinned to CPU.
+
+    The bounded in-child probe is necessary but not sufficient: a flaky
+    TPU tunnel can pass the probe and then wedge the child's own
+    backend init (or wedge mid-bench), and a process stuck inside PJRT
+    client creation ignores signals and can't free itself. Only a
+    supervisor that never touches JAX can guarantee the driver always
+    gets a JSON line (round 2 recorded rc=1 and zero perf evidence)."""
+    if os.environ.get("BENCH_CHILD"):
+        return run_benches()
+
+    import subprocess
+
+    budget = float(os.environ.get("BENCH_TIMEOUT", 2400))
+    cpu_budget = float(os.environ.get("BENCH_CPU_TIMEOUT", 1500))
+
+    def attempt(env_extra: dict, timeout: float):
+        env = {**os.environ, "BENCH_CHILD": "1", **env_extra}
+        try:
+            p = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                               capture_output=True, text=True,
+                               timeout=timeout, env=env)
+        except subprocess.TimeoutExpired:
+            return None, f"bench child exceeded {timeout:.0f}s"
+        for line in reversed((p.stdout or "").strip().splitlines()):
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                continue
+        tail = (p.stderr or "").strip().splitlines()[-3:]
+        return None, (f"bench child rc={p.returncode}: "
+                      + " | ".join(tail))[:400]
+
+    out, err = attempt({}, budget)
+    if out is None:
+        cpu_env = {"JEPSEN_TPU_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu"}
+        out, err2 = attempt(cpu_env, cpu_budget)
+        if out is None:
+            out = {"metric": "elle-append histories/sec", "value": 0.0,
+                   "unit": "histories/sec", "vs_baseline": 0.0,
+                   "error": f"tpu attempt: {err}; cpu attempt: {err2}"}
+        else:
+            out["backend"] = "cpu"
+            out["tpu_error"] = err
     print(json.dumps(out))
     return 0
 

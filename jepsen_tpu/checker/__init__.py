@@ -531,7 +531,7 @@ class Linearizable(Checker):
     verdicts only ever degrade to the oracle, never diverge from it."""
 
     def __init__(self, m: model.Model | None = None,
-                 algorithm: str = "competition", backend: str = "cpu"):
+                 algorithm: str = "competition", backend: str = "auto"):
         self.model = m if m is not None else model.cas_register()
         self.algorithm = algorithm
         self.backend = backend
@@ -567,32 +567,54 @@ class Linearizable(Checker):
         `independent.checker` to shard per-key subhistories across the
         device mesh instead of pmapping JVM threads.
 
-        The device engine is the dense-bitset config-grid kernel
-        (`.knossos.dense`) — exact verdicts, no frontier overflow;
-        histories that exceed its slot/value grid budget (or aren't
-        register-shaped at all) fall back to the CPU WGL oracle. The
-        kernel implements CAS-register semantics from a nil initial
-        state, so any other model routes to CPU wholesale."""
-        if self.backend != "tpu" or not (
-                type(self.model) is model.CASRegister
+        Device routing is tiered: (1) the dense-bitset config-grid
+        kernel (`.knossos.dense`) — exact verdicts, no frontier
+        overflow — for histories inside its slot/value grid budget;
+        (2) histories past the grid (e.g. >14 concurrently-pending
+        ops) route to the bounded sorted-frontier kernel
+        (`.knossos.kernels`), whose cost scales with the frontier
+        arena, not 2^slots; its rare ":frontier-overflow" unknowns
+        (3) re-run on the CPU WGL oracle, as does anything not
+        register-shaped at all. The kernels implement CAS-register
+        semantics from a nil initial state, so any other model routes
+        to CPU wholesale. Verdicts only ever degrade toward the
+        oracle, never diverge from it."""
+        # Model eligibility first: resolving an auto backend may probe
+        # the hardware (bounded, but up to JEPSEN_TPU_PROBE_TIMEOUT on a
+        # dead transport) — pointless when only the CPU path can apply.
+        if not (type(self.model) is model.CASRegister
                 and self.model.value is None):
             return [self._cpu(hs) for hs in histories]
-        from .knossos import dense
+        from ..devices import resolve_backend
+        if resolve_backend(self.backend) != "tpu":
+            return [self._cpu(hs) for hs in histories]
+        from .knossos import dense, kernels
         from .knossos import encode as kenc
-        encs = []
+        dense_encs, dense_idx = [], []
+        front_encs, front_idx = [], []
         cpu_idx = []
-        enc_idx = []
         for i, hs in enumerate(histories):
             try:
-                encs.append(dense.encode_dense_history(hs))
-                enc_idx.append(i)
+                dense_encs.append(dense.encode_dense_history(hs))
+                dense_idx.append(i)
             except kenc.EncodingError:
-                cpu_idx.append(i)
+                try:
+                    front_encs.append(kenc.encode_register_history(hs))
+                    front_idx.append(i)
+                except kenc.EncodingError:
+                    cpu_idx.append(i)
         results: list[dict | None] = [None] * len(histories)
-        if encs:
-            for i, r in zip(enc_idx,
-                            dense.check_encoded_dense_batch(encs)):
+        if dense_encs:
+            for i, r in zip(dense_idx,
+                            dense.check_encoded_dense_batch(dense_encs)):
                 results[i] = r
+        if front_encs:
+            for i, r in zip(front_idx,
+                            kernels.check_encoded_batch(front_encs)):
+                if r.get("valid?") == "unknown":
+                    cpu_idx.append(i)  # overflow: exact answer from CPU
+                else:
+                    results[i] = r
         for i in cpu_idx:
             results[i] = self._cpu(histories[i])
         return results  # type: ignore[return-value]
@@ -600,7 +622,7 @@ class Linearizable(Checker):
 
 def linearizable(m: model.Model | None = None,
                  algorithm: str = "competition",
-                 backend: str = "cpu", **kw) -> Checker:
+                 backend: str = "auto", **kw) -> Checker:
     return Linearizable(m, algorithm=algorithm, backend=backend, **kw)
 
 

@@ -99,13 +99,14 @@ class AppendChecker(Checker):
     Options:
       anomalies:      which anomaly classes to prohibit (default G1+G2,
                       like the reference wrapper append.clj:14-16)
-      backend:        "cpu" | "tpu"
+      backend:        "auto" (device kernels when an accelerator is
+                      reachable, else the CPU oracle) | "cpu" | "tpu"
       realtime:       add realtime (strict-serializability) edges
       process_order:  add per-process order edges
     """
 
     def __init__(self, anomalies: Iterable[str] = ("G1", "G2"),
-                 backend: str = "cpu", realtime: bool = False,
+                 backend: str = "auto", realtime: bool = False,
                  process_order: bool = False):
         self.prohibited = expand_anomalies(anomalies)
         self.backend = backend
@@ -113,14 +114,16 @@ class AppendChecker(Checker):
         self.process_order = process_order
 
     def check(self, test, history, opts):
+        from ...devices import resolve_backend
+        backend = resolve_backend(self.backend)
         enc = encode_history(history)
-        find = (cycle_anomalies_tpu if self.backend == "tpu"
+        find = (cycle_anomalies_tpu if backend == "tpu"
                 else cycle_anomalies_cpu)
         cycles = find(enc, realtime=self.realtime,
                       process_order=self.process_order)
         from . import artifacts
         divergent: dict = {}
-        if self.backend == "tpu" and cycles:
+        if backend == "tpu" and cycles:
             # Device path returns anomaly FLAGS; flagged histories run
             # the host pass for witness cycles (rare positives — the
             # fast path stays on device).
@@ -133,6 +136,6 @@ class AppendChecker(Checker):
 
 
 def append_checker(anomalies: Iterable[str] = ("G1", "G2"),
-                   backend: str = "cpu", realtime: bool = False,
+                   backend: str = "auto", realtime: bool = False,
                    process_order: bool = False) -> Checker:
     return AppendChecker(anomalies, backend, realtime, process_order)

@@ -413,7 +413,7 @@ class WrChecker(Checker):
 
     def __init__(self, anomalies: Iterable[str] = ("G2", "G1a", "G1b",
                                                    "internal"),
-                 backend: str = "cpu", sequential_keys: bool = False,
+                 backend: str = "auto", sequential_keys: bool = False,
                  linearizable_keys: bool = False, wfr_keys: bool = False,
                  realtime: bool = False, process_order: bool = False):
         self.prohibited = frozenset().union(
@@ -427,14 +427,16 @@ class WrChecker(Checker):
         self.process_order = process_order
 
     def check(self, test, history, opts):
+        from ...devices import resolve_backend
+        backend = resolve_backend(self.backend)
         enc = encode_wr_history(history, **self.opts)
-        find = (cycle_anomalies_tpu if self.backend == "tpu"
+        find = (cycle_anomalies_tpu if backend == "tpu"
                 else cycle_anomalies_cpu)
         cycles = find(enc, realtime=self.realtime,
                       process_order=self.process_order)
         from . import artifacts
         divergent: dict = {}
-        if self.backend == "tpu" and cycles:
+        if backend == "tpu" and cycles:
             cycles, divergent = artifacts.device_host_refine(
                 cycles, lambda: cycle_anomalies_cpu(
                     enc, realtime=self.realtime,
@@ -445,5 +447,5 @@ class WrChecker(Checker):
 
 def rw_register_checker(anomalies: Iterable[str] = ("G2", "G1a", "G1b",
                                                     "internal"),
-                        backend: str = "cpu", **kw: Any) -> Checker:
+                        backend: str = "auto", **kw: Any) -> Checker:
     return WrChecker(anomalies, backend, **kw)

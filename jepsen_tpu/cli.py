@@ -53,6 +53,12 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
     p.add_argument("--test-count", type=int, default=1)
     p.add_argument("--leave-db-running", action="store_true")
     p.add_argument("--store", default="store", help="store directory")
+    p.add_argument("--backend", default="auto",
+                   choices=["auto", "tpu", "cpu"],
+                   help="analysis backend: device kernels (tpu), host "
+                        "oracles (cpu), or pick by hardware (auto — "
+                        "the default; the north star's :backend :tpu "
+                        "is the production path when a chip is up)")
 
 
 def test_map_from_args(args: argparse.Namespace) -> dict:
@@ -62,6 +68,7 @@ def test_map_from_args(args: argparse.Namespace) -> dict:
                   Path(args.nodes_file).read_text().splitlines()
                   if ln.strip()]
     t: dict = {
+        "backend": getattr(args, "backend", "auto"),
         "concurrency": args.concurrency,
         "time_limit": args.time_limit,
         "leave_db_running": args.leave_db_running,
@@ -121,6 +128,8 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
                               "run's own checker")
     p_batch.add_argument("--name", default=None,
                          help="only runs of this test name")
+    p_batch.add_argument("--backend", default="auto",
+                         choices=["auto", "tpu", "cpu"])
 
     p_serve = sub.add_parser("serve", help="serve the store over HTTP")
     p_serve.add_argument("--port", type=int, default=8080)
@@ -135,6 +144,12 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s [%(name)s] %(message)s")
+
+    # Every auto-backend checker constructed from here on resolves per
+    # this process-wide choice (devices.resolve_backend).
+    if getattr(args, "backend", None) and args.backend != "auto":
+        import os
+        os.environ["JEPSEN_TPU_BACKEND"] = args.backend
 
     try:
         if args.command == "test":

@@ -3,41 +3,120 @@
 The default JAX backend wins (a real TPU slice when present), but:
   * JEPSEN_TPU_PLATFORM=cpu|tpu|... pins a platform explicitly (tests pin
     cpu so the 8-device virtual host mesh is used even on machines where
-    a TPU plugin registers itself regardless of JAX_PLATFORMS), and
+    a TPU plugin registers itself regardless of JAX_PLATFORMS),
   * a minimum device count can be requested — if the preferred backend is
     smaller, we fall back to the host-platform devices, which honors
-    --xla_force_host_platform_device_count virtual meshes.
+    --xla_force_host_platform_device_count virtual meshes, and
+  * initialization of an UNPINNED default backend is guarded by a
+    bounded subprocess probe: a TPU plugin whose transport is down can
+    hang `jax.devices()` indefinitely (it did, for 9+ minutes, in the
+    round-2 bench), and a benchmark/checker must degrade to CPU with a
+    structured error instead of hanging. The probe runs once per
+    process and is memoized.
 """
 
 from __future__ import annotations
 
 import os
+import subprocess
+import sys
+
+# Result of the one-shot default-backend probe: None = not yet run,
+# (True, None) = healthy, (False, "err...") = dead/unreachable.
+_probe_result: tuple[bool, str | None] | None = None
+
+# Why the last default_devices() call fell back to CPU (None if it
+# didn't). Benchmarks surface this in their structured output.
+backend_error: str | None = None
 
 
-def _pin_requested_platform() -> str | None:
-    """Honor an explicit platform request even when a plugin (e.g. the
-    axon TPU tunnel) has force-updated the jax_platforms config from
-    sitecustomize, overriding the JAX_PLATFORMS env var. Without the
-    re-pin, merely creating an array initializes every configured
-    backend — and a dead tunnel hangs the process."""
+def probe_timeout() -> float:
+    return float(os.environ.get("JEPSEN_TPU_PROBE_TIMEOUT", "120"))
+
+
+def _backends_already_alive() -> bool:
+    """True when this process already initialized JAX backends — probing
+    again would be pure waste (and the hang risk is already behind us)."""
+    try:
+        from jax._src import xla_bridge
+        return bool(xla_bridge._backends)
+    except Exception:
+        return False
+
+
+def probe_default_backend(timeout: float | None = None) -> tuple[bool, str | None]:
+    """Initialize the default JAX backend in a THROWAWAY subprocess with a
+    wall-clock bound. Returns (ok, error). Memoized per process.
+
+    This is the only safe way to ask "is the TPU tunnel alive?": doing it
+    in-process risks wedging the caller forever, because backend init
+    holds the lock `jax.devices()` needs and a dead transport never
+    returns."""
+    global _probe_result
+    if _probe_result is not None:
+        return _probe_result
+    if _backends_already_alive():
+        _probe_result = (True, None)
+        return _probe_result
+    timeout = probe_timeout() if timeout is None else timeout
+    code = ("import jax; d = jax.devices(); "
+            "print('JEPSEN_PROBE_OK', len(d), d[0].platform)")
+    try:
+        p = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=timeout)
+        if p.returncode == 0 and "JEPSEN_PROBE_OK" in p.stdout:
+            _probe_result = (True, None)
+        else:
+            tail = (p.stderr or p.stdout).strip().splitlines()[-1:]
+            _probe_result = (False, f"backend init failed (rc={p.returncode}): "
+                                    f"{' '.join(tail)[:300]}")
+    except subprocess.TimeoutExpired:
+        _probe_result = (False, f"backend init hung > {timeout:.0f}s "
+                                "(transport down?); falling back to cpu")
+    except Exception as e:  # probe infrastructure itself failed
+        _probe_result = (False, f"probe error: {e!r}"[:300])
+    return _probe_result
+
+
+def _pin_platform(want: str) -> None:
+    """Pin jax_platforms even when a plugin (e.g. a TPU tunnel) has
+    force-updated the config from sitecustomize, overriding the
+    JAX_PLATFORMS env var. Without the re-pin, merely creating an array
+    initializes every configured backend — and a dead tunnel hangs the
+    process."""
     import jax
-
-    plat = os.environ.get("JEPSEN_TPU_PLATFORM")
-    want = plat or os.environ.get("JAX_PLATFORMS")
-    if want and "axon" not in want and jax.config.jax_platforms != want:
+    if jax.config.jax_platforms != want:
         try:
             jax.config.update("jax_platforms", want)
         except Exception:
             pass
+
+
+def _requested_platform() -> str | None:
+    plat = os.environ.get("JEPSEN_TPU_PLATFORM")
+    want = plat or os.environ.get("JAX_PLATFORMS")
+    if want and "axon" not in want:
+        _pin_platform(want)
     return plat
 
 
-def default_devices(min_count: int = 1) -> list:
+def default_devices(min_count: int = 1, *, probe: bool = False) -> list:
+    """The analysis devices. With probe=True (benchmarks, `auto` checker
+    backends), an unpinned default backend is first health-checked in a
+    bounded subprocess; on failure we pin cpu and record the reason in
+    `devices.backend_error` instead of hanging."""
+    global backend_error
     import jax
 
-    plat = _pin_requested_platform()
+    plat = _requested_platform()
     if plat:
         return jax.devices(plat)
+    if probe and not os.environ.get("JAX_PLATFORMS"):
+        ok, err = probe_default_backend()
+        if not ok:
+            backend_error = err
+            _pin_platform("cpu")
+            return jax.devices("cpu")
     devs = jax.devices()
     if len(devs) < min_count:
         try:
@@ -47,3 +126,34 @@ def default_devices(min_count: int = 1) -> list:
         except RuntimeError:
             pass
     return devs
+
+
+def device_platform(devices: list | None = None) -> str:
+    devs = devices if devices is not None else default_devices(probe=True)
+    return devs[0].platform if devs else "none"
+
+
+def accelerator_available() -> bool:
+    """True when a non-CPU backend is reachable — the `auto` checker
+    backend resolves to the device kernels exactly when this holds.
+    Bounded: never hangs on a dead transport."""
+    try:
+        return device_platform() != "cpu"
+    except Exception:
+        return False
+
+
+def resolve_backend(backend: str = "auto") -> str:
+    """Resolve a checker backend choice to "tpu" (device kernels) or
+    "cpu" (host oracles). "auto" — the default everywhere, mirroring the
+    north star's `:backend :tpu` becoming the production analysis path —
+    picks the device kernels when an accelerator is reachable, else the
+    CPU oracle. JEPSEN_TPU_BACKEND overrides the auto resolution (the
+    CLI's --backend flag sets it; tests force the device path on the
+    virtual CPU mesh with it)."""
+    if backend != "auto":
+        return backend
+    env = os.environ.get("JEPSEN_TPU_BACKEND")
+    if env and env != "auto":
+        return env
+    return "tpu" if accelerator_available() else "cpu"

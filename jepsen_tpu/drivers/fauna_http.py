@@ -224,7 +224,7 @@ def decode(j: Any) -> Any:
         if "@set" in j:
             return decode(j["@set"])
         if "@ts" in j or "@date" in j:
-            return j.get("@ts") or j.get("@date")
+            return j["@ts"] if "@ts" in j else j["@date"]
         return {k: decode(v) for k, v in j.items()}
     if isinstance(j, list):
         return [decode(v) for v in j]
@@ -261,7 +261,12 @@ class FaunaConn:
                 errs = json.loads(e.read()).get("errors") or []
             except Exception:
                 errs = []
-            if errs:
+            # Only 4xx responses are definite rejections. 5xx (internal
+            # error / unavailable) may have committed server-side — the
+            # reference maps InternalException/UnavailableException to
+            # indeterminate :info (faunadb client.clj with-errors), so
+            # raise DriverError and let invoke classify writes as info.
+            if errs and e.code < 500:
                 first = errs[0]
                 desc = "; ".join(
                     f"{x.get('code', '?')}: {x.get('description', '')}"
@@ -275,8 +280,29 @@ class FaunaConn:
         return decode(out["resource"])
 
     def query_all(self, set_expr, size: int = 1024) -> list:
-        """Paginate a set expression to exhaustion (client.clj's
-        query-all: follow the `after` cursor)."""
+        """Paginate a set expression to exhaustion at ONE snapshot
+        (client.clj's query-all): the first request pins a timestamp
+        with time('now'), and every page — including the first — runs
+        inside at(ts, ...), so a multi-page read under concurrent
+        writes stays snapshot-consistent. (The explicitly
+        non-transactional variant is query_all_naive.)"""
+        # decode() strips the @ts tag to a plain ISO string; re-tag it
+        # with time() or at() would receive a bare string literal.
+        ts = time(self.query(time("now")))
+        out: list = []
+        after = None
+        while True:
+            page = self.query(
+                at(ts, paginate(set_expr, size=size, after=after)))
+            out.extend(page.get("data", []))
+            after = page.get("after")
+            if not after:
+                return out
+
+    def query_all_naive(self, set_expr, size: int = 1024) -> list:
+        """Cursor-follow with a fresh transaction per page (the
+        reference's query-all-naive) — pagination-isolation anomalies
+        become visible; the pages workload wants exactly that."""
         out: list = []
         after = None
         while True:

@@ -185,11 +185,38 @@ def bucket_by_length(encs: Sequence, *, multiple: int = 128,
 def check_bucketed(encs: Sequence, mesh: Mesh | None = None, *,
                    classify: bool = True, realtime: bool = False,
                    process_order: bool = False,
-                   budget_cells: int = 1 << 27) -> list[dict]:
+                   budget_cells: int = 1 << 27,
+                   two_pass: bool | None = None) -> list[dict]:
     """Check many encoded histories bucketed by length: one device
-    dispatch per bucket, results returned in input order."""
+    dispatch per bucket, results returned in input order.
+
+    With classify=True the default strategy is detect-then-classify
+    (two_pass): sweep every bucket in detect mode (one closure per
+    history), then re-dispatch ONLY the flagged histories with the
+    classification closures. On the production regime — sweeps that are
+    mostly valid — this pays the ~3x classify cost only for the rare
+    positives, so the sweep runs at the detect rate; verdicts are
+    identical because a cycle-free graph classifies to zero flags."""
     if not len(encs):
         return []
+    if two_pass is None:
+        two_pass = classify
+    if classify and two_pass:
+        detect = check_bucketed(encs, mesh, classify=False,
+                                realtime=realtime,
+                                process_order=process_order,
+                                budget_cells=budget_cells)
+        flagged = [i for i, f in enumerate(detect) if f]
+        if not flagged:
+            return detect
+        full = check_bucketed([encs[i] for i in flagged], mesh,
+                              classify=True, realtime=realtime,
+                              process_order=process_order,
+                              budget_cells=budget_cells, two_pass=False)
+        out = list(detect)
+        for i, r in zip(flagged, full):
+            out[i] = r
+        return out
     out: list[dict | None] = [None] * len(encs)
     dp = mesh.devices.shape[0] if mesh is not None else 1
     for bucket in bucket_by_length(encs, budget_cells=budget_cells, dp=dp):

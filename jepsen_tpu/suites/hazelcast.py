@@ -2,20 +2,30 @@
 
 Counterpart of hazelcast/src/jepsen/hazelcast.clj (821 LoC + the
 SetUnionMergePolicy.java server extension): an embedded-jar server
-started per node with a TCP/IP member list, driven through locks,
-queues, CRDT-ish sets and unique-id generators. The client protocol is
-Hazelcast's JVM binary protocol — pluggable (pass ``client``);
-install/daemon/workload wiring is complete.
+started per node with a TCP/IP member list, driven over the Open
+Client Protocol (drivers/hazelcast_proto.py) through the reference's
+distinctive workload menu — locks (+ the no-quorum variant,
+hazelcast.clj:412-449 & 652-677), queues with total-queue accounting
+(270-296, 756), atomic-long unique ids (146-161, 766-770), and the
+map/crdt-map set-union CAS workloads that exercise the shipped
+SetUnionMergePolicy (453-509).
 """
 
 from __future__ import annotations
 
+from .. import checker as jchecker
 from .. import cli as jcli
+from .. import client as jclient
 from .. import control
 from .. import db as jdb
+from .. import generator as gen
 from .. import nemesis as jnemesis, os_setup
+from ..checker import models
 from ..control import util as cutil
-from . import base_opts, standard_workloads, suite_test
+from ..drivers import DriverError
+from ..drivers import hazelcast_proto as hz
+from ..workloads import queue as queue_wl
+from . import base_opts, suite_test
 
 DIR = "/opt/hazelcast"
 VERSION = "3.10.3"
@@ -87,16 +97,214 @@ class HazelcastDB(jdb.DB, jdb.LogFiles):
         return [LOGFILE]
 
 
+# ---------------------------------------------------------------------------
+# wire clients (hazelcast.clj:146-161, 270-296, 412-449, 453-509)
+# ---------------------------------------------------------------------------
+
+class _HzClient(jclient.Client):
+    """Shared connection plumbing: one HzConn per open, DriverError ->
+    indeterminate for mutations (the reference's IOException handling,
+    hazelcast.clj:439-446)."""
+
+    port = 5701
+
+    def __init__(self, conn: hz.HzConn | None = None,
+                 port: int | None = None):
+        self.conn = conn
+        if port is not None:
+            self.port = port
+
+    def _open(self, node: str) -> hz.HzConn:
+        return hz.HzConn(node, self.port)
+
+    def open(self, test, node):
+        c = type(self)(self._open(node), port=self.port)
+        return c
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+
+class LockClient(_HzClient):
+    """ILock acquire/release (lock-client, hazelcast.clj:412-449):
+    acquire = tryLock(5s) -> ok/fail; release = unlock, with
+    not-lock-owner and quorum failures classified like the reference."""
+
+    lock_name = "jepsen.lock"
+
+    def __init__(self, conn=None, port=None, lock_name=None):
+        super().__init__(conn, port)
+        if lock_name is not None:
+            self.lock_name = lock_name
+
+    def open(self, test, node):
+        c = type(self)(self._open(node), port=self.port,
+                       lock_name=self.lock_name)
+        return c
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "acquire":
+                ok = self.conn.lock_try_lock(self.lock_name, 5000)
+                return {**op, "type": "ok" if ok else "fail"}
+            if op["f"] == "release":
+                self.conn.lock_unlock(self.lock_name)
+                return {**op, "type": "ok"}
+            return {**op, "type": "fail", "error": f"bad f {op['f']!r}"}
+        except hz.HazelcastError as e:
+            msg = str(e)
+            if "not owner of the lock" in msg or \
+                    "IllegalMonitorStateException" in msg:
+                return {**op, "type": "fail", "error": "not-lock-owner"}
+            if "QuorumException" in msg:
+                return {**op, "type": "fail", "error": "quorum"}
+            raise
+        except DriverError as e:
+            # acquire that never reached the cluster still may have:
+            # indeterminate either way (a lost unlock matters too)
+            return {**op, "type": "info", "error": str(e)[:120]}
+
+
+class QueueClient(_HzClient):
+    """IQueue enqueue/dequeue/drain with total-queue accounting
+    (queue-client, hazelcast.clj:270-296)."""
+
+    queue_name = "jepsen.queue"
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "enqueue":
+                ok = self.conn.queue_offer(self.queue_name, op["value"])
+                return {**op, "type": "ok" if ok else "fail"}
+            if op["f"] == "dequeue":
+                v = self.conn.queue_poll(self.queue_name)
+                if v is None:
+                    return {**op, "type": "fail", "error": "empty"}
+                return {**op, "type": "ok", "value": v}
+            if op["f"] == "drain":
+                out = []
+                while True:
+                    v = self.conn.queue_poll(self.queue_name)
+                    if v is None:
+                        return {**op, "type": "ok", "value": out}
+                    out.append(v)
+            return {**op, "type": "fail", "error": f"bad f {op['f']!r}"}
+        except DriverError as e:
+            return {**op, "type": "info", "error": str(e)[:120]}
+
+
+class AtomicLongIdClient(_HzClient):
+    """IAtomicLong unique-id generation (atomic-long-id-client,
+    hazelcast.clj:146-161)."""
+
+    counter_name = "jepsen.atomic-long"
+
+    def invoke(self, test, op):
+        assert op["f"] == "generate", op
+        try:
+            v = self.conn.atomic_long_increment_and_get(self.counter_name)
+            return {**op, "type": "ok", "value": v}
+        except DriverError as e:
+            return {**op, "type": "info", "error": str(e)[:120]}
+
+
+class MapSetClient(_HzClient):
+    """Grow-only set in an IMap under one key via CAS on a sorted long
+    array (map-client, hazelcast.clj:453-491). With crdt=True the map
+    is the one whose split-brain merges run the shipped
+    SetUnionMergePolicy (the <map name="jepsen*"> registration in
+    HazelcastDB.setup)."""
+
+    def __init__(self, conn=None, port=None, crdt: bool = True):
+        super().__init__(conn, port)
+        self.crdt = crdt
+        self.map_name = "jepsen.crdt-map" if crdt else "jepsen.map"
+
+    def open(self, test, node):
+        return type(self)(self._open(node), port=self.port,
+                          crdt=self.crdt)
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "add":
+                cur = self.conn.map_get(self.map_name, "hi")
+                if cur is not None:
+                    new = sorted(set(cur) | {op["value"]})
+                    ok = self.conn.map_replace_if_same(
+                        self.map_name, "hi", cur, new)
+                    if ok:
+                        return {**op, "type": "ok"}
+                    return {**op, "type": "fail", "error": "cas-failed"}
+                prev = self.conn.map_put_if_absent(
+                    self.map_name, "hi", [op["value"]])
+                if prev is None:
+                    return {**op, "type": "ok"}
+                return {**op, "type": "fail", "error": "cas-failed"}
+            if op["f"] == "read":
+                cur = self.conn.map_get(self.map_name, "hi")
+                return {**op, "type": "ok",
+                        "value": sorted(set(cur or []))}
+            return {**op, "type": "fail", "error": f"bad f {op['f']!r}"}
+        except DriverError as e:
+            crash = "fail" if op["f"] == "read" else "info"
+            return {**op, "type": crash, "error": str(e)[:120]}
+
+
+# ---------------------------------------------------------------------------
+# workloads (hazelcast.clj:652-777)
+# ---------------------------------------------------------------------------
+
+def _lock_workload(lock_name: str) -> dict:
+    return {
+        "client": LockClient(lock_name=lock_name),
+        "generator": gen.each_thread(gen.stagger(0.1, gen.cycle(
+            gen.Seq.of([{"type": "invoke", "f": "acquire"},
+                        {"type": "invoke", "f": "release"}])))),
+        "checker": jchecker.linearizable(models.mutex()),
+    }
+
+
+def _map_workload(crdt: bool) -> dict:
+    def add(test=None, ctx=None):
+        add.i += 1
+        return {"type": "invoke", "f": "add", "value": add.i}
+    add.i = -1
+    return {
+        "client": MapSetClient(crdt=crdt),
+        "generator": gen.stagger(0.1, add),
+        "final_generator": gen.each_thread(
+            gen.once({"type": "invoke", "f": "read"})),
+        "checker": jchecker.set_checker(),
+    }
+
+
 def workloads(opts: dict | None = None) -> dict:
-    std = standard_workloads(opts)
-    # hazelcast.clj's matrix: locks, queues, unique-ids, crdt sets —
-    # the shared analogues:
-    return {k: std[k] for k in ("set", "register", "monotonic")}
+    opts = opts or {}
+    n = opts.get("queue-size", 500)
+    return {
+        "lock": lambda: _lock_workload("jepsen.lock"),
+        "lock-no-quorum": lambda: _lock_workload("jepsen.lock.no-quorum"),
+        "queue": lambda: {
+            "client": QueueClient(),
+            "generator": queue_wl.generator(n),
+            "final_generator": queue_wl.final_generator(),
+            "checker": jchecker.total_queue(),
+        },
+        "atomic-long-ids": lambda: {
+            "client": AtomicLongIdClient(),
+            "generator": gen.stagger(
+                0.5, gen.repeat_gen({"type": "invoke", "f": "generate"})),
+            "checker": jchecker.unique_ids(),
+        },
+        "map": lambda: _map_workload(crdt=False),
+        "crdt-map": lambda: _map_workload(crdt=True),
+    }
 
 
 def hazelcast_test(opts: dict | None = None) -> dict:
     opts = base_opts(**(opts or {}))
-    wname = opts.get("workload", "set")
+    wname = opts.get("workload", "crdt-map")
     return suite_test(
         "hazelcast", wname, opts, workloads(opts),
         db=HazelcastDB(opts.get("version", VERSION)),

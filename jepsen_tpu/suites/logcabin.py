@@ -123,10 +123,25 @@ class LogCabinClient(jclient.Client):
             if op["f"] == "cas" and CAS_FAILED in msg:
                 return {**op, "type": "fail", "error": "cas-mismatch"}
             if TIMED_OUT in msg:
-                # reference maps client timeouts to :fail/:timed-out
-                # (logcabin.clj:240-243)
-                return {**op, "type": "fail", "error": "timed-out"}
+                # The reference maps every client timeout to
+                # :fail/:timed-out (logcabin.clj:240-243) — unsound for
+                # writes, which may commit after the client gives up.
+                # Reads are idempotent, so fail is safe there; timed-out
+                # writes/cas are indeterminate.
+                if op["f"] == "read":
+                    return {**op, "type": "fail", "error": "timed-out"}
+                return {**op, "type": "info", "error": "timed-out"}
             if op["f"] == "read":
+                # A never-written register reads as absent; the
+                # reference avoids this by seeding nil in setup!
+                # (logcabin.clj:214-216) — treat TreeOps' lookup
+                # failure as an ok nil read rather than fail-noise.
+                # scoped to TreeOps' lookup errors — a broader match
+                # (e.g. the shell's "TreeOps: not found") would turn
+                # infrastructure failures into fabricated ok reads
+                if any(s in msg.lower() for s in
+                       ("lookup_error", "does not exist")):
+                    return {**op, "type": "ok", "value": lift(None)}
                 return {**op, "type": "fail", "error": str(e)[:120]}
             # a failed write/cas exec is indeterminate: TreeOps may
             # have committed before dying

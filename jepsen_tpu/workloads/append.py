@@ -64,7 +64,7 @@ def generator(**opts):
     return gen.clients(AppendGen(**opts))
 
 
-def checker(anomalies=("G1", "G2"), backend="cpu", **kw):
+def checker(anomalies=("G1", "G2"), backend="auto", **kw):
     return elle.append_checker(anomalies=anomalies, backend=backend, **kw)
 
 

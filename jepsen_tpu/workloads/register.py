@@ -47,7 +47,7 @@ def generator(threads_per_key: int = 2, key_count: int = 10,
         lambda k: gen.limit(ops_per_key, op_gen))
 
 
-def checker(backend: str = "cpu", algorithm: str = "competition",
+def checker(backend: str = "auto", algorithm: str = "competition",
             model=None):
     return independent.checker(
         linearizable(model if model is not None else models.cas_register(),
@@ -55,7 +55,7 @@ def checker(backend: str = "cpu", algorithm: str = "competition",
 
 
 def test(threads_per_key: int = 2, key_count: int = 10,
-         ops_per_key: int = 100, backend: str = "cpu") -> dict:
+         ops_per_key: int = 100, backend: str = "auto") -> dict:
     return {"generator": gen.clients(
                 generator(threads_per_key, key_count, ops_per_key)),
             "checker": checker(backend=backend)}

@@ -57,7 +57,7 @@ def generator(**opts):
     return gen.clients(WrGen(**opts))
 
 
-def checker(anomalies=("G2", "G1a", "G1b", "internal"), backend="cpu",
+def checker(anomalies=("G2", "G1a", "G1b", "internal"), backend="auto",
             **kw):
     return rw_register_checker(anomalies, backend, **kw)
 

@@ -1,0 +1,218 @@
+"""In-process fake Hazelcast member speaking the Open Client Protocol
+(the wire format of drivers/hazelcast_proto.py): auth, IMap CAS ops,
+IQueue, ILock, IAtomicLong — enough to round-trip every client the
+hazelcast suite ships, in the style of fake_fauna/fake_cql."""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+
+from jepsen_tpu.drivers import hazelcast_proto as hz
+
+
+class _State:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.maps: dict[str, dict] = {}
+        self.queues: dict[str, list] = {}
+        self.longs: dict[str, int] = {}
+        self.locks: dict[str, tuple | None] = {}  # name -> owner conn id
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def _send(self, msg_type, corr, payload):
+        self.request.sendall(hz.pack_message(msg_type, corr, payload))
+
+    def _error(self, corr, code, cls, msg):
+        w = (hz._W())
+        w.parts.append(struct.pack("<i", code))
+        w.nullable_string(cls)
+        w.nullable_string(msg)
+        self._send(hz.RESP_ERROR, corr, w.bytes_())
+
+    def handle(self):
+        st: _State = self.server.state
+        conn_id = id(self)
+        init = b""
+        while len(init) < 3:
+            chunk = self.request.recv(3 - len(init))
+            if not chunk:
+                return
+            init += chunk
+        assert init == hz.PROTOCOL_INIT, init
+        buf = b""
+        while True:
+            try:
+                chunk = self.request.recv(65536)
+            except OSError:
+                return
+            if not chunk:
+                return
+            buf += chunk
+            while len(buf) >= 4:
+                (ln,) = struct.unpack("<i", buf[:4])
+                if len(buf) < ln:
+                    break
+                frame, buf = buf[:ln], buf[ln:]
+                typ, corr, body = hz.unpack_message(frame)
+                try:
+                    self._dispatch(st, conn_id, typ, corr, hz._R(body))
+                except Exception as e:  # noqa: BLE001
+                    self._error(corr, 1, type(e).__name__, str(e))
+
+    def _dispatch(self, st, conn_id, typ, corr, r):
+        if typ == hz.AUTH:
+            user = r.string()
+            pw = r.string()
+            status = 0 if (user, pw) == self.server.creds else 1
+            w = hz._W().u8(status)
+            w.u8(1)  # address: null flag (we skip the rest; the client
+            #          only reads status)
+            return self._send(hz.RESP_AUTH, corr, w.bytes_())
+
+        if typ == hz.MAP_GET:
+            name, key = r.string(), hz.deser_data(r.data())
+            with st.lock:
+                v = st.maps.get(name, {}).get(_k(key))
+            return self._reply_data(corr, v)
+        if typ == hz.MAP_PUT:
+            name, key = r.string(), hz.deser_data(r.data())
+            val = hz.deser_data(r.data())
+            with st.lock:
+                m = st.maps.setdefault(name, {})
+                old = m.get(_k(key))
+                m[_k(key)] = val
+            return self._reply_data(corr, old)
+        if typ == hz.MAP_PUT_IF_ABSENT:
+            name, key = r.string(), hz.deser_data(r.data())
+            val = hz.deser_data(r.data())
+            with st.lock:
+                m = st.maps.setdefault(name, {})
+                old = m.get(_k(key))
+                if old is None:
+                    m[_k(key)] = val
+            return self._reply_data(corr, old)
+        if typ == hz.MAP_REPLACE_IF_SAME:
+            name, key = r.string(), hz.deser_data(r.data())
+            old = hz.deser_data(r.data())
+            new = hz.deser_data(r.data())
+            with st.lock:
+                m = st.maps.setdefault(name, {})
+                ok = m.get(_k(key)) == old
+                if ok:
+                    m[_k(key)] = new
+            return self._send(hz.RESP_BOOL, corr,
+                              b"\x01" if ok else b"\x00")
+
+        if typ == hz.QUEUE_OFFER:
+            name, val = r.string(), hz.deser_data(r.data())
+            with st.lock:
+                st.queues.setdefault(name, []).append(val)
+            return self._send(hz.RESP_BOOL, corr, b"\x01")
+        if typ in (hz.QUEUE_POLL, hz.QUEUE_TAKE):
+            name = r.string()
+            with st.lock:
+                q = st.queues.setdefault(name, [])
+                v = q.pop(0) if q else None
+            return self._reply_data(corr, v)
+        if typ == hz.QUEUE_SIZE:
+            name = r.string()
+            with st.lock:
+                n = len(st.queues.get(name, []))
+            return self._send(hz.RESP_INT, corr, struct.pack("<i", n))
+
+        if typ == hz.LOCK_TRY_LOCK:
+            name = r.string()
+            r.i64()  # lease
+            r.i64()  # timeout — the fake never blocks
+            tid = r.i64()
+            with st.lock:
+                owner = st.locks.get(name)
+                ok = owner is None or owner == (conn_id, tid)
+                if ok:
+                    st.locks[name] = (conn_id, tid)
+            return self._send(hz.RESP_BOOL, corr,
+                              b"\x01" if ok else b"\x00")
+        if typ == hz.LOCK_LOCK:
+            name = r.string()
+            r.i64()
+            tid = r.i64()
+            with st.lock:
+                owner = st.locks.get(name)
+                if owner is not None and owner != (conn_id, tid):
+                    raise RuntimeError("lock held; fake never blocks")
+                st.locks[name] = (conn_id, tid)
+            return self._send(hz.RESP_VOID, corr, b"")
+        if typ == hz.LOCK_UNLOCK:
+            name = r.string()
+            tid = r.i64()
+            with st.lock:
+                owner = st.locks.get(name)
+                if owner != (conn_id, tid):
+                    return self._error(
+                        corr, 25, "IllegalMonitorStateException",
+                        "Current thread is not owner of the lock!")
+                st.locks[name] = None
+            return self._send(hz.RESP_VOID, corr, b"")
+
+        if typ == hz.ATOMIC_LONG_INCREMENT_AND_GET:
+            name = r.string()
+            with st.lock:
+                st.longs[name] = st.longs.get(name, 0) + 1
+                v = st.longs[name]
+            return self._send(hz.RESP_LONG, corr, struct.pack("<q", v))
+        if typ == hz.ATOMIC_LONG_ADD_AND_GET:
+            name = r.string()
+            d = r.i64()
+            with st.lock:
+                st.longs[name] = st.longs.get(name, 0) + d
+                v = st.longs[name]
+            return self._send(hz.RESP_LONG, corr, struct.pack("<q", v))
+        if typ == hz.ATOMIC_LONG_GET:
+            name = r.string()
+            with st.lock:
+                v = st.longs.get(name, 0)
+            return self._send(hz.RESP_LONG, corr, struct.pack("<q", v))
+
+        self._error(corr, 2, "UnsupportedOperationException",
+                    f"message type {typ:#x}")
+
+    def _reply_data(self, corr, v):
+        if v is None:
+            return self._send(hz.RESP_DATA, corr, b"\x01")
+        blob = hz.ser_data(v)
+        return self._send(hz.RESP_DATA, corr,
+                          b"\x00" + struct.pack("<i", len(blob)) + blob)
+
+
+def _k(key):
+    return tuple(key) if isinstance(key, list) else key
+
+
+class FakeHazelcastServer:
+    """`with FakeHazelcastServer() as srv:` — .port; shared state."""
+
+    def __init__(self, creds=("dev", "dev-pass")):
+        self.server = socketserver.ThreadingTCPServer(
+            ("127.0.0.1", 0), _Handler, bind_and_activate=True)
+        self.server.daemon_threads = True
+        self.server.state = _State()
+        self.server.creds = creds
+        self.port = self.server.server_address[1]
+
+    def __enter__(self):
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.server.shutdown()
+        self.server.server_close()
+
+    @property
+    def state(self):
+        return self.server.state

@@ -1,0 +1,149 @@
+"""The device kernels are the DEFAULT analysis path (the north star's
+`:backend :tpu` flag, jepsen/src/jepsen/checker.clj:188-219): every
+checker constructor defaults backend="auto", which resolves to the
+device engine when an accelerator is reachable (or JEPSEN_TPU_BACKEND
+forces it) and to the CPU oracle otherwise — and a full dummy-remote
+etcd run's analyze phase actually routes through the device kernels.
+
+Also covers the detect-then-classify two-pass in the bucketed batch
+sweep (the production analyze-store path)."""
+
+import json
+import threading
+from http.server import HTTPServer
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import core, devices, parallel
+from jepsen_tpu.checker.elle import synth
+from jepsen_tpu.store import Store
+from jepsen_tpu.suites import etcd
+from tests.test_suites import FakeEtcd
+
+
+# --------------------------------------------------------------------------
+# resolve_backend
+# --------------------------------------------------------------------------
+
+def test_resolve_backend_explicit_passthrough(monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_BACKEND", "tpu")
+    assert devices.resolve_backend("cpu") == "cpu"   # explicit beats env
+    assert devices.resolve_backend("tpu") == "tpu"
+
+
+def test_resolve_backend_auto_no_accelerator(monkeypatch):
+    monkeypatch.delenv("JEPSEN_TPU_BACKEND", raising=False)
+    # conftest pins the cpu platform: no accelerator reachable
+    assert devices.resolve_backend("auto") == "cpu"
+
+
+def test_resolve_backend_auto_with_accelerator(monkeypatch):
+    monkeypatch.delenv("JEPSEN_TPU_BACKEND", raising=False)
+    monkeypatch.setattr(devices, "accelerator_available", lambda: True)
+    assert devices.resolve_backend("auto") == "tpu"
+
+
+def test_resolve_backend_env_override(monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_BACKEND", "tpu")
+    assert devices.resolve_backend("auto") == "tpu"
+    monkeypatch.setenv("JEPSEN_TPU_BACKEND", "cpu")
+    assert devices.resolve_backend("auto") == "cpu"
+
+
+def test_default_constructors_are_auto():
+    from jepsen_tpu import checker as jchecker
+    from jepsen_tpu.checker import elle
+    from jepsen_tpu.checker.elle import wr
+    assert jchecker.linearizable().backend == "auto"
+    assert elle.append_checker().backend == "auto"
+    assert wr.rw_register_checker().backend == "auto"
+
+
+# --------------------------------------------------------------------------
+# the etcd suite's analyze phase takes the device route
+# --------------------------------------------------------------------------
+
+@pytest.fixture()
+def fake_etcd():
+    FakeEtcd.store = {}
+    srv = HTTPServer(("127.0.0.1", 0), FakeEtcd)
+    th = threading.Thread(target=srv.serve_forever, daemon=True)
+    th.start()
+    yield srv.server_address[1]
+    srv.shutdown()
+
+
+def test_etcd_dummy_run_analyze_routes_device_kernels(
+        tmp_path, fake_etcd, monkeypatch):
+    """A dummy-remote etcd run (fake in-process etcd) with the forced
+    device backend: the linearizability verdict must come out of the
+    dense-bitset device kernel, not the CPU WGL engine."""
+    monkeypatch.setenv("JEPSEN_TPU_BACKEND", "tpu")
+    monkeypatch.setattr(etcd, "client_url",
+                        lambda node: f"http://127.0.0.1:{fake_etcd}")
+    from jepsen_tpu.checker.knossos import dense
+    batches = []
+    orig = dense.check_encoded_dense_batch
+
+    def spy(encs, *a, **kw):
+        batches.append(len(encs))
+        return orig(encs, *a, **kw)
+
+    monkeypatch.setattr(dense, "check_encoded_dense_batch", spy)
+
+    # nemesis-interval must stay below time-limit: the nemesis's sleep
+    # ops run on its worker thread, and the post-time-limit drain waits
+    # for the in-flight sleep to finish.
+    t = etcd.etcd_test({"time-limit": 2, "ops-per-key": 15,
+                        "threads-per-key": 2, "nemesis-interval": 1})
+    t.update(nodes=["n1", "n2", "n3"], concurrency=2,
+             ssh={"dummy": True}, store=Store(tmp_path / "store"))
+    t = core.run(t)
+    assert t["results"]["valid?"] is True
+    assert t["results"]["indep"]["valid?"] is True
+    assert sum(batches) > 0, "analyze never reached the device kernel"
+
+
+# --------------------------------------------------------------------------
+# detect-then-classify two-pass
+# --------------------------------------------------------------------------
+
+def _encs(n_good: int, n_bad: int, T: int = 96, K: int = 8):
+    out = [synth.synth_encoded_history(T, K=K) for _ in range(n_good)]
+    out += [synth.synth_encoded_history(T, K=K, inject_cycle=True)
+            for _ in range(n_bad)]
+    return out
+
+
+def test_two_pass_matches_single_pass():
+    encs = _encs(6, 2)
+    two = parallel.check_bucketed(encs, None)          # default: two-pass
+    one = parallel.check_bucketed(encs, None, two_pass=False)
+    assert two == one
+    assert all(f == {} for f in two[:6])
+    assert all("G1c" in f for f in two[6:])
+
+
+def test_two_pass_all_valid_skips_classify(monkeypatch):
+    """On an all-valid sweep the classify closures never run: every
+    dispatch is detect-mode."""
+    calls = []
+    orig = parallel.sharded_check_fn
+
+    def spy(mesh, shape, **kw):
+        calls.append(kw.get("classify"))
+        return orig(mesh, shape, **kw)
+
+    monkeypatch.setattr(parallel, "sharded_check_fn", spy)
+    out = parallel.check_bucketed(_encs(5, 0), None)
+    assert all(f == {} for f in out)
+    assert calls and not any(calls), calls
+
+
+def test_two_pass_on_mesh():
+    mesh = parallel.make_mesh()
+    encs = _encs(9, 1)
+    out = parallel.check_bucketed(encs, mesh)
+    assert all(f == {} for f in out[:9])
+    assert "G1c" in out[9]

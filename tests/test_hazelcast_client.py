@@ -1,0 +1,169 @@
+"""Hazelcast wire client + workload-menu tests: Open Client Protocol
+round-trips against the in-process fake member (VERDICT r2 item 4 —
+locks, queues, atomic-long ids, crdt-map set CAS), and a full
+dummy-remote run of the lock workload."""
+
+import pytest
+
+from jepsen_tpu import checker as jchecker, core
+from jepsen_tpu.drivers import hazelcast_proto as hz
+from jepsen_tpu.store import Store
+from jepsen_tpu.suites import hazelcast
+from tests.fake_hazelcast import FakeHazelcastServer
+
+
+@pytest.fixture()
+def srv():
+    with FakeHazelcastServer() as s:
+        yield s
+
+
+def conn(srv):
+    return hz.HzConn("127.0.0.1", srv.port)
+
+
+# ---------------------------------------------------------------------------
+# protocol round-trips
+# ---------------------------------------------------------------------------
+
+def test_auth_rejected():
+    with FakeHazelcastServer(creds=("u", "secret")) as s:
+        with pytest.raises(hz.DBError):
+            hz.HzConn("127.0.0.1", s.port)
+
+
+def test_data_serialization_roundtrip():
+    for v in (None, 7, -3, "hello", [1, 2, 3], []):
+        got = hz.deser_data(hz.ser_data(v))
+        assert got == (list(v) if isinstance(v, (list, tuple)) else v)
+
+
+def test_map_cas_ops(srv):
+    c = conn(srv)
+    assert c.map_get("m", "hi") is None
+    assert c.map_put_if_absent("m", "hi", [1]) is None
+    assert c.map_put_if_absent("m", "hi", [9]) == [1]
+    assert c.map_replace_if_same("m", "hi", [1], [1, 2]) is True
+    assert c.map_replace_if_same("m", "hi", [1], [1, 3]) is False
+    assert c.map_get("m", "hi") == [1, 2]
+    c.close()
+
+
+def test_queue_ops(srv):
+    c = conn(srv)
+    assert c.queue_offer("q", 10) is True
+    assert c.queue_offer("q", 20) is True
+    assert c.queue_size("q") == 2
+    assert c.queue_poll("q") == 10
+    assert c.queue_take("q") == 20
+    assert c.queue_poll("q") is None
+    c.close()
+
+
+def test_lock_ops(srv):
+    c1, c2 = conn(srv), conn(srv)
+    assert c1.lock_try_lock("l", 100) is True
+    assert c2.lock_try_lock("l", 100) is False
+    with pytest.raises(hz.HazelcastError, match="not owner"):
+        c2.lock_unlock("l")
+    c1.lock_unlock("l")
+    assert c2.lock_try_lock("l", 100) is True
+    c1.close(), c2.close()
+
+
+def test_atomic_long_ops(srv):
+    c = conn(srv)
+    assert c.atomic_long_increment_and_get("ids") == 1
+    assert c.atomic_long_increment_and_get("ids") == 2
+    assert c.atomic_long_add_and_get("ids", 10) == 12
+    assert c.atomic_long_get("ids") == 12
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# workload clients
+# ---------------------------------------------------------------------------
+
+def _opened(cls, srv, **kw):
+    c = cls(port=srv.port, **kw)
+    return c.open({}, "127.0.0.1")
+
+
+def test_lock_client_classification(srv):
+    a = _opened(hazelcast.LockClient, srv)
+    b = _opened(hazelcast.LockClient, srv)
+    assert a.invoke({}, {"f": "acquire"})["type"] == "ok"
+    assert b.invoke({}, {"f": "acquire"})["type"] == "fail"
+    out = b.invoke({}, {"f": "release"})
+    assert out["type"] == "fail" and out["error"] == "not-lock-owner"
+    assert a.invoke({}, {"f": "release"})["type"] == "ok"
+
+
+def test_queue_client(srv):
+    c = _opened(hazelcast.QueueClient, srv)
+    assert c.invoke({}, {"f": "enqueue", "value": 5})["type"] == "ok"
+    assert c.invoke({}, {"f": "enqueue", "value": 6})["type"] == "ok"
+    out = c.invoke({}, {"f": "dequeue"})
+    assert out["type"] == "ok" and out["value"] == 5
+    out = c.invoke({}, {"f": "drain"})
+    assert out["type"] == "ok" and out["value"] == [6]
+
+
+def test_id_client(srv):
+    c = _opened(hazelcast.AtomicLongIdClient, srv)
+    vs = [c.invoke({}, {"f": "generate"})["value"] for _ in range(5)]
+    assert vs == [1, 2, 3, 4, 5]
+
+
+def test_map_set_client_cas_and_read(srv):
+    a = _opened(hazelcast.MapSetClient, srv, crdt=True)
+    assert a.invoke({}, {"f": "add", "value": 3})["type"] == "ok"
+    assert a.invoke({}, {"f": "add", "value": 1})["type"] == "ok"
+    out = a.invoke({}, {"f": "read"})
+    assert out["type"] == "ok" and out["value"] == [1, 3]
+    # uses the crdt map name the merge policy is registered for
+    assert "jepsen.crdt-map" in srv.state.maps
+
+
+def test_connection_refused_is_indeterminate():
+    c = hazelcast.AtomicLongIdClient(port=1)
+    with pytest.raises(hz.DriverError):
+        c.open({}, "127.0.0.1")
+
+
+# ---------------------------------------------------------------------------
+# workload registry + a full dummy-remote run
+# ---------------------------------------------------------------------------
+
+def test_workload_menu_matches_reference():
+    ws = hazelcast.workloads()
+    assert set(ws) == {"lock", "lock-no-quorum", "queue",
+                      "atomic-long-ids", "map", "crdt-map"}
+    for name, f in ws.items():
+        pkg = f()
+        assert pkg.get("generator") is not None, name
+        assert pkg.get("checker") is not None, name
+        assert pkg.get("client") is not None, name
+
+
+def test_hazelcast_test_default_client_wired():
+    t = hazelcast.hazelcast_test({"time-limit": 1})
+    assert t["client"] is not None
+
+
+def test_lock_workload_full_run(tmp_path, srv, monkeypatch):
+    monkeypatch.setattr(hazelcast._HzClient, "port", srv.port)
+    t = hazelcast.hazelcast_test({
+        "workload": "lock", "time-limit": 2, "nemesis-interval": 1000,
+        "nodes": ["127.0.0.1"], "concurrency": 3,
+        "ssh": {"dummy": True}})
+    # partition nemesis sleeps would outlive the run; drop the nemesis
+    t["nemesis"] = None
+    import jepsen_tpu.generator as gen
+    wl = hazelcast.workloads()["lock"]()
+    t["generator"] = gen.time_limit(2, gen.clients(wl["generator"]))
+    t["store"] = Store(tmp_path / "store")
+    t = core.run(t)
+    assert t["results"]["valid?"] is True
+    hist = [o for o in t["history"] if o.get("f") in ("acquire", "release")]
+    assert len(hist) >= 4

@@ -227,8 +227,17 @@ def test_logcabin_client_treeops_commands_and_cas_classification():
 
     to_err = ("Exiting due to LogCabin::Client::Exception: "
               "Client-specified timeout elapsed")
+    # a timed-out write may still commit server-side: indeterminate
+    # (the reference's blanket :fail at logcabin.clj:240-243 is unsound
+    # for writes; reads are idempotent so fail is safe)
     assert classify("write", 3, to_err)["error"] == "timed-out"
-    assert classify("write", 3, to_err)["type"] == "fail"
+    assert classify("write", 3, to_err)["type"] == "info"
+    assert classify("read", None, to_err)["type"] == "fail"
+    # a never-written register reads as absent, not as an error
+    missing = ("Exiting due to LogCabin::Client::Exception: "
+               "Path '/r0' does not exist")
+    out = classify("read", None, missing)
+    assert out["type"] == "ok" and out["value"] is None
 
     # any other failed write is indeterminate
     assert classify("write", 3, "boom")["type"] == "info"
