@@ -32,7 +32,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...devices import default_devices
+from ...devices import default_devices, ensure_platform_pin
+
+ensure_platform_pin()
 from ...util import pad_to_multiple
 from .encode import EncodedHistory, effective_complete_index
 
@@ -169,7 +171,8 @@ def _edges_one(appends: jnp.ndarray, reads: jnp.ndarray, n_keys: int,
     return ww, wr, rw
 
 
-def _closure_batched(m: jnp.ndarray, steps: int, constrain) -> jnp.ndarray:
+def _closure_batched(m: jnp.ndarray, steps: int, constrain,
+                     use_pallas: bool = False) -> jnp.ndarray:
     """Transitive closure of [B,T,T] boolean adjacencies via repeated
     squaring; each squaring is one batched bf16 matmul on the MXU.
 
@@ -178,7 +181,13 @@ def _closure_batched(m: jnp.ndarray, steps: int, constrain) -> jnp.ndarray:
     histories the diameter tracks ops-per-key, far below T, which makes
     the early exit worth ~1.5x on the 5k-txn benchmark (the any()
     reduction per round is noise next to the matmul). `steps` stays the
-    adversarial upper bound."""
+    adversarial upper bound.
+
+    With use_pallas (unsharded TPU dispatches), the squaring runs as
+    the fused Pallas kernel (pallas_square.closure_square): the
+    cast/matmul/threshold pipeline stays in VMEM instead of making
+    bf16/f32 round-trips through HBM. Sharded dispatches keep the XLA
+    matmul so the compiler can insert the dp/mp collectives."""
     eye = jnp.eye(m.shape[-1], dtype=bool)
     m = m | eye
 
@@ -188,11 +197,16 @@ def _closure_batched(m: jnp.ndarray, steps: int, constrain) -> jnp.ndarray:
 
     def body(carry):
         m, _, i = carry
-        mb = constrain(m.astype(jnp.bfloat16))
-        m2 = jax.lax.dot_general(
-            mb, mb, (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32) > 0
-        m2 = constrain(m2)
+        if use_pallas:
+            from . import pallas_square
+            m2 = pallas_square.closure_square(
+                m, interpret=pallas_square.INTERPRET)
+        else:
+            mb = constrain(m.astype(jnp.bfloat16))
+            m2 = jax.lax.dot_general(
+                mb, mb, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32) > 0
+            m2 = constrain(m2)
         return m2, jnp.any(m2 != m), i + 1
 
     m, _, _ = jax.lax.while_loop(
@@ -212,7 +226,8 @@ def _closure_batched(m: jnp.ndarray, steps: int, constrain) -> jnp.ndarray:
 def check_batched_impl(appends, reads, invoke_index, complete_index, process,
                        n_live, *, n_keys: int, max_pos: int, n_txns: int,
                        steps: int, classify: bool, realtime: bool,
-                       process_order: bool, constrain) -> jnp.ndarray:
+                       process_order: bool, constrain,
+                       use_pallas: bool = False) -> jnp.ndarray:
     """THE cycle-check kernel: packed [B,...] tensors -> [B] int32 flag
     words. `n_live` is the per-history real txn count ([B]); rows beyond
     it are excluded from realtime/process edges."""
@@ -222,13 +237,14 @@ def check_batched_impl(appends, reads, invoke_index, complete_index, process,
     return classify_matrices_impl(
         ww, wr, rw, invoke_index, complete_index, process, n_live,
         steps=steps, classify=classify, realtime=realtime,
-        process_order=process_order, constrain=constrain)
+        process_order=process_order, constrain=constrain,
+        use_pallas=use_pallas)
 
 
 def classify_matrices_impl(ww, wr, rw, invoke_index, complete_index, process,
                            n_live, *, steps: int, classify: bool,
                            realtime: bool, process_order: bool,
-                           constrain) -> jnp.ndarray:
+                           constrain, use_pallas: bool = False) -> jnp.ndarray:
     """Closure + anomaly classification over explicit [B,T,T] boolean edge
     matrices. Entry point for checkers (rw-register) whose edge
     construction happens host-side from inferred version graphs rather
@@ -259,7 +275,7 @@ def classify_matrices_impl(ww, wr, rw, invoke_index, complete_index, process,
     wwr = ww | wr
     full = wwr | rw
     if not classify:
-        c_full = _closure_batched(full, steps, constrain)
+        c_full = _closure_batched(full, steps, constrain, use_pallas)
         cycle = jnp.any(full & jnp.swapaxes(c_full, 1, 2) & nI,
                         axis=(1, 2))
         return cycle.astype(jnp.int32) << CYCLE
@@ -267,9 +283,9 @@ def classify_matrices_impl(ww, wr, rw, invoke_index, complete_index, process,
     # seeding each wider closure with the previous result is exact and
     # each seeded closure converges in the few rounds its NEW edge
     # class adds, instead of re-walking the whole graph three times.
-    c_ww = _closure_batched(ww, steps, constrain)
-    c_wwr = _closure_batched(c_ww | wr, steps, constrain)
-    c_full = _closure_batched(c_wwr | rw, steps, constrain)
+    c_ww = _closure_batched(ww, steps, constrain, use_pallas)
+    c_wwr = _closure_batched(c_ww | wr, steps, constrain, use_pallas)
+    c_full = _closure_batched(c_wwr | rw, steps, constrain, use_pallas)
     cycle = jnp.any(full & jnp.swapaxes(c_full, 1, 2) & nI, axis=(1, 2))
     cT_wwr = jnp.swapaxes(c_wwr, 1, 2)
     g0 = jnp.any(ww & jnp.swapaxes(c_ww, 1, 2) & nI, axis=(1, 2))
@@ -290,31 +306,34 @@ def _identity(x):
 
 @functools.partial(jax.jit, static_argnames=(
     "n_keys", "max_pos", "n_txns", "steps", "classify", "realtime",
-    "process_order"))
+    "process_order", "use_pallas"))
 def check_batch_device(appends, reads, invoke_index, complete_index, process,
                        n_live, *, n_keys: int, max_pos: int, n_txns: int,
                        steps: int, classify: bool = True,
                        realtime: bool = False,
-                       process_order: bool = False) -> jnp.ndarray:
+                       process_order: bool = False,
+                       use_pallas: bool = False) -> jnp.ndarray:
     """Single-device jitted entry over a packed batch: [B] int32 flags."""
     return check_batched_impl(
         appends, reads, invoke_index, complete_index, process, n_live,
         n_keys=n_keys, max_pos=max_pos, n_txns=n_txns, steps=steps,
         classify=classify, realtime=realtime, process_order=process_order,
-        constrain=_identity)
+        constrain=_identity, use_pallas=use_pallas)
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "steps", "classify", "realtime", "process_order"))
+    "steps", "classify", "realtime", "process_order", "use_pallas"))
 def classify_matrices_device(ww, wr, rw, invoke_index, complete_index,
                              process, n_live, *, steps: int,
                              classify: bool = True, realtime: bool = False,
-                             process_order: bool = False) -> jnp.ndarray:
+                             process_order: bool = False,
+                             use_pallas: bool = False) -> jnp.ndarray:
     """Jitted single-device entry over packed [B,T,T] edge matrices."""
     return classify_matrices_impl(
         ww, wr, rw, invoke_index, complete_index, process, n_live,
         steps=steps, classify=classify, realtime=realtime,
-        process_order=process_order, constrain=_identity)
+        process_order=process_order, constrain=_identity,
+        use_pallas=use_pallas)
 
 
 def pack_edge_matrices(per_history: list[dict], multiple: int = 128) -> dict:
@@ -379,9 +398,12 @@ def check_edge_batch(per_history: list[dict], realtime: bool = False,
     else:
         args = [jax.device_put(p[k], devices[0] if devices else None)
                 for k in names]
+    from . import pallas_square
     flags = classify_matrices_device(
         *args, steps=closure_steps(p["T"]), classify=classify,
-        realtime=realtime, process_order=process_order)
+        realtime=realtime, process_order=process_order,
+        use_pallas=(len(devices) == 1
+                    and pallas_square.pallas_available()))
     return [flags_to_names(int(w)) for w in np.asarray(flags)[:n]]
 
 
@@ -426,8 +448,11 @@ def check_encoded_batch(encs: list[EncodedHistory],
             mesh, jax.sharding.PartitionSpec("dp"))
         args = [jax.device_put(a, sharding) for a in args]
 
+    from . import pallas_square
     flags = check_batch_device(
         *args, n_keys=shape.n_keys, max_pos=shape.max_pos,
         n_txns=shape.n_txns, steps=closure_steps(shape.n_txns),
-        classify=classify, realtime=realtime, process_order=process_order)
+        classify=classify, realtime=realtime, process_order=process_order,
+        use_pallas=(len(devices) == 1
+                    and pallas_square.pallas_available()))
     return [flags_to_names(int(w)) for w in np.asarray(flags)[:n]]

@@ -1,0 +1,114 @@
+"""Pallas TPU kernel for the closure-squaring step — THE hot op.
+
+The transitive-closure fixpoint (kernels._closure_batched) squares a
+[B, T, T] boolean reachability matrix each round:
+
+    m2 = (bf16(m) @ bf16(m)) > 0
+
+On the XLA path that is three HBM passes per round: cast bool->bf16
+(materialized), the matmul, and the f32->bool compare. This kernel
+fuses all three: bool tiles are DMA'd to VMEM once, cast on the VPU,
+accumulated on the MXU in an f32 VMEM scratch over the k-tiles, and
+thresholded back to bool as they leave — one HBM read of m per operand
+tile and one bool write, no bf16/f32 intermediates in HBM.
+
+Grid is (B, i, j, k) with k innermost (sequential — "arbitrary"
+semantics) so the accumulator scratch carries across the k loop of one
+output tile; b/i/j are parallel. T must be a multiple of the tile (the
+encoders pad T to 128 already).
+
+Used by kernels._closure_batched on unsharded TPU dispatches;
+mesh-sharded closures keep the XLA matmul so the compiler can insert
+the dp/mp collectives. Correctness is pinned CPU-side via
+interpret=True differential tests (tests/test_pallas_square.py) and on
+hardware by the `-m tpu` tier.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable on every platform; only lowering needs a TPU
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+# Tests flip this to run the kernel through the Pallas interpreter on
+# CPU (full-verdict parity without hardware); production leaves it off.
+INTERPRET = False
+
+
+def _square_kernel(a_ref, b_ref, out_ref, acc_ref):
+    @pl.when(pl.program_id(3) == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    a = a_ref[0].astype(jnp.bfloat16)
+    b = b_ref[0].astype(jnp.bfloat16)
+    acc_ref[...] += jax.lax.dot_general(
+        a, b, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(pl.program_id(3) == pl.num_programs(3) - 1)
+    def _emit():
+        out_ref[0] = acc_ref[...] > 0.0
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def closure_square(m: jnp.ndarray, *, tile: int = 256,
+                   interpret: bool = False) -> jnp.ndarray:
+    """One closure round: (bf16(m) @ bf16(m)) > 0 for m [B, T, T] bool.
+
+    `tile` shrinks to T when T < tile; T must divide evenly by the
+    effective tile (guaranteed by the 128-padding in the encoders)."""
+    B, T, T2 = m.shape
+    assert T == T2, m.shape
+    t = tile if T % tile == 0 else 128  # encoders pad T to 128
+    t = min(t, T)
+    assert T % t == 0, (T, t)
+    grid = (B, T // t, T // t, T // t)
+
+    kwargs = {}
+    if pltpu is not None and not interpret:
+        try:
+            kwargs["compiler_params"] = pltpu.CompilerParams(
+                dimension_semantics=("parallel", "parallel", "parallel",
+                                     "arbitrary"))
+        except Exception:  # older API spellings: let the compiler infer
+            pass
+    return pl.pallas_call(
+        _square_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, t, t), lambda b, i, j, k: (b, i, k)),
+            pl.BlockSpec((1, t, t), lambda b, i, j, k: (b, k, j)),
+        ],
+        out_specs=pl.BlockSpec((1, t, t), lambda b, i, j, k: (b, i, j)),
+        out_shape=jax.ShapeDtypeStruct((B, T, T), jnp.bool_),
+        scratch_shapes=[
+            (pltpu.VMEM((t, t), jnp.float32) if pltpu is not None
+             else pl.pallas_core.MemorySpace.ANY)  # pragma: no cover
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * B * T * T * T,
+            bytes_accessed=m.size * 2 + m.size,
+            transcendentals=0),
+        interpret=interpret,
+        **kwargs,
+    )(m, m)
+
+
+def pallas_available() -> bool:
+    """True when the current default device can lower this kernel — a
+    real TPU. (Interpret mode is for tests; running it in production
+    on CPU would be slower than the XLA matmul.)"""
+    try:
+        from ...devices import default_devices
+        d = default_devices()[0]
+        return getattr(d, "platform", "") in ("tpu", "axon")
+    except Exception:
+        return False

@@ -47,7 +47,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...devices import default_devices
+from ...devices import default_devices, ensure_platform_pin
+
+ensure_platform_pin()
 from ...util import pad_to_multiple
 from ... import history as h
 from .encode import CAS, READ, WRITE, EncodingError

@@ -54,7 +54,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...devices import default_devices
+from ...devices import default_devices, ensure_platform_pin
+
+ensure_platform_pin()
 from ...util import pad_to_multiple
 from .encode import (CAS, COMPLETE_EV, INVOKE_EV, READ, WRITE,
                      EncodedRegisterHistory, RegisterBatchShape,

@@ -53,6 +53,11 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
     p.add_argument("--test-count", type=int, default=1)
     p.add_argument("--leave-db-running", action="store_true")
     p.add_argument("--store", default="store", help="store directory")
+    p.add_argument("--faults", default=None,
+                   help="comma list for the combined nemesis bundle "
+                        "(partition,kill,pause,clock) — swaps the "
+                        "suite's default nemesis for the composed "
+                        "package (combined.clj:318-364)")
     p.add_argument("--backend", default="auto",
                    choices=["auto", "tpu", "cpu"],
                    help="analysis backend: device kernels (tpu), host "
@@ -70,6 +75,9 @@ def test_map_from_args(args: argparse.Namespace) -> dict:
     t: dict = {
         "backend": getattr(args, "backend", "auto"),
         "concurrency": args.concurrency,
+        **({"faults": [f.strip() for f in args.faults.split(",")
+                       if f.strip()]}
+           if getattr(args, "faults", None) else {}),
         "time_limit": args.time_limit,
         "leave_db_running": args.leave_db_running,
         "store": Store(args.store),
@@ -259,6 +267,13 @@ def analyze_store(store: Store, checker: str = "append",
     from .checker import elle
     from .checker.elle import kernels as elle_kernels
     from .checker.elle import wr as elle_wr
+    import os as _os
+
+    # An EXPLICIT --backend cpu (the dispatcher exports it) routes the
+    # sweep through the host oracle. Auto stays on the batched kernels:
+    # they run on whatever devices exist — that's the north-star sweep,
+    # and on CPU-only hosts it doubles as the virtual-mesh dryrun.
+    host_only = _os.environ.get("JEPSEN_TPU_BACKEND") == "cpu"
 
     # Encodable histories get the batched device sweep; the rest fall
     # back to their own stored checker host-side. Ingest shards run
@@ -299,6 +314,10 @@ def analyze_store(store: Store, checker: str = "append",
             # single-run verdicts for the same history.
             prohibited = elle.AppendChecker().prohibited
             cycles_by_dir: dict = {}
+            if host_only:
+                for d, enc in zip(mapping, encs):
+                    cycles_by_dir[d] = elle.cycle_anomalies_cpu(enc)
+                dense = huge = []
             if dense:
                 for d, cycles in zip(dense_map,
                                      parallel.check_bucketed(dense,
@@ -317,11 +336,15 @@ def analyze_store(store: Store, checker: str = "append",
                                           prohibited)
                 worst = max(worst, emit(d, res))
         else:  # wr: edge lists are host-built; one device dispatch
-            cycles_per_run = elle_kernels.check_edge_batch(
-                [{"n": e.n, "edges": e.edges,
-                  "invoke_index": e.invoke_index,
-                  "complete_index": e.complete_index,
-                  "process": e.process} for e in encs])
+            if host_only:
+                cycles_per_run = [elle.cycle_anomalies_cpu(e)
+                                  for e in encs]
+            else:
+                cycles_per_run = elle_kernels.check_edge_batch(
+                    [{"n": e.n, "edges": e.edges,
+                      "invoke_index": e.invoke_index,
+                      "complete_index": e.complete_index,
+                      "process": e.process} for e in encs])
             prohibited = elle_wr.WrChecker().prohibited
             for d, enc, cycles in zip(mapping, encs, cycles_per_run):
                 res = elle_wr.render_wr_verdict(enc, cycles, prohibited)

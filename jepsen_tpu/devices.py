@@ -100,6 +100,16 @@ def _requested_platform() -> str | None:
     return plat
 
 
+def ensure_platform_pin() -> None:
+    """Re-assert the JEPSEN_TPU_PLATFORM/JAX_PLATFORMS env pin on the
+    jax config. Kernel modules call this at import: plugins that
+    force-update jax_platforms from sitecustomize otherwise win over
+    the user's env var, and the first jit of ANY entry point would
+    initialize the plugin backend (hanging the process when its
+    transport is down). Cheap — a config write, no backend init."""
+    _requested_platform()
+
+
 def default_devices(min_count: int = 1, *, probe: bool = False) -> list:
     """The analysis devices. With probe=True (benchmarks, `auto` checker
     backends), an unpinned default backend is first health-checked in a

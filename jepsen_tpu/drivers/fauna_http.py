@@ -301,8 +301,13 @@ class FaunaConn:
 
     def query_all_naive(self, set_expr, size: int = 1024) -> list:
         """Cursor-follow with a fresh transaction per page (the
-        reference's query-all-naive) — pagination-isolation anomalies
-        become visible; the pages workload wants exactly that."""
+        reference's query-all-naive): each page sees a different
+        snapshot, so cross-page isolation is deliberately absent. The
+        pages workload reads with the PINNED query_all by default, like
+        the reference (pages.clj reads via f/query-all) — whether the
+        server's at()-pinned pagination is actually atomic is the
+        property under test; pass pages-naive-reads to hunt the
+        known-torn variant instead."""
         out: list = []
         after = None
         while True:

@@ -27,7 +27,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..checker.elle import kernels as K
-from ..devices import default_devices
+from ..devices import default_devices, ensure_platform_pin
+
+ensure_platform_pin()
 from ..util import pad_to_multiple
 
 
@@ -54,17 +56,21 @@ def sharded_check_fn(mesh: Mesh | None, shape: K.BatchShape, *,
     """Build a jitted batched checker around kernels.check_batched_impl.
     With a mesh, inputs are expected sharded over 'dp' and the closure
     matrices are constrained to P('dp', None, 'mp'); without one, it's a
-    plain single-device jit. Memoized per (mesh, shape, flags) so
+    plain single-device jit whose closure squaring runs as the fused
+    Pallas kernel on TPU hardware. Memoized per (mesh, shape, flags) so
     repeated same-shape dispatches (bucketed sweeps, per-key loops)
     compile once."""
+    from ..checker.elle import pallas_square
+    use_pallas = mesh is None and pallas_square.pallas_available()
     return _sharded_check_fn_cached(mesh, shape, classify, realtime,
-                                    process_order)
+                                    process_order, use_pallas)
 
 
 @functools.lru_cache(maxsize=64)
 def _sharded_check_fn_cached(mesh: Mesh | None, shape: K.BatchShape,
                              classify: bool, realtime: bool,
-                             process_order: bool):
+                             process_order: bool,
+                             use_pallas: bool = False):
     if mesh is not None:
         spec = P("dp", None, "mp")
 
@@ -79,7 +85,7 @@ def _sharded_check_fn_cached(mesh: Mesh | None, shape: K.BatchShape,
         K.check_batched_impl, n_keys=shape.n_keys, max_pos=shape.max_pos,
         n_txns=shape.n_txns, steps=K.closure_steps(shape.n_txns),
         classify=classify, realtime=realtime, process_order=process_order,
-        constrain=constrain)
+        constrain=constrain, use_pallas=use_pallas)
     if mesh is None:
         return jax.jit(f)
     in_shard = NamedSharding(mesh, P("dp"))

@@ -21,10 +21,22 @@ def last_test(store: Store | str = "store") -> dict | None:
 
 @contextlib.contextmanager
 def to_file(path):
-    """Redirect stdout into a file — the reference's report/to-file
-    macro (report.clj:9-16)."""
-    with open(path, "w") as f, contextlib.redirect_stdout(f):
-        yield f
+    """Redirect stdout into a file — the reference's report/to macro
+    (report.clj:9-16): parents created, and a 'Report written to'
+    notice printed on the REAL stdout afterwards."""
+    import os
+    path = os.fspath(path)
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    # Like the reference, the notice prints once the file is OPEN (its
+    # finally sits inside with-open): never for an unopenable path.
+    with open(path, "w") as f:
+        try:
+            with contextlib.redirect_stdout(f):
+                yield f
+        finally:
+            print("Report written to", path)
 
 
 # codec.clj:9-29: EDN <-> bytes.

@@ -109,16 +109,35 @@ def suite_test(name: str, workload_name: str, opts: dict,
             f"have {sorted(workloads)}")
     wl = workloads[workload_name]()
     g = wl["generator"]
+    interval = opts.get("nemesis-interval", 10)
+    nemesis_gen = nemesis_cycle(interval)
+    # Combined fault bundle for ANY suite (combined.clj:318-364): opts
+    # {"faults": ["partition", "kill", "pause", "clock"]} swaps the
+    # plain start/stop partition schedule for the composed package's
+    # nemesis + generator (faults the DB can't support are dropped).
+    heal_gen = gen.once({"type": "info", "f": "stop"})
+    if opts.get("faults"):
+        # An explicit fault request beats the suite's default nemesis —
+        # every suite bakes one in, so "explicit argument wins" would
+        # make the flag a no-op everywhere.
+        from ..nemesis import combined as ncombined
+        pkg = ncombined.nemesis_package(
+            db, interval, faults=opts["faults"])
+        nemesis = pkg["nemesis"]
+        if pkg.get("generator") is not None:
+            nemesis_gen = pkg["generator"]
+        if pkg.get("final_generator") is not None:
+            heal_gen = pkg["final_generator"]
     main_gen = gen.time_limit(
         opts.get("time-limit", 60),
-        gen.clients(g, nemesis_cycle(opts.get("nemesis-interval", 10))))
+        gen.clients(g, nemesis_gen))
     if wl.get("final_generator") is not None:
         # post-time-limit phase (queue drains, final reads): heal the
         # nemesis first so a live partition can't wedge an until-ok
         # final phase (the reference's std-gen shape)
         main_gen = gen.phases(
             main_gen,
-            gen.nemesis(gen.once({"type": "info", "f": "stop"})),
+            gen.nemesis(heal_gen),
             wl["final_generator"])
     test = {
         "name": f"{name} {workload_name}",

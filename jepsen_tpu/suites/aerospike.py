@@ -4,18 +4,24 @@ Counterpart of aerospike/src/jepsen/aerospike.clj (1,262 LoC, plus the
 TLA+ spec at aerospike/spec/aerospike.tla — our model spec lives at
 suites/specs/aerospike.tla and makes the lost-acked-write claim the
 empirical register workload hunts): deb-installed server with a
-mesh-seeded cluster, CAS-register (generation-check writes) and counter
-workloads. The wire protocol is Aerospike's bespoke binary info/data
-protocol — the client is pluggable (pass ``client`` in opts);
-install/cluster/workload wiring is complete.
+mesh-seeded cluster, driven over the bespoke binary message protocol
+(drivers/aerospike_msg.py) — CAS registers via generation-check writes
+(cas_register.clj:43-90's AerospikeClient usage) and the server-side
+INCR counter workload (counter.clj).
 """
 
 from __future__ import annotations
 
+from .. import checker as jchecker
 from .. import cli as jcli
+from .. import client as jclient
 from .. import control
 from .. import db as jdb
+from .. import generator as gen
+from .. import independent
 from .. import nemesis as jnemesis, os_setup
+from ..drivers import DriverError
+from ..drivers import aerospike_msg as asp
 from . import base_opts, standard_workloads, suite_test
 
 LOGFILE = "/var/log/aerospike/aerospike.log"
@@ -57,9 +63,107 @@ class AerospikeDB(jdb.DB, jdb.LogFiles):
         return [LOGFILE]
 
 
+class _AsClient(jclient.Client):
+    port = 3000
+
+    def __init__(self, conn: asp.AsConn | None = None,
+                 port: int | None = None):
+        self.conn = conn
+        if port is not None:
+            self.port = port
+
+    def open(self, test, node):
+        return type(self)(asp.AsConn(node, self.port), port=self.port)
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+
+class AerospikeCasClient(_AsClient):
+    """CAS register: reads return {value, generation}; cas re-reads and
+    writes with a generation check, so a concurrent update fails the
+    cas (cas_register.clj's record-generation scheme)."""
+
+    def invoke(self, test, op):
+        v = op["value"]
+        k, val = (v.key, v.value) if independent.is_tuple(v) else (0, v)
+        lift = ((lambda x: independent.tuple_(k, x))
+                if independent.is_tuple(v) else (lambda x: x))
+        try:
+            if op["f"] == "read":
+                rec = self.conn.get(k)
+                out = None if rec is None else rec["bins"].get("value")
+                return {**op, "type": "ok", "value": lift(out)}
+            if op["f"] == "write":
+                self.conn.put(k, {"value": val})
+                return {**op, "type": "ok"}
+            if op["f"] == "cas":
+                old, new = val
+                rec = self.conn.get(k)
+                if rec is None or rec["bins"].get("value") != old:
+                    return {**op, "type": "fail", "error": "precond"}
+                try:
+                    self.conn.put(k, {"value": new},
+                                  generation=rec["generation"])
+                except asp.AerospikeError as e:
+                    if e.code == asp.RESULT_GENERATION:
+                        return {**op, "type": "fail",
+                                "error": "generation-mismatch"}
+                    raise
+                return {**op, "type": "ok"}
+            return {**op, "type": "fail", "error": f"bad f {op['f']!r}"}
+        except asp.AerospikeError as e:
+            return {**op, "type": "fail", "error": str(e)[:120]}
+        except DriverError as e:
+            crash = "fail" if op["f"] == "read" else "info"
+            return {**op, "type": crash, "error": str(e)[:120]}
+
+
+class AerospikeCounterClient(_AsClient):
+    """Server-side INCR counter (counter.clj): add deltas, read the
+    running value; checked by the counter-bounds checker."""
+
+    def invoke(self, test, op):
+        try:
+            if op["f"] == "add":
+                self.conn.add("counter", "value", op["value"])
+                return {**op, "type": "ok"}
+            if op["f"] == "read":
+                rec = self.conn.get("counter")
+                out = 0 if rec is None else rec["bins"].get("value", 0)
+                return {**op, "type": "ok", "value": out}
+            return {**op, "type": "fail", "error": f"bad f {op['f']!r}"}
+        except DriverError as e:
+            crash = "fail" if op["f"] == "read" else "info"
+            return {**op, "type": crash, "error": str(e)[:120]}
+        except asp.AerospikeError as e:
+            return {**op, "type": "fail", "error": str(e)[:120]}
+
+
+def _counter_workload() -> dict:
+    import random as _r
+
+    def add(test=None, ctx=None):
+        return {"type": "invoke", "f": "add", "value": _r.randint(1, 5)}
+
+    return {
+        "client": AerospikeCounterClient(),
+        "generator": gen.stagger(1 / 10, gen.mix(
+            [add, gen.repeat_gen({"type": "invoke", "f": "read"})])),
+        "checker": jchecker.counter(),
+    }
+
+
 def workloads(opts: dict | None = None) -> dict:
     std = standard_workloads(opts)
-    return {k: std[k] for k in ("register", "set", "monotonic")}
+    return {
+        "register": lambda: {**std["register"](),
+                             "client": AerospikeCasClient()},
+        "counter": _counter_workload,
+        "set": std["set"],
+        "monotonic": std["monotonic"],
+    }
 
 
 def aerospike_test(opts: dict | None = None) -> dict:

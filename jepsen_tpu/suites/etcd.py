@@ -52,19 +52,17 @@ def initial_cluster(test: dict) -> str:
     return ",".join(f"{n}={peer_url(n)}" for n in test.get("nodes", []))
 
 
-class EtcdDB(jdb.DB, jdb.LogFiles):
-    """Tarball install + daemonized etcd (db, etcd.clj:51-86)."""
+class EtcdDB(jdb.DB, jdb.Process, jdb.Pause, jdb.LogFiles):
+    """Tarball install + daemonized etcd (db, etcd.clj:51-86); start/
+    kill/pause/resume implement the db.clj:22-35 fault protocols so the
+    combined kill/pause nemesis packages apply."""
 
     def __init__(self, version: str = VERSION):
         self.version = version
 
-    def setup(self, test, node):
-        sess = control.current_session()
-        url = (f"https://storage.googleapis.com/etcd/{self.version}/"
-               f"etcd-{self.version}-linux-amd64.tar.gz")
-        cutil.install_archive(sess.su(), url, DIR)
+    def _start(self, sess, test, node):
         cutil.start_daemon(
-            sess.su(), BINARY,
+            sess, BINARY,
             "--name", node,
             "--listen-peer-urls", peer_url(node),
             "--listen-client-urls", client_url(node),
@@ -73,6 +71,13 @@ class EtcdDB(jdb.DB, jdb.LogFiles):
             "--initial-advertise-peer-urls", peer_url(node),
             "--initial-cluster", initial_cluster(test),
             logfile=LOGFILE, pidfile=PIDFILE, chdir=DIR)
+
+    def setup(self, test, node):
+        sess = control.current_session()
+        url = (f"https://storage.googleapis.com/etcd/{self.version}/"
+               f"etcd-{self.version}-linux-amd64.tar.gz")
+        cutil.install_archive(sess.su(), url, DIR)
+        self._start(sess.su(), test, node)
         import time
         time.sleep(5)
 
@@ -80,6 +85,21 @@ class EtcdDB(jdb.DB, jdb.LogFiles):
         sess = control.current_session().su()
         cutil.stop_daemon(sess, PIDFILE)
         sess.exec("rm", "-rf", DIR)
+
+    def start(self, test, node):
+        self._start(control.current_session().su(), test, node)
+
+    def kill(self, test, node):
+        cutil.grepkill(control.current_session().su(), "etcd",
+                       signal="KILL")
+
+    def pause(self, test, node):
+        cutil.grepkill(control.current_session().su(), "etcd",
+                       signal="STOP")
+
+    def resume(self, test, node):
+        cutil.grepkill(control.current_session().su(), "etcd",
+                       signal="CONT")
 
     def log_files(self, test, node):
         return [LOGFILE]
@@ -181,12 +201,23 @@ def etcd_test(opts: dict | None = None) -> dict:
     opts = base_opts(**(opts or {}))
     ops_per_key = opts.get("ops-per-key", 300)
     threads_per_key = opts.get("threads-per-key", 10)
+    db = EtcdDB(opts.get("version", VERSION))
+    interval = opts.get("nemesis-interval", 10)
+    nemesis = jnemesis.partition_random_halves()
+    nemesis_gen = nemesis_cycle(interval)
+    if opts.get("faults"):
+        from ..nemesis import combined as ncombined
+        pkg = ncombined.nemesis_package(db, interval,
+                                        faults=opts["faults"])
+        nemesis = pkg["nemesis"]
+        if pkg.get("generator") is not None:
+            nemesis_gen = pkg["generator"]
     test = {
         "name": "etcd",
         "os": os_setup.debian(),
-        "db": EtcdDB(opts.get("version", VERSION)),
+        "db": db,
         "client": EtcdClient(quorum=bool(opts.get("quorum", False))),
-        "nemesis": jnemesis.partition_random_halves(),
+        "nemesis": nemesis,
         "checker": jchecker.compose({
             "perf": jchecker.perf_checker(),
             "indep": independent.checker(jchecker.compose({
@@ -202,7 +233,7 @@ def etcd_test(opts: dict | None = None) -> dict:
                     lambda k: gen.limit(
                         ops_per_key,
                         gen.stagger(1 / 30, gen.mix([r, w, cas])))),
-                nemesis_cycle(opts.get("nemesis-interval", 10)))),
+                nemesis_gen)),
     }
     for k, v in opts.items():
         test.setdefault(k, v)

@@ -6,16 +6,22 @@ log-replicated cluster, driven over its HTTP+JSON query API with
 secret-key auth (the reference's JVM driver is the same HTTP endpoint,
 client.clj:36-60). FaunaClient speaks the FQL wire-JSON protocol via
 drivers.fauna_http and maps the register (register.clj:31-62), set
-(set.clj:35-60), bank (bank.clj:80-140), monotonic and g2 families;
-pass ``client`` to substitute your own.
+(set.clj:35-60), bank (bank.clj:80-140), monotonic, g2, pages
+(pages.clj — pagination isolation of grouped inserts) and
+multimonotonic (multimonotonic.clj — increment-only registers with
+timestamp-order and read-skew checkers) families; pass ``client`` to
+substitute your own. opts {"nemesis": "topology"} swaps the partition
+nemesis for the cluster-membership TopologyNemesis (topology.clj).
 """
 
 from __future__ import annotations
 
+from .. import checker as jchecker
 from .. import cli as jcli
 from .. import client as jclient
 from .. import control
 from .. import db as jdb
+from .. import generator as gen
 from .. import independent
 from .. import nemesis as jnemesis, os_setup
 from ..drivers import DBError, DriverError
@@ -83,18 +89,19 @@ class FaunaClient(jclient.Client):
 
     def __init__(self, mode: str = "register", accounts: list | None = None,
                  total: int = 100, node: str | None = None,
-                 timeout: float = 10.0):
+                 timeout: float = 10.0, naive_reads: bool = False):
         self.mode = mode
         self.accounts = accounts if accounts is not None else list(range(8))
         self.total = total
         self.node = node
         self.timeout = timeout
+        self.naive_reads = naive_reads  # pages: per-page transactions
         self.conn = None
         self._setup_done = False
 
     def open(self, test, node):
         return FaunaClient(self.mode, self.accounts, self.total, node,
-                           self.timeout)
+                           self.timeout, self.naive_reads)
 
     def _ensure_conn(self, test):
         from ..drivers import fauna_http as q
@@ -143,6 +150,18 @@ class FaunaClient(jclient.Client):
                         "source": q.class_(name),
                         "active": True,
                         "terms": [{"field": ["data", "key"]}]})))
+        elif self.mode == "pages":
+            self._upsert_class(q, "elements")
+            self.conn.query(q.if_(
+                q.exists(q.index("elements-by-key")), None,
+                q.create_index({
+                    "name": "elements-by-key",
+                    "source": q.class_("elements"),
+                    "active": True,
+                    "terms": [{"field": ["data", "key"]}],
+                    "values": [{"field": ["data", "value"]}]})))
+        elif self.mode == "multimonotonic":
+            self._upsert_class(q, "registers")
 
     def close(self, test):
         self.conn = None
@@ -252,6 +271,53 @@ class FaunaClient(jclient.Client):
                     q.select(["data", "value"],
                              q.create(ref, {"data": {"value": 1}}))))
                 return {**op, "type": "ok", "value": res}
+        elif self.mode == "pages":
+            # pages.clj:31-66: groups of elements created in ONE txn;
+            # concurrent paginated reads of the key's whole index — for
+            # every element of a group, the rest must appear too.
+            k, val = (v.key, v.value) if independent.is_tuple(v) \
+                else (0, v)
+            if f == "add":
+                self.conn.query(q.do(*[
+                    q.create(q.ref_(q.class_("elements"), f"{k}:{e}"),
+                             {"data": {"key": k, "value": e}})
+                    for e in val]))
+                return {**op, "type": "ok"}
+            if f == "read":
+                q_all = (self.conn.query_all_naive if self.naive_reads
+                         else self.conn.query_all)
+                vals = q_all(q.match(q.index("elements-by-key"), k))
+                return {**op, "type": "ok",
+                        "value": independent.tuple_(k, list(vals))}
+        elif self.mode == "multimonotonic":
+            # multimonotonic.clj:76-107: blind single-txn writes of
+            # {key: value} maps; reads pin a timestamp and fetch a
+            # subset of registers with their instance ts.
+            if f == "write":
+                self.conn.query([
+                    q.if_(q.exists(q.ref_(q.class_("registers"), k)),
+                          q.update(q.ref_(q.class_("registers"), k),
+                                   {"data": {"value": val}}),
+                          q.create(q.ref_(q.class_("registers"), k),
+                                   {"data": {"value": val}}))
+                    for k, val in v.items()])
+                return {**op, "type": "ok"}
+            if f == "read":
+                ks = list(v)
+                res = self.conn.query(
+                    [q.time("now")] +
+                    [q.when(q.exists(q.ref_(q.class_("registers"), k)),
+                            q.get_(q.ref_(q.class_("registers"), k)))
+                     for k in ks])
+                ts, instances = res[0], res[1:]
+                registers = {}
+                for k, inst in zip(ks, instances):
+                    if isinstance(inst, dict):
+                        registers[k] = {
+                            "ts": inst.get("ts"),
+                            "value": (inst.get("data") or {}).get("value")}
+                return {**op, "type": "ok",
+                        "value": {"ts": ts, "registers": registers}}
         elif self.mode == "g2":
             if f == "insert":
                 k, ids = (v.key, v.value) if independent.is_tuple(v) \
@@ -273,6 +339,303 @@ class FaunaClient(jclient.Client):
         return {**op, "type": "fail", "error": f"unknown f {f!r}"}
 
 
+# ---------------------------------------------------------------------------
+# pages: transactional isolation of pagination (pages.clj)
+# ---------------------------------------------------------------------------
+
+class PagesChecker(jchecker.Checker):
+    """Every read must be expressible as a union of add-groups: pick an
+    element, cross off its whole group, and if any group member is
+    missing that's a pagination-isolation error (pages.clj:68-106)."""
+
+    def check(self, test, history, opts):
+        invoked, failed = set(), set()
+        idx: dict = {}
+        for o in history:
+            if o.get("f") != "add":
+                continue
+            group = tuple(o.get("value") or ())
+            if o.get("type") == "invoke":
+                invoked.add(group)
+            elif o.get("type") == "fail":
+                failed.add(group)
+        for group in invoked - failed:
+            gs = frozenset(group)
+            for e in group:
+                assert e not in idx, "Elements must be unique"
+                idx[e] = gs
+        errs = []
+        ok_reads = 0
+        for o in history:
+            if o.get("type") != "ok" or o.get("f") != "read":
+                continue
+            ok_reads += 1
+            read = set(o.get("value") or ())
+            while read:
+                e = next(iter(read))
+                group = idx.get(e, frozenset({e}))
+                if not group <= read:
+                    errs.append({"expected": sorted(group),
+                                 "found": sorted(read & group)})
+                read -= group
+        return {"valid?": not errs,
+                "ok-read-count": ok_reads,
+                "error-count": len(errs),
+                "first-error": errs[0] if errs else None}
+
+
+def _pages_workload(opts: dict) -> dict:
+    import random as _r
+    nodes = opts.get("nodes") or ["n1", "n2", "n3", "n4", "n5"]
+    n = opts.get("pages-elements", 1000)
+    group_size = opts.get("pages-group-size", 4)
+    per_key = opts.get("pages-ops-per-key", 256)
+
+    def gen_key(k):
+        rng = _r.Random(f"pages:{k}")
+        vals = list(range(-n, n))
+        rng.shuffle(vals)
+        groups = [vals[i:i + group_size]
+                  for i in range(0, len(vals), group_size)]
+        # ~4:1 adds:reads like the reference's (mix [adds x4 reads]) —
+        # but interleaved into ONE sequence: our pure mix would step
+        # four independent copies of the adds Seq, re-inserting every
+        # group (the duplicate creates then fail and poison the
+        # checker's group index).
+        ops = []
+        for g in groups:
+            ops.append({"type": "invoke", "f": "add", "value": g})
+            if rng.random() < 0.25:
+                ops.append({"type": "invoke", "f": "read",
+                            "value": None})
+        return gen.stagger(1 / 5, gen.limit(per_key, gen.Seq.of(ops)))
+
+    return {
+        "client": FaunaClient(mode="pages",
+                              naive_reads=bool(
+                                  opts.get("pages-naive-reads"))),
+        "generator": independent.concurrent_generator(
+            2 * len(nodes), range(100000), gen_key),
+        "checker": independent.checker(PagesChecker()),
+    }
+
+
+# ---------------------------------------------------------------------------
+# multimonotonic: increment-only registers must never read backwards
+# (multimonotonic.clj)
+# ---------------------------------------------------------------------------
+
+class TsOrderChecker(jchecker.Checker):
+    """Order reads by their read timestamp and fold a running lower
+    bound per register; any read below the bound means timestamp order
+    disagrees with observed values (multimonotonic.clj:256-272)."""
+
+    def check(self, test, history, opts):
+        reads = [o for o in history
+                 if o.get("type") == "ok" and o.get("f") == "read"
+                 and (o.get("value") or {}).get("ts") is not None]
+        reads.sort(key=lambda o: o["value"]["ts"])
+        inferred: dict = {}
+        errs = []
+        for o in reads:
+            state = {k: r["value"]
+                     for k, r in o["value"]["registers"].items()}
+            bad = {k: [inferred[k], {"value": val,
+                                     "op-index": o.get("index")}]
+                   for k, val in state.items()
+                   if k in inferred and val < inferred[k]["value"]}
+            if bad:
+                errs.append({"observed": state, "op": o, "errors": bad})
+            for k, val in state.items():
+                if k not in inferred or inferred[k]["value"] <= val:
+                    inferred[k] = {"value": val,
+                                   "op-index": o.get("index")}
+        return {"valid?": not errs, "errors": errs[:8],
+                "error-count": len(errs)}
+
+
+class ReadSkewChecker(jchecker.Checker):
+    """Read-skew hunt over increment-only registers: for each key,
+    order reads by observed value and add edges between consecutive
+    value classes; a cycle in the union graph means two reads each saw
+    the other's future (the cycle-detection formulation sketched at
+    multimonotonic.clj:274-299 — the reference stubs the check out;
+    this implements it)."""
+
+    def check(self, test, history, opts):
+        reads = [o for o in history
+                 if o.get("type") == "ok" and o.get("f") == "read"]
+        states = [{k: r["value"]
+                   for k, r in (o.get("value") or {}).get(
+                       "registers", {}).items()} for o in reads]
+        by_key: dict = {}
+        for i, st in enumerate(states):
+            for k, val in st.items():
+                by_key.setdefault(k, {}).setdefault(val, []).append(i)
+        edges: dict[int, set] = {i: set() for i in range(len(states))}
+        for k, classes in by_key.items():
+            vals = sorted(classes)
+            for lo, hi in zip(vals, vals[1:]):
+                for a in classes[lo]:
+                    edges[a] |= set(classes[hi])
+        # iterative Tarjan: any SCC with >1 node is a skew cycle
+        index: dict = {}
+        low: dict = {}
+        on: set = set()
+        stack: list = []
+        sccs = []
+        counter = [0]
+        for root in edges:
+            if root in index:
+                continue
+            work = [(root, iter(edges[root]))]
+            index[root] = low[root] = counter[0]
+            counter[0] += 1
+            stack.append(root)
+            on.add(root)
+            while work:
+                v, it = work[-1]
+                adv = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on.add(w)
+                        work.append((w, iter(edges[w])))
+                        adv = True
+                        break
+                    if w in on:
+                        low[v] = min(low[v], index[w])
+                if adv:
+                    continue
+                work.pop()
+                if work:
+                    low[work[-1][0]] = min(low[work[-1][0]], low[v])
+                if low[v] == index[v]:
+                    comp = []
+                    while True:
+                        w = stack.pop()
+                        on.discard(w)
+                        comp.append(w)
+                        if w == v:
+                            break
+                    if len(comp) > 1:
+                        sccs.append(sorted(comp))
+        errs = [{"cycle-reads": [reads[i].get("index") for i in comp],
+                 "states": [states[i] for i in comp]} for comp in sccs]
+        return {"valid?": not errs, "errors": errs[:8],
+                "error-count": len(errs)}
+
+
+class _MMWriter(gen.Generator):
+    """Per-thread writer (via each_thread): blind writes to a key
+    derived from the current process, restarting from 0 when a crash
+    remaps the process (multimonotonic.clj:314-341). Registers written
+    keys in the shared `active` dict so readers can pick subsets."""
+
+    def __init__(self, active: dict, key=None, value: int = 0):
+        self.active = active
+        self.key = key
+        self.value = value
+
+    def op(self, test, ctx):
+        thread = next(iter(ctx.workers))
+        p = ctx.workers[thread]
+        k, v = (self.key, self.value) if p == self.key else (p, 0)
+        self.active[thread] = k
+        o = gen.fill_in_op({"f": "write", "value": {k: v}}, ctx)
+        if o is gen.PENDING:
+            return (o, self)
+        return (o, _MMWriter(self.active, k, v + 1))
+
+    def update(self, test, ctx, event):
+        return self
+
+
+def _mm_workload(opts: dict) -> dict:
+    import random as _r
+    conc = int(str(opts.get("concurrency", 5)).rstrip("n")) or 5
+    active: dict = {}
+
+    def read(test=None, ctx=None):
+        ks = sorted(set(active.values())) or [0]
+        n = _r.randint(1, len(ks))
+        return {"type": "invoke", "f": "read",
+                "value": sorted(_r.sample(ks, n))}
+
+    # Per-branch staggers: a single stagger around the reserve would
+    # rate-limit the merged stream, and soonest-op tie-breaking then
+    # starves the reader branch (a free writer thread wins every tick).
+    return {
+        "client": FaunaClient(mode="multimonotonic"),
+        "generator": gen.reserve(
+            max(1, conc // 2),
+            gen.stagger(opts.get("mm-write-stagger", 1 / 200),
+                        gen.each_thread(_MMWriter(active))),
+            gen.stagger(opts.get("mm-read-stagger", 1 / 100), read)),
+        "checker": jchecker.compose({
+            "ts-order": TsOrderChecker(),
+            "read-skew": ReadSkewChecker(),
+        }),
+    }
+
+
+# ---------------------------------------------------------------------------
+# topology-change nemesis (topology.clj + auto.clj:107-124,273-280)
+# ---------------------------------------------------------------------------
+
+class TopologyNemesis:
+    """Grow and shrink the cluster under load: `add-node` re-joins a
+    removed node to the current primary (`faunadb-admin join -r
+    <replica>`), `remove-node` removes it by host id (`faunadb-admin
+    remove $(faunadb-admin host-id ...)`). Best-effort like the
+    reference — topology drift after crashes is tolerated."""
+
+    def __init__(self):
+        self.removed: list = []
+
+    def setup(self, test):
+        return self
+
+    def invoke(self, test, op):
+        nodes = test.get("nodes") or []
+        f = op.get("f")
+        try:
+            if f == "remove-node":
+                cand = [n for n in nodes if n not in self.removed]
+                if len(cand) <= (len(nodes) // 2 + 1):
+                    return {**op, "type": "info", "value": "too-few"}
+                node = cand[-1]
+                sess = control.session(test, cand[0]).su()
+                sess.exec("sh", "-c",
+                          f"faunadb-admin remove "
+                          f"$(faunadb-admin host-id {node})")
+                self.removed.append(node)
+                return {**op, "type": "info", "value": node}
+            if f == "add-node":
+                if not self.removed:
+                    return {**op, "type": "info", "value": "none-removed"}
+                node = self.removed.pop()
+                primary = nodes[0]
+                sess = control.session(test, node).su()
+                sess.exec("faunadb-admin", "join", "-r", "replica-0",
+                          primary)
+                return {**op, "type": "info", "value": node}
+            return {**op, "type": "info", "value": f"bad f {f!r}"}
+        except Exception as e:  # noqa: BLE001 — nemesis never crashes a run
+            return {**op, "type": "info", "error": str(e)[:120]}
+
+    def teardown(self, test):
+        pass
+
+
+def topology_generator(interval: float = 15.0):
+    return gen.stagger(interval, gen.cycle(gen.Seq.of([
+        {"type": "info", "f": "remove-node"},
+        {"type": "info", "f": "add-node"}])))
+
+
 def workloads(opts: dict | None = None) -> dict:
     std = standard_workloads(opts)
     out = {}
@@ -282,17 +645,23 @@ def workloads(opts: dict | None = None) -> dict:
             pkg.setdefault("client", FaunaClient(mode=name))
             return pkg
         out[k] = make
+    o = opts or {}
+    out["pages"] = lambda: _pages_workload(o)
+    out["multimonotonic"] = lambda: _mm_workload(o)
     return out
 
 
 def faunadb_test(opts: dict | None = None) -> dict:
     opts = base_opts(**(opts or {}))
     wname = opts.get("workload", "register")
+    nem = (TopologyNemesis()
+           if opts.get("nemesis") == "topology"
+           else jnemesis.partition_random_halves())
     return suite_test(
         "faunadb", wname, opts, workloads(opts),
         db=FaunaDB(opts.get("version", "2.5.5")),
         client=opts.get("client"),
-        nemesis=jnemesis.partition_random_halves(),
+        nemesis=nem,
         os_setup=os_setup.debian())
 
 

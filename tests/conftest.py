@@ -7,14 +7,22 @@ path; real-chip benchmarks happen in bench.py).
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-# Some environments register a TPU plugin regardless of JAX_PLATFORMS;
-# this pin makes jepsen_tpu.devices resolve the virtual CPU mesh.
-os.environ["JEPSEN_TPU_PLATFORM"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+# Opt-in real-hardware tier: JEPSEN_TPU_PLATFORM set to a non-cpu
+# platform (`JEPSEN_TPU_PLATFORM=tpu pytest -m tpu` on a TPU host;
+# `=axon` where the chip is reached through the tunnel plugin) skips
+# the CPU pin so the `tpu`-marked differential suites run on the chip.
+ON_HARDWARE = os.environ.get("JEPSEN_TPU_PLATFORM", "") not in ("", "cpu")
+
+if not ON_HARDWARE:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # Some environments register a TPU plugin regardless of
+    # JAX_PLATFORMS; this pin makes jepsen_tpu.devices resolve the
+    # virtual CPU mesh.
+    os.environ["JEPSEN_TPU_PLATFORM"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 # The axon TPU-tunnel plugin (when present) force-updates the
@@ -24,12 +32,36 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 # 8-device virtual CPU mesh.
 import jax  # noqa: E402
 
-if jax.config.jax_platforms != "cpu":
+if not ON_HARDWARE and jax.config.jax_platforms != "cpu":
     jax.config.update("jax_platforms", "cpu")
 
 import random
 
 import pytest
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "tpu: CPU-vs-device differential tests meant for real hardware "
+        "(run with JEPSEN_TPU_PLATFORM=tpu pytest -m tpu)")
+
+
+def pytest_collection_modifyitems(config, items):
+    """`tpu`-marked tests only run when hardware is opted in; everything
+    else is excluded under the hardware tier (one chip, no virtual
+    mesh — the CPU-pinned assumptions of the main suite don't hold)."""
+    if ON_HARDWARE:
+        skip = pytest.mark.skip(reason="hardware tier runs -m tpu only")
+        for it in items:
+            if "tpu" not in it.keywords:
+                it.add_marker(skip)
+    else:
+        skip = pytest.mark.skip(
+            reason="needs real hardware: JEPSEN_TPU_PLATFORM=tpu")
+        for it in items:
+            if "tpu" in it.keywords:
+                it.add_marker(skip)
 
 
 @pytest.fixture(autouse=True)

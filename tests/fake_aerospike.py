@@ -1,0 +1,121 @@
+"""In-process fake Aerospike node speaking the message protocol (the
+wire format of drivers/aerospike_msg.py): records keyed by digest with
+generations, generation-check writes, create-only, INCR, and the info
+protocol."""
+
+from __future__ import annotations
+
+import socketserver
+import struct
+import threading
+
+from jepsen_tpu.drivers import aerospike_msg as asp
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def _recv_exact(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self.request.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def handle(self):
+        st = self.server.state
+        while True:
+            head = self._recv_exact(8)
+            if head is None:
+                return
+            ver, typ, size = asp.unpack_proto(head)
+            body = self._recv_exact(size)
+            if body is None:
+                return
+            if typ == asp.TYPE_INFO:
+                names = body.decode().split()
+                out = "".join(f"{n}\tok\n" for n in names).encode()
+                self.request.sendall(struct.pack(
+                    ">Q", (asp.PROTO_VERSION << 56)
+                    | (asp.TYPE_INFO << 48) | len(out)) + out)
+                continue
+            self.request.sendall(self._message(st, body))
+
+    def _message(self, st, body) -> bytes:
+        (hsz, info1, info2, _i3, _u, _res, gen, _ttl, _ttt, n_fields,
+         n_ops) = asp.MSG_HEADER.unpack_from(body)
+        i = hsz
+        digest = None
+        for _ in range(n_fields):
+            (sz,) = struct.unpack_from(">i", body, i)
+            ftype = body[i + 4]
+            data = body[i + 5:i + 4 + sz]
+            if ftype == asp.FIELD_DIGEST:
+                digest = data
+            i += 4 + sz
+        ops = []
+        for _ in range(n_ops):
+            (sz,) = struct.unpack_from(">i", body, i)
+            op_body = body[i + 4:i + 4 + sz]
+            i += 4 + sz
+            opc, particle, _v, nlen = struct.unpack_from(">BBBB", op_body)
+            name = op_body[4:4 + nlen].decode()
+            data = op_body[4 + nlen:]
+            if particle == asp.PARTICLE_INTEGER:
+                val = struct.unpack(">q", data)[0]
+            elif particle == asp.PARTICLE_STRING:
+                val = data.decode()
+            else:
+                val = None
+            ops.append((opc, name, val))
+
+        def reply(result, generation=0, bins=None):
+            out_ops = [asp._op(1, n, v) for n, v in (bins or {}).items()]
+            return asp.pack_message(0, 0, generation, [], out_ops,
+                                    result=result)
+
+        with st["lock"]:
+            rec = st["records"].get(digest)
+            if info1 & asp.INFO1_READ:
+                if rec is None:
+                    return reply(asp.RESULT_NOT_FOUND)
+                return reply(asp.RESULT_OK, rec["gen"], rec["bins"])
+            if info2 & asp.INFO2_WRITE:
+                if info2 & asp.INFO2_GENERATION:
+                    if rec is None or rec["gen"] != gen:
+                        return reply(asp.RESULT_GENERATION)
+                if info2 & asp.INFO2_CREATE_ONLY and rec is not None:
+                    return reply(5)  # AS_PROTO_RESULT_FAIL_EXISTS
+                if rec is None:
+                    rec = {"gen": 0, "bins": {}}
+                    st["records"][digest] = rec
+                for opc, name, val in ops:
+                    if opc == 5:  # INCR
+                        rec["bins"][name] = rec["bins"].get(name, 0) + val
+                    else:
+                        rec["bins"][name] = val
+                rec["gen"] += 1
+                return reply(asp.RESULT_OK, rec["gen"])
+        return reply(4)  # parameter error
+
+
+class FakeAerospikeServer:
+    def __init__(self):
+        self.server = socketserver.ThreadingTCPServer(
+            ("127.0.0.1", 0), _Handler, bind_and_activate=True)
+        self.server.daemon_threads = True
+        self.server.state = {"lock": threading.Lock(), "records": {}}
+        self.port = self.server.server_address[1]
+
+    def __enter__(self):
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+        return self
+
+    def __exit__(self, *exc):
+        self.server.shutdown()
+        self.server.server_close()
+
+    @property
+    def state(self):
+        return self.server.state

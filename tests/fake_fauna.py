@@ -240,7 +240,13 @@ class FaunaStore:
                 return ("ref", base[1], str(x.get("id")))
             raise BadRequest("invalid expression", f"ref base {base!r}")
         if "time" in x:
-            return self.eval(x["time"], env)
+            v = self.eval(x["time"], env)
+            if v == "now":
+                # a monotonic tagged timestamp (the global txn counter),
+                # so multimonotonic reads sort by real commit order
+                self.ts += 1
+                return {"@ts": f"1970-01-01T00:00:00.{self.ts:09d}Z"}
+            return v
         if "at" in x:
             return self.eval(x["expr"], env)
         raise BadRequest("invalid expression", f"unknown form {x!r}")

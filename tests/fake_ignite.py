@@ -1,0 +1,142 @@
+"""In-process fake Ignite node speaking the thin-client binary protocol
+(the wire format of drivers/ignite_thin.py): handshake + the cache ops
+the suite's clients use."""
+
+from __future__ import annotations
+
+import socketserver
+import struct
+import threading
+
+from jepsen_tpu.drivers import ignite_thin as ig
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def _recv_exact(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self.request.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _recv_packet(self):
+        head = self._recv_exact(4)
+        if head is None:
+            return None
+        (ln,) = struct.unpack("<i", head)
+        return self._recv_exact(ln)
+
+    def _send_packet(self, body: bytes):
+        self.request.sendall(struct.pack("<i", len(body)) + body)
+
+    def handle(self):
+        st = self.server.state
+        hs = self._recv_packet()
+        if hs is None:
+            return
+        self._send_packet(b"\x01")
+        while True:
+            pkt = self._recv_packet()
+            if pkt is None:
+                return
+            r = ig._R(pkt)
+            op = r.i16()
+            rid = r.i64()
+            try:
+                out = self._dispatch(st, op, r)
+                self._send_packet(struct.pack("<qi", rid, 0) + out)
+            except Exception as e:  # noqa: BLE001
+                self._send_packet(struct.pack("<qi", rid, 1)
+                                  + ig.ser(str(e)))
+
+    def _dispatch(self, st, op, r) -> bytes:
+        if op == ig.OP_CACHE_GET_OR_CREATE_WITH_NAME:
+            name = r.string()
+            with st["lock"]:
+                st["caches"].setdefault(ig.java_hash(name), {})
+            return b""
+        if op == ig.OP_TX_START:
+            # serialize all transactions with one global lock — a
+            # simplification that still exercises the wire format and
+            # keeps transfers atomic
+            st["tx_lock"].acquire()
+            with st["lock"]:
+                st["tx_id"] += 1
+                st["tx_buf"] = {}
+                return struct.pack("<i", st["tx_id"])
+        if op == ig.OP_TX_END:
+            r.i32()  # tx id
+            commit = r.u8() != 0
+            with st["lock"]:
+                if commit:
+                    for (cid, k), v in st["tx_buf"].items():
+                        st["caches"].setdefault(cid, {})[k] = v
+                st["tx_buf"] = {}
+            st["tx_lock"].release()
+            return b""
+        cache_id = r.i32()
+        flags = r.u8()
+        tx = r.i32() if flags & ig.FLAG_TRANSACTIONAL else None
+        with st["lock"]:
+            cache = st["caches"].setdefault(cache_id, {})
+            if tx is not None:
+                if op == ig.OP_CACHE_GET:
+                    k = ig.deser(r)
+                    if (cache_id, k) in st["tx_buf"]:
+                        return ig.ser(st["tx_buf"][(cache_id, k)])
+                    return ig.ser(cache.get(k))
+                if op == ig.OP_CACHE_PUT:
+                    k, v = ig.deser(r), ig.deser(r)
+                    st["tx_buf"][(cache_id, k)] = v
+                    return b""
+                raise RuntimeError(f"op {op} not transactional here")
+            if op == ig.OP_CACHE_GET:
+                return ig.ser(cache.get(ig.deser(r)))
+            if op == ig.OP_CACHE_PUT:
+                k, v = ig.deser(r), ig.deser(r)
+                cache[k] = v
+                return b""
+            if op == ig.OP_CACHE_GET_AND_PUT:
+                k, v = ig.deser(r), ig.deser(r)
+                old = cache.get(k)
+                cache[k] = v
+                return ig.ser(old)
+            if op == ig.OP_CACHE_PUT_IF_ABSENT:
+                k, v = ig.deser(r), ig.deser(r)
+                if k in cache:
+                    return ig.ser(False)
+                cache[k] = v
+                return ig.ser(True)
+            if op == ig.OP_CACHE_REPLACE_IF_EQUALS:
+                k, old, new = ig.deser(r), ig.deser(r), ig.deser(r)
+                if cache.get(k) == old and k in cache:
+                    cache[k] = new
+                    return ig.ser(True)
+                return ig.ser(False)
+        raise RuntimeError(f"unsupported op {op}")
+
+
+class FakeIgniteServer:
+    def __init__(self):
+        self.server = socketserver.ThreadingTCPServer(
+            ("127.0.0.1", 0), _Handler, bind_and_activate=True)
+        self.server.daemon_threads = True
+        self.server.state = {"lock": threading.Lock(),
+                             "tx_lock": threading.Lock(),
+                             "tx_id": 0, "tx_buf": {}, "caches": {}}
+        self.port = self.server.server_address[1]
+
+    def __enter__(self):
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+        return self
+
+    def __exit__(self, *exc):
+        self.server.shutdown()
+        self.server.server_close()
+
+    @property
+    def state(self):
+        return self.server.state

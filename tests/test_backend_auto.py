@@ -141,6 +141,35 @@ def test_two_pass_all_valid_skips_classify(monkeypatch):
     assert calls and not any(calls), calls
 
 
+def test_analyze_store_backend_cpu_routes_host_oracle(
+        tmp_path, monkeypatch, capsys):
+    """An explicit --backend cpu (exported as JEPSEN_TPU_BACKEND) must
+    run the batch sweep on the host oracle, not the device kernels."""
+    from jepsen_tpu import cli
+    from jepsen_tpu.checker.elle.synth import synth_append_history
+    from jepsen_tpu.history import history_to_edn
+    monkeypatch.setenv("JEPSEN_TPU_BACKEND", "cpu")
+
+    def boom(*a, **kw):
+        raise AssertionError("device sweep ran under --backend cpu")
+
+    monkeypatch.setattr(parallel, "check_bucketed", boom)
+    store = Store(tmp_path / "store")
+    for ts, kw in [("20260730T000000", {}),
+                   ("20260730T000001", {"g1c": True})]:
+        d = store.base / "etcd" / ts
+        d.mkdir(parents=True)
+        (d / "history.edn").write_text(history_to_edn(
+            synth_append_history(T=60, K=6, seed=4, **kw)))
+    rc = cli.analyze_store(store, checker="append")
+    assert rc == 1
+    import json as _json
+    lines = [_json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    assert lines[0]["valid?"] is True
+    assert lines[1]["valid?"] is False
+
+
 def test_two_pass_on_mesh():
     mesh = parallel.make_mesh()
     encs = _encs(9, 1)

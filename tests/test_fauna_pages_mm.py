@@ -1,0 +1,190 @@
+"""FaunaDB pages + multimonotonic workloads and the topology nemesis
+(VERDICT r2 item 9): fake-backed client round-trips, golden checker
+verdicts, and a full dummy-remote run of each workload."""
+
+from __future__ import annotations
+
+import pytest
+
+from jepsen_tpu import control, core, generator as gen, independent
+from jepsen_tpu.store import Store
+from jepsen_tpu.suites import faunadb
+from fake_fauna import FakeFaunaServer
+
+
+def hosts_for(srv):
+    return {n: ("127.0.0.1", srv.port)
+            for n in ("n1", "n2", "n3", "n4", "n5")}
+
+
+# ---------------------------------------------------------------------------
+# pages
+# ---------------------------------------------------------------------------
+
+def test_pages_client_group_add_and_read():
+    with FakeFaunaServer() as srv:
+        test = {"db-hosts": hosts_for(srv)}
+        c = faunadb.FaunaClient("pages").open(test, "n1")
+        kv = independent.tuple_
+        out = c.invoke(test, {"type": "invoke", "f": "add",
+                              "value": kv(1, [1, 5, -15, 23])})
+        assert out["type"] == "ok"
+        out = c.invoke(test, {"type": "invoke", "f": "add",
+                              "value": kv(1, [2, 7])})
+        assert out["type"] == "ok"
+        # another key's elements are invisible to key 1
+        assert c.invoke(test, {"type": "invoke", "f": "add",
+                               "value": kv(2, [100])})["type"] == "ok"
+        r = c.invoke(test, {"type": "invoke", "f": "read",
+                            "value": kv(1, None)})
+        assert r["type"] == "ok"
+        assert sorted(r["value"].value) == [-15, 1, 2, 5, 7, 23]
+
+
+def test_pages_checker_golden():
+    def op(ty, f, v, i):
+        return {"type": ty, "f": f, "value": v, "index": i}
+    # add [1,2] and [3,4]; a read seeing {1,2,3,4} is fine, {1,3,4} is
+    # a pagination-isolation violation (1 without 2)
+    base = [op("invoke", "add", [1, 2], 0), op("ok", "add", [1, 2], 1),
+            op("invoke", "add", [3, 4], 2), op("ok", "add", [3, 4], 3)]
+    good = base + [op("invoke", "read", None, 4),
+                   op("ok", "read", [1, 2, 3, 4], 5)]
+    bad = base + [op("invoke", "read", None, 4),
+                  op("ok", "read", [1, 3, 4], 5)]
+    chk = faunadb.PagesChecker()
+    assert chk.check({}, good, {})["valid?"] is True
+    res = chk.check({}, bad, {})
+    assert res["valid?"] is False
+    assert res["first-error"]["expected"] == [1, 2]
+    # a failed add never constrains reads
+    failed = [op("invoke", "add", [8, 9], 0), op("fail", "add", [8, 9], 1),
+              op("invoke", "read", None, 2), op("ok", "read", [], 3)]
+    assert chk.check({}, failed, {})["valid?"] is True
+
+
+def test_pages_workload_full_run(tmp_path):
+    with FakeFaunaServer() as srv:
+        wl = faunadb._pages_workload({"nodes": ["n1"],
+                                      "pages-ops-per-key": 30,
+                                      "pages-elements": 40})
+        t = {"name": "fauna pages", "nodes": ["n1", "n2", "n3"],
+             "concurrency": 4, "ssh": {"dummy": True},
+             "db-hosts": hosts_for(srv),
+             "client": wl["client"], "checker": wl["checker"],
+             "generator": gen.time_limit(
+                 3, gen.clients(wl["generator"])),
+             "store": Store(tmp_path / "store")}
+        t = core.run(t)
+        assert t["results"]["valid?"] is True
+        reads = [o for o in t["history"]
+                 if o.get("type") == "ok" and o.get("f") == "read"]
+        assert reads
+
+
+# ---------------------------------------------------------------------------
+# multimonotonic
+# ---------------------------------------------------------------------------
+
+def test_mm_client_write_read():
+    with FakeFaunaServer() as srv:
+        test = {"db-hosts": hosts_for(srv)}
+        c = faunadb.FaunaClient("multimonotonic").open(test, "n1")
+        assert c.invoke(test, {"type": "invoke", "f": "write",
+                               "value": {3: 0, 4: 10}})["type"] == "ok"
+        assert c.invoke(test, {"type": "invoke", "f": "write",
+                               "value": {3: 1}})["type"] == "ok"
+        r = c.invoke(test, {"type": "invoke", "f": "read",
+                            "value": [3, 4, 9]})
+        assert r["type"] == "ok"
+        v = r["value"]
+        assert v["ts"] is not None
+        assert v["registers"][3]["value"] == 1
+        assert v["registers"][4]["value"] == 10
+        assert 9 not in v["registers"]
+        # instance ts present and ordered
+        assert v["registers"][3]["ts"] is not None
+
+
+def _read_op(ts, regs, i):
+    return {"type": "ok", "f": "read", "index": i,
+            "value": {"ts": ts,
+                      "registers": {k: {"ts": None, "value": v}
+                                    for k, v in regs.items()}}}
+
+
+def test_ts_order_checker_golden():
+    chk = faunadb.TsOrderChecker()
+    good = [_read_op("t1", {0: 1}, 0), _read_op("t2", {0: 2}, 1)]
+    assert chk.check({}, good, {})["valid?"] is True
+    # later timestamp, lower value: nonmonotonic
+    bad = [_read_op("t1", {0: 2}, 0), _read_op("t2", {0: 1}, 1)]
+    res = chk.check({}, bad, {})
+    assert res["valid?"] is False and res["error-count"] == 1
+
+
+def test_read_skew_checker_golden():
+    chk = faunadb.ReadSkewChecker()
+    # r1 sees x=1,y=2; r2 sees x=2,y=1: each is in the other's future
+    bad = [_read_op("t1", {"x": 1, "y": 2}, 0),
+           _read_op("t2", {"x": 2, "y": 1}, 1)]
+    res = chk.check({}, bad, {})
+    assert res["valid?"] is False
+    assert res["errors"][0]["cycle-reads"] == [0, 1]
+    good = [_read_op("t1", {"x": 1, "y": 1}, 0),
+            _read_op("t2", {"x": 2, "y": 2}, 1)]
+    assert chk.check({}, good, {})["valid?"] is True
+
+
+def test_mm_workload_full_run(tmp_path):
+    with FakeFaunaServer() as srv:
+        wl = faunadb._mm_workload({"concurrency": 4})
+        t = {"name": "fauna mm", "nodes": ["n1", "n2", "n3"],
+             "concurrency": 4, "ssh": {"dummy": True},
+             "db-hosts": hosts_for(srv),
+             "client": wl["client"], "checker": wl["checker"],
+             "generator": gen.time_limit(
+                 2, gen.clients(wl["generator"])),
+             "store": Store(tmp_path / "store")}
+        t = core.run(t)
+        assert t["results"]["valid?"] is True, t["results"]
+        writes = [o for o in t["history"]
+                  if o.get("type") == "ok" and o.get("f") == "write"]
+        reads = [o for o in t["history"]
+                 if o.get("type") == "ok" and o.get("f") == "read"]
+        assert writes and reads
+
+
+# ---------------------------------------------------------------------------
+# topology nemesis
+# ---------------------------------------------------------------------------
+
+def test_topology_nemesis_ops():
+    test = {"nodes": ["n1", "n2", "n3", "n4", "n5"],
+            "ssh": {"dummy": True}}
+    remote = control.remote_for(test)
+    nem = faunadb.TopologyNemesis().setup(test)
+    out = nem.invoke(test, {"type": "info", "f": "remove-node"})
+    assert out["value"] == "n5"
+    cmds = " || ".join(str(p) for _, k, p in remote.actions
+                       if k == "execute")
+    assert "faunadb-admin remove" in cmds and "host-id n5" in cmds
+    remote.actions.clear()
+    out = nem.invoke(test, {"type": "info", "f": "add-node"})
+    assert out["value"] == "n5"
+    cmds = " || ".join(str(p) for _, k, p in remote.actions
+                       if k == "execute")
+    assert "join" in cmds
+    # removal floor: never removes below a majority + 1
+    nem2 = faunadb.TopologyNemesis().setup(test)
+    removed = [nem2.invoke(test, {"type": "info", "f": "remove-node"})
+               for _ in range(5)]
+    assert [o["value"] for o in removed[:2]] == ["n5", "n4"]
+    assert all(o["value"] == "too-few" for o in removed[2:])
+
+
+def test_topology_nemesis_selected_by_opts():
+    t = faunadb.faunadb_test({"nemesis": "topology", "time-limit": 1})
+    assert isinstance(t["nemesis"], faunadb.TopologyNemesis)
+    assert "pages" in faunadb.workloads() \
+        and "multimonotonic" in faunadb.workloads()

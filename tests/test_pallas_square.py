@@ -1,0 +1,68 @@
+"""Pallas closure-squaring kernel: interpreter-mode parity with the XLA
+formulation (the `-m tpu` tier runs the compiled kernel on hardware)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from jepsen_tpu.checker.elle import kernels as K
+from jepsen_tpu.checker.elle import pallas_square, synth
+
+
+def xla_square(m):
+    mb = jnp.asarray(m).astype(jnp.bfloat16)
+    return np.asarray(jax.lax.dot_general(
+        mb, mb, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32) > 0)
+
+
+@pytest.mark.parametrize("B,T", [(1, 128), (3, 128), (2, 256), (1, 384)])
+def test_square_parity_random(B, T):
+    rng = np.random.default_rng(B * 1000 + T)
+    m = rng.random((B, T, T)) < 0.02
+    m |= np.eye(T, dtype=bool)[None]
+    got = np.asarray(pallas_square.closure_square(
+        jnp.asarray(m), interpret=True))
+    assert (got == xla_square(m)).all()
+
+
+def test_square_empty_and_full():
+    for m in (np.zeros((1, 128, 128), bool),
+              np.ones((1, 128, 128), bool)):
+        got = np.asarray(pallas_square.closure_square(
+            jnp.asarray(m), interpret=True))
+        assert (got == xla_square(m)).all()
+
+
+def test_full_checker_verdicts_through_pallas(monkeypatch):
+    """The whole check path (edge build -> fixpoint closure ->
+    classification) with the Pallas squaring in interpreter mode must
+    produce the same flag words as the XLA path."""
+    monkeypatch.setattr(pallas_square, "INTERPRET", True)
+    batch = synth.synth_valid_batch(B=3, T=96, K=8, seed=5)
+    batch = synth.inject_g1c(batch, np.asarray([1]), 8)
+    shape = batch["shape"]
+    names = ("appends", "reads", "invoke_index", "complete_index",
+             "process", "n_txns")
+    args = tuple(jnp.asarray(batch[k]) for k in names)
+    kw = dict(n_keys=shape.n_keys, max_pos=shape.max_pos,
+              n_txns=shape.n_txns, steps=K.closure_steps(shape.n_txns))
+    for classify in (False, True):
+        xla = np.asarray(K.check_batch_device(
+            *args, classify=classify, use_pallas=False, **kw))
+        pal = np.asarray(K.check_batch_device(
+            *args, classify=classify, use_pallas=True, **kw))
+        assert (xla == pal).all(), (classify, xla, pal)
+    assert pal[1] & (1 << K.G1C)
+    assert pal[0] == 0 and pal[2] == 0
+
+
+@pytest.mark.tpu
+def test_square_parity_on_hardware():
+    rng = np.random.default_rng(7)
+    m = rng.random((2, 512, 512)) < 0.01
+    m |= np.eye(512, dtype=bool)[None]
+    got = np.asarray(pallas_square.closure_square(jnp.asarray(m)))
+    assert (got == xla_square(m)).all()

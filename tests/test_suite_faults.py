@@ -1,0 +1,55 @@
+"""Any suite can opt into the combined nemesis bundle via opts
+{"faults": [...]} / the CLI --faults flag (VERDICT r2 weak 7 — the
+packages existed but only cockroach wired a menu)."""
+
+from jepsen_tpu import core, generator as gen, net as jnet, workloads
+from jepsen_tpu.store import Store
+from jepsen_tpu.suites import etcd, suite_test
+
+
+def test_suite_test_builds_combined_nemesis():
+    plain = etcd.etcd_test({"time-limit": 1})
+    t = etcd.etcd_test({"time-limit": 1,
+                        "faults": ["partition", "kill", "pause"]})
+    # the composed package's nemesis replaces the suite default
+    # (etcd's DB supports Process+Pause, so kill/pause compose in)
+    assert type(t["nemesis"]) is not type(plain["nemesis"])
+    kill_only = etcd.etcd_test({"time-limit": 1, "faults": ["kill"]})
+    # EtcdDB implements Process, so the kill package composes in
+    # rather than degrading to the noop nemesis
+    from jepsen_tpu.nemesis import NoopNemesis
+    assert not isinstance(kill_only["nemesis"], NoopNemesis)
+
+
+def test_faults_run_executes_fault_ops(tmp_path):
+    db, client = workloads.atom_fixtures()
+    t = suite_test(
+        "atom", "reg",
+        {"time-limit": 2, "nemesis-interval": 0.3,
+         "faults": ["partition"], "nodes": ["n1", "n2", "n3"],
+         "concurrency": 3, "ssh": {"dummy": True},
+         "extra": {"net": jnet.iptables()}},
+        {"reg": lambda: {
+            "generator": gen.stagger(
+                0.02, gen.repeat_gen({"f": "read"})),
+            "checker": None}},
+        db=db, client=client)
+    t["store"] = Store(tmp_path / "store")
+    t = core.run(t)
+    nem_ops = [o for o in t["history"]
+               if o.get("process") == "nemesis"
+               and o.get("type") == "info" and o.get("f")]
+    fs = {o["f"] for o in nem_ops}
+    assert any("partition" in str(f) for f in fs), fs
+
+
+def test_cli_faults_flag_parses():
+    from jepsen_tpu import cli
+    import argparse
+    p = argparse.ArgumentParser()
+    cli.add_test_opts(p)
+    args = p.parse_args(["--faults", "partition, kill"])
+    t = cli.test_map_from_args(args)
+    assert t["faults"] == ["partition", "kill"]
+    args = p.parse_args([])
+    assert "faults" not in cli.test_map_from_args(args)
