@@ -134,6 +134,52 @@ class AppendChecker(Checker):
         verdict = render_verdict(enc, cycles, self.prohibited)
         return artifacts.attach(verdict, divergent, test, opts)
 
+    def render_failure(self, test, history, res, opts) -> None:
+        """Per-key artifact hook: `independent.checker` calls this with
+        the key's subdirectory opts for each invalid batch result, so
+        batched dispatch still leaves elle/ witness artifacts for the
+        keys that failed."""
+        from . import artifacts
+        artifacts.attach(res, res.get("device-host-divergence", {}),
+                         test, opts)
+
+    def check_batch(self, test, histories: list, opts) -> list[dict]:
+        """Check MANY histories in one bucketed device sweep — the
+        route `independent.checker` takes so per-key subhistories
+        share dispatches (and the detect-then-classify two-pass)
+        instead of fanning out over host threads. Flagged histories
+        re-run the host oracle for witness cycles; verdicts match
+        check() minus store artifacts (per-key artifact dirs are the
+        independent layer's concern)."""
+        from ...devices import resolve_backend
+        backend = resolve_backend(self.backend)
+        encs = [encode_history(h) for h in histories]
+        kw = dict(realtime=self.realtime,
+                  process_order=self.process_order)
+        if backend != "tpu":
+            return [render_verdict(e, cycle_anomalies_cpu(e, **kw),
+                                   self.prohibited) for e in encs]
+        from ... import parallel
+        mesh = None
+        try:
+            mesh = parallel.make_mesh()
+        except Exception:
+            pass
+        cycles_list = parallel.check_bucketed(encs, mesh, **kw)
+        from . import artifacts
+        out = []
+        for enc, cycles in zip(encs, cycles_list):
+            divergent: dict = {}
+            if cycles:
+                cycles, divergent = artifacts.device_host_refine(
+                    cycles,
+                    lambda enc=enc: cycle_anomalies_cpu(enc, **kw))
+            verdict = render_verdict(enc, cycles, self.prohibited)
+            if divergent:  # either direction means a path is wrong
+                verdict["device-host-divergence"] = divergent
+            out.append(verdict)
+        return out
+
 
 def append_checker(anomalies: Iterable[str] = ("G1", "G2"),
                    backend: str = "auto", realtime: bool = False,

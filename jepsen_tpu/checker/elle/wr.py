@@ -444,6 +444,44 @@ class WrChecker(Checker):
         verdict = render_wr_verdict(enc, cycles, self.prohibited)
         return artifacts.attach(verdict, divergent, test, opts)
 
+    def render_failure(self, test, history, res, opts) -> None:
+        """Per-key artifact hook for batched independent dispatch."""
+        from . import artifacts
+        artifacts.attach(res, res.get("device-host-divergence", {}),
+                         test, opts)
+
+    def check_batch(self, test, histories: list, opts) -> list[dict]:
+        """Batched per-key dispatch: host version-order inference per
+        history, then ONE device cycle dispatch over the packed edge
+        matrices (kernels.check_edge_batch); flagged histories re-run
+        the host oracle for witnesses."""
+        from ...devices import resolve_backend
+        backend = resolve_backend(self.backend)
+        encs = [encode_wr_history(h, **self.opts) for h in histories]
+        kw = dict(realtime=self.realtime,
+                  process_order=self.process_order)
+        if backend != "tpu":
+            return [render_wr_verdict(e, cycle_anomalies_cpu(e, **kw),
+                                      self.prohibited) for e in encs]
+        from . import artifacts, kernels
+        cycles_list = kernels.check_edge_batch(
+            [{"n": e.n, "edges": e.edges,
+              "invoke_index": e.invoke_index,
+              "complete_index": e.complete_index,
+              "process": e.process} for e in encs], **kw)
+        out = []
+        for enc, cycles in zip(encs, cycles_list):
+            divergent: dict = {}
+            if cycles:
+                cycles, divergent = artifacts.device_host_refine(
+                    cycles,
+                    lambda enc=enc: cycle_anomalies_cpu(enc, **kw))
+            verdict = render_wr_verdict(enc, cycles, self.prohibited)
+            if divergent:
+                verdict["device-host-divergence"] = divergent
+            out.append(verdict)
+        return out
+
 
 def rw_register_checker(anomalies: Iterable[str] = ("G2", "G1a", "G1b",
                                                     "internal"),

@@ -337,7 +337,10 @@ def analyze_store(store: Store, checker: str = "append",
                 worst = max(worst, emit(d, res))
         else:  # wr: edge lists are host-built; one device dispatch
             if host_only:
-                cycles_per_run = [elle.cycle_anomalies_cpu(e)
+                # wr encodings carry prebuilt edges; the wr module's
+                # own host analyzer consumes them (the append-side
+                # cycle_anomalies_cpu would look for .appends)
+                cycles_per_run = [elle_wr.cycle_anomalies_cpu(e)
                                   for e in encs]
             else:
                 cycles_per_run = elle_kernels.check_edge_batch(

@@ -49,6 +49,39 @@ class Pause:
         raise NotImplementedError
 
 
+class SignalProcess(Process, Pause):
+    """Mixin implementing the kill/pause/resume fault protocols for DBs
+    whose server is a plain daemonized process: signals matched on
+    `process_pattern` (the reference's grepkill!/hammer-time route,
+    control/util.clj:238, nemesis.clj:380), restart via the DB's own
+    `_start(sess, test, node)` launcher. Subclasses set
+    `process_pattern` and factor their setup-time daemon launch into
+    `_start` so the combined kill package can restart them."""
+
+    process_pattern: str = ""
+
+    def _start(self, sess, test: dict, node: str) -> None:
+        raise NotImplementedError
+
+    def _signal(self, sig: str) -> None:
+        from .control import util as cutil
+        assert self.process_pattern, type(self).__name__
+        cutil.grepkill(control.current_session().su(),
+                       self.process_pattern, signal=sig)
+
+    def start(self, test, node):
+        self._start(control.current_session().su(), test, node)
+
+    def kill(self, test, node):
+        self._signal("KILL")
+
+    def pause(self, test, node):
+        self._signal("STOP")
+
+    def resume(self, test, node):
+        self._signal("CONT")
+
+
 class Primary:
     """DBs with a distinguished primary (db.clj:15-20)."""
 

@@ -204,6 +204,18 @@ def time(s: str) -> Expr:
     return _fn(time=s)
 
 
+def lambda_(params: list[str] | str, body) -> Expr:
+    return Expr({"lambda": params, "expr": wrap(body)})
+
+
+def map_(f: Expr, collection) -> Expr:
+    return Expr({"map": wrap(f), "collection": wrap(collection)})
+
+
+def foreach(f: Expr, collection) -> Expr:
+    return Expr({"foreach": wrap(f), "collection": wrap(collection)})
+
+
 def at(ts, expr) -> Expr:
     return Expr({"at": wrap(ts), "expr": wrap(expr)})
 

@@ -27,18 +27,17 @@ LOGFILE = f"{DIR}/cockroach.log"
 PIDFILE = f"{DIR}/cockroach.pid"
 
 
-class CockroachDB(jdb.DB, jdb.LogFiles):
+class CockroachDB(jdb.DB, jdb.SignalProcess, jdb.LogFiles):
     """Tarball install + `cockroach start --join` cluster
-    (cockroachdb/src/jepsen/cockroach.clj's db)."""
+    (cockroachdb/src/jepsen/cockroach.clj's db); kill/pause fault
+    protocols via SignalProcess."""
+
+    process_pattern = "cockroach"
 
     def __init__(self, version: str = VERSION):
         self.version = version
 
-    def setup(self, test, node):
-        sess = control.current_session().su()
-        url = (f"https://binaries.cockroachdb.com/"
-               f"cockroach-{self.version}.linux-amd64.tgz")
-        cutil.install_archive(sess, url, DIR)
+    def _start(self, sess, test, node):
         join = ",".join(f"{n}:26257" for n in test.get("nodes", []))
         cutil.start_daemon(
             sess, BINARY, "start", "--insecure",
@@ -47,6 +46,13 @@ class CockroachDB(jdb.DB, jdb.LogFiles):
             "--http-addr", f"{node}:8080",
             "--join", join,
             logfile=LOGFILE, pidfile=PIDFILE, chdir=DIR)
+
+    def setup(self, test, node):
+        sess = control.current_session().su()
+        url = (f"https://binaries.cockroachdb.com/"
+               f"cockroach-{self.version}.linux-amd64.tgz")
+        cutil.install_archive(sess, url, DIR)
+        self._start(sess, test, node)
         if node == (test.get("nodes") or [node])[0]:
             # The daemon launch returns before the server listens; retry
             # init until it connects. "already been initialized" (from a

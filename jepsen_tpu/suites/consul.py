@@ -33,18 +33,17 @@ LOGFILE = f"{DIR}/consul.log"
 DATA_DIR = f"{DIR}/data"
 
 
-class ConsulDB(jdb.DB, jdb.LogFiles):
+class ConsulDB(jdb.DB, jdb.SignalProcess, jdb.LogFiles):
     """Zip install + `consul agent -server` with node 0 bootstrapping
-    and the rest joining it (db.clj:23-52)."""
+    and the rest joining it (db.clj:23-52); kill/pause fault protocols
+    via SignalProcess."""
+
+    process_pattern = "consul"
 
     def __init__(self, version: str = VERSION):
         self.version = version
 
-    def setup(self, test, node):
-        sess = control.current_session().su()
-        url = (f"https://releases.hashicorp.com/consul/{self.version}/"
-               f"consul_{self.version}_linux_amd64.zip")
-        cutil.install_archive(sess, url, DIR)
+    def _start(self, sess, test, node):
         nodes = test.get("nodes", [node])
         args = [BINARY, "agent", "-server",
                 "-data-dir", DATA_DIR,
@@ -56,6 +55,13 @@ class ConsulDB(jdb.DB, jdb.LogFiles):
             args += ["-retry-join", nodes[0]]
         cutil.start_daemon(sess, *args, logfile=LOGFILE,
                            pidfile=PIDFILE, chdir=DIR)
+
+    def setup(self, test, node):
+        sess = control.current_session().su()
+        url = (f"https://releases.hashicorp.com/consul/{self.version}/"
+               f"consul_{self.version}_linux_amd64.zip")
+        cutil.install_archive(sess, url, DIR)
+        self._start(sess, test, node)
 
     def teardown(self, test, node):
         sess = control.current_session().su()

@@ -30,12 +30,23 @@ PORT = 7711
 QUEUE = "jepsen"
 
 
-class DisqueDB(jdb.DB, jdb.LogFiles):
+class DisqueDB(jdb.DB, jdb.SignalProcess, jdb.LogFiles):
     """git clone + make + daemonize + CLUSTER MEET fan-in
-    (install!/start!/join!, disque.clj:40-106)."""
+    (install!/start!/join!, disque.clj:40-106); kill/pause fault
+    protocols via SignalProcess."""
+
+    process_pattern = "disque-server"
 
     def __init__(self, version: str = VERSION):
         self.version = version
+
+    def _start(self, sess, test, node):
+        cutil.start_daemon(
+            sess, BINARY,
+            "--port", str(PORT),
+            "--cluster-enabled", "yes",
+            "--appendonly", "yes",
+            logfile=LOGFILE, pidfile=PIDFILE, chdir=DIR)
 
     def setup(self, test, node):
         sess = control.current_session().su()
@@ -44,12 +55,7 @@ class DisqueDB(jdb.DB, jdb.LogFiles):
                   f"https://github.com/antirez/disque {DIR}")
         sess.exec("sh", "-c",
                   f"cd {DIR} && git checkout {self.version} && make")
-        cutil.start_daemon(
-            sess, BINARY,
-            "--port", str(PORT),
-            "--cluster-enabled", "yes",
-            "--appendonly", "yes",
-            logfile=LOGFILE, pidfile=PIDFILE, chdir=DIR)
+        self._start(sess, test, node)
         nodes = test.get("nodes", [])
         dummy = bool(test.get("ssh", {}).get("dummy"))
         if node == (nodes[0] if nodes else node) and not dummy:

@@ -52,10 +52,11 @@ def initial_cluster(test: dict) -> str:
     return ",".join(f"{n}={peer_url(n)}" for n in test.get("nodes", []))
 
 
-class EtcdDB(jdb.DB, jdb.Process, jdb.Pause, jdb.LogFiles):
-    """Tarball install + daemonized etcd (db, etcd.clj:51-86); start/
-    kill/pause/resume implement the db.clj:22-35 fault protocols so the
-    combined kill/pause nemesis packages apply."""
+class EtcdDB(jdb.DB, jdb.SignalProcess, jdb.LogFiles):
+    """Tarball install + daemonized etcd (db, etcd.clj:51-86);
+    kill/pause fault protocols (db.clj:22-35) via SignalProcess."""
+
+    process_pattern = "etcd"
 
     def __init__(self, version: str = VERSION):
         self.version = version
@@ -85,21 +86,6 @@ class EtcdDB(jdb.DB, jdb.Process, jdb.Pause, jdb.LogFiles):
         sess = control.current_session().su()
         cutil.stop_daemon(sess, PIDFILE)
         sess.exec("rm", "-rf", DIR)
-
-    def start(self, test, node):
-        self._start(control.current_session().su(), test, node)
-
-    def kill(self, test, node):
-        cutil.grepkill(control.current_session().su(), "etcd",
-                       signal="KILL")
-
-    def pause(self, test, node):
-        cutil.grepkill(control.current_session().su(), "etcd",
-                       signal="STOP")
-
-    def resume(self, test, node):
-        cutil.grepkill(control.current_session().su(), "etcd",
-                       signal="CONT")
 
     def log_files(self, test, node):
         return [LOGFILE]

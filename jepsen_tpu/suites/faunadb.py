@@ -162,6 +162,86 @@ class FaunaClient(jclient.Client):
                     "values": [{"field": ["data", "value"]}]})))
         elif self.mode == "multimonotonic":
             self._upsert_class(q, "registers")
+        elif self.mode == "internal":
+            self._upsert_class(q, "cats")
+            self.conn.query(q.if_(
+                q.exists(q.index("cats_by_type")), None,
+                q.create_index({
+                    "name": "cats_by_type",
+                    "source": q.class_("cats"),
+                    "active": True,
+                    "terms": [{"field": ["data", "type"]}],
+                    "values": [{"field": ["ref"]},
+                               {"field": ["data", "name"]}]})))
+
+    # -- internal-consistency mode (faunadb/internal.clj) ------------------
+
+    @staticmethod
+    def _cats_pairs(q, typ):
+        """[[ref, name], ...] for cats of `typ` via the index."""
+        return q.select(["data"], q.paginate(
+            q.match(q.index("cats_by_type"), typ), size=1024))
+
+    @classmethod
+    def _cats_names(cls, q, typ):
+        return q.map_(q.lambda_(["r", "name"], q.var("name")),
+                      cls._cats_pairs(q, typ))
+
+    @classmethod
+    def _delete_by_type(cls, q, typ):
+        refs = q.map_(q.lambda_(["r", "name"], q.var("r")),
+                      cls._cats_pairs(q, typ))
+        return q.foreach(
+            q.lambda_("r", q.when(q.exists(q.var("r")),
+                                  q.delete(q.var("r")))), refs)
+
+    def _internal_dispatch(self, q, op, f, v):
+        """internal.clj:69-133: one txn creates a cat and reads the
+        index before/after INSIDE the txn, through three differently-
+        shaped queries (let bindings, object literal, array literal) —
+        all must observe the txn's own effects identically."""
+        create = q.create(q.class_("cats"),
+                          {"data": {"type": "tabby", "name": v}})
+        match = self._cats_names(q, "tabby")
+        if f == "reset":
+            self.conn.query(q.do(self._delete_by_type(q, "tabby"),
+                                 self._delete_by_type(q, "calico")))
+            return {**op, "type": "ok"}
+        if f == "create-tabby-let":
+            res = self.conn.query(q.let(
+                {"t": q.time("now")},
+                q.let({"tabbies_0": q.at(q.var("t"), match),
+                       "tabby": create,
+                       "tabbies_1": q.at(q.var("t"), match)},
+                      # reversed key order vs the bindings, like the
+                      # reference, so we check let scoping not literals
+                      {"tabbies-1": q.var("tabbies_1"),
+                       "tabby": q.var("tabby"),
+                       "tabbies-0": q.var("tabbies_0")})))
+        elif f == "create-tabby-obj":
+            r = self.conn.query({"c": match, "a": create, "b": match})
+            res = {"tabbies-0": r["c"], "tabby": r["a"],
+                   "tabbies-1": r["b"]}
+        elif f == "create-tabby-arr":
+            r = self.conn.query([match, create, match])
+            res = {"tabbies-0": r[0], "tabby": r[1], "tabbies-1": r[2]}
+        elif f == "change-type":
+            refs1 = q.map_(q.lambda_(["r", "name"], q.var("r")),
+                           q.select(["data"], q.paginate(
+                               q.match(q.index("cats_by_type"),
+                                       "tabby"), size=1)))
+            r = self.conn.query([
+                q.let({"rs": refs1},
+                      q.when(q.not_(q.equals(q.var("rs"), [])),
+                             q.update(q.select([0], q.var("rs")),
+                                      {"data": {"type": "calico"}}))),
+                match, self._cats_names(q, "calico")])
+            return {**op, "type": "ok",
+                    "value": {"cat": r[0], "tabbies": r[1],
+                              "calicos": r[2]}}
+        else:
+            return {**op, "type": "fail", "error": f"unknown f {f!r}"}
+        return {**op, "type": "ok", "value": res}
 
     def close(self, test):
         self.conn = None
@@ -318,6 +398,8 @@ class FaunaClient(jclient.Client):
                             "value": (inst.get("data") or {}).get("value")}
                 return {**op, "type": "ok",
                         "value": {"ts": ts, "registers": registers}}
+        elif self.mode == "internal":
+            return self._internal_dispatch(q, op, f, v)
         elif self.mode == "g2":
             if f == "insert":
                 k, ids = (v.key, v.value) if independent.is_tuple(v) \
@@ -582,6 +664,130 @@ def _mm_workload(opts: dict) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# internal transaction consistency (internal.clj)
+# ---------------------------------------------------------------------------
+
+class InternalChecker(jchecker.Checker):
+    """Each create txn must NOT see its new cat in the pre-create read
+    and MUST see it in the post-create read (both inside the same txn);
+    change-type moves a cat between both index reads atomically
+    (internal.clj:140-206)."""
+
+    @staticmethod
+    def _op_errors(op):
+        v = op.get("value") or {}
+        f = op.get("f")
+        errs = []
+        if f in ("create-tabby-let", "create-tabby-obj",
+                 "create-tabby-arr"):
+            name = ((v.get("tabby") or {}).get("data") or {}).get("name")
+            if name in (v.get("tabbies-0") or []):
+                errs.append({"type": "present-before-create",
+                             "name": name, "op-index": op.get("index")})
+            if name not in (v.get("tabbies-1") or []):
+                errs.append({"type": "missing-after-create",
+                             "name": name, "op-index": op.get("index")})
+        elif f == "change-type":
+            cat = v.get("cat")
+            name = ((cat or {}).get("data") or {}).get("name")
+            if name is not None:
+                if name in (v.get("tabbies") or []):
+                    errs.append({"type": "present-after-change",
+                                 "name": name,
+                                 "op-index": op.get("index")})
+                if name not in (v.get("calicos") or []):
+                    errs.append({"type": "missing-after-change",
+                                 "name": name,
+                                 "op-index": op.get("index")})
+        return errs
+
+    def check(self, test, history, opts):
+        errors = [e for o in history if o.get("type") == "ok"
+                  for e in self._op_errors(o)]
+        return {"valid?": not errors,
+                "error-count": len(errors),
+                "error-types": sorted({e["type"] for e in errors}),
+                "errors": errors[:16]}
+
+
+def _internal_workload(opts: dict) -> dict:
+    counter = {"i": -1}
+
+    def create(f):
+        def g(test=None, ctx=None):
+            counter["i"] += 1
+            return {"type": "invoke", "f": f, "value": counter["i"]}
+        return g
+
+    return {
+        "client": FaunaClient(mode="internal"),
+        "generator": gen.stagger(0.1, gen.mix(
+            [create("create-tabby-let"), create("create-tabby-obj"),
+             create("create-tabby-arr"),
+             gen.repeat_gen({"type": "invoke", "f": "change-type",
+                             "value": None})])),
+        "checker": InternalChecker(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# replica-aware partitions (faunadb/nemesis.clj:20-55 + topology.clj:12-30)
+# ---------------------------------------------------------------------------
+
+def nodes_by_replica(nodes: list, replica_count: int = 3) -> dict:
+    """The reference's initial layout: node i lives in replica
+    i mod replica-count (topology.clj:12-30)."""
+    out: dict = {}
+    for i, n in enumerate(nodes):
+        out.setdefault(f"replica-{i % replica_count}", []).append(n)
+    return out
+
+
+def intra_replica_grudge(replica_count: int = 3):
+    """Partition INSIDE one randomly-chosen replica; nodes of other
+    replicas keep uninterrupted connectivity to both halves
+    (nemesis.clj:29-41)."""
+    import random as _r
+
+    def f(nodes):
+        groups = sorted(nodes_by_replica(nodes, replica_count).items())
+        _replica, members = _r.choice(groups)
+        members = _r.sample(members, len(members))
+        return jnemesis.complete_grudge(jnemesis.bisect(members))
+    return f
+
+
+def inter_replica_grudge(replica_count: int = 3):
+    """Partition BETWEEN replicas: split the set of replicas in half
+    and cut every cross-half link (nemesis.clj:42-55)."""
+    import random as _r
+
+    def f(nodes):
+        groups = list(nodes_by_replica(nodes, replica_count).values())
+        _r.shuffle(groups)
+        halves = jnemesis.bisect(groups)
+        flat = [[n for g in h for n in g] for h in halves]
+        return jnemesis.complete_grudge(flat)
+    return f
+
+
+def single_node_grudge(nodes):
+    """Isolate one node from everyone (nemesis.clj:20-28)."""
+    return jnemesis.complete_grudge(jnemesis.split_one(nodes))
+
+
+FAUNA_NEMESES = {
+    "partition": jnemesis.partition_random_halves,
+    "single-node-partition":
+        lambda: jnemesis.partitioner(single_node_grudge),
+    "intra-replica-partition":
+        lambda: jnemesis.partitioner(intra_replica_grudge()),
+    "inter-replica-partition":
+        lambda: jnemesis.partitioner(inter_replica_grudge()),
+}
+
+
+# ---------------------------------------------------------------------------
 # topology-change nemesis (topology.clj + auto.clj:107-124,273-280)
 # ---------------------------------------------------------------------------
 
@@ -648,15 +854,18 @@ def workloads(opts: dict | None = None) -> dict:
     o = opts or {}
     out["pages"] = lambda: _pages_workload(o)
     out["multimonotonic"] = lambda: _mm_workload(o)
+    out["internal"] = lambda: _internal_workload(o)
     return out
 
 
 def faunadb_test(opts: dict | None = None) -> dict:
     opts = base_opts(**(opts or {}))
     wname = opts.get("workload", "register")
-    nem = (TopologyNemesis()
-           if opts.get("nemesis") == "topology"
-           else jnemesis.partition_random_halves())
+    choice = opts.get("nemesis", "partition")
+    if choice == "topology":
+        nem = TopologyNemesis()
+    else:
+        nem = FAUNA_NEMESES.get(choice, FAUNA_NEMESES["partition"])()
     return suite_test(
         "faunadb", wname, opts, workloads(opts),
         db=FaunaDB(opts.get("version", "2.5.5")),

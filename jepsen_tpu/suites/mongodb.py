@@ -36,21 +36,19 @@ RS = "jepsen"
 MAJORITY = {"w": "majority"}
 
 
-class MongoDB(jdb.DB, jdb.LogFiles):
+class MongoDB(jdb.DB, jdb.SignalProcess, jdb.LogFiles):
     """Tarball mongod with --replSet; node 0 initiates the set over
-    the wire protocol once every member is up."""
+    the wire protocol once every member is up. kill/pause fault
+    protocols via SignalProcess."""
+
+    process_pattern = "mongod"
 
     def __init__(self, version: str = VERSION,
                  storage_engine: str = "wiredTiger"):
         self.version = version
         self.storage_engine = storage_engine
 
-    def setup(self, test, node):
-        sess = control.current_session().su()
-        url = (f"https://fastdl.mongodb.org/linux/"
-               f"mongodb-linux-x86_64-{self.version}.tgz")
-        cutil.install_archive(sess, url, DIR)
-        sess.exec("mkdir", "-p", f"{DIR}/data")
+    def _start(self, sess, test, node):
         cutil.start_daemon(
             sess, f"{DIR}/bin/mongod",
             "--dbpath", f"{DIR}/data",
@@ -59,6 +57,14 @@ class MongoDB(jdb.DB, jdb.LogFiles):
             "--replSet", RS,
             "--storageEngine", self.storage_engine,
             logfile=LOGFILE, pidfile=PIDFILE, chdir=DIR)
+
+    def setup(self, test, node):
+        sess = control.current_session().su()
+        url = (f"https://fastdl.mongodb.org/linux/"
+               f"mongodb-linux-x86_64-{self.version}.tgz")
+        cutil.install_archive(sess, url, DIR)
+        sess.exec("mkdir", "-p", f"{DIR}/data")
+        self._start(sess, test, node)
         nodes = test.get("nodes", [node])
         dummy = bool(test.get("ssh", {}).get("dummy"))
         if node == nodes[0] and not dummy:

@@ -24,9 +24,16 @@ QUEUE = "jepsen.queue"
 LOGFILE = "/var/log/rabbitmq/rabbit.log"
 
 
-class RabbitDB(jdb.DB, jdb.LogFiles):
+class RabbitDB(jdb.DB, jdb.SignalProcess, jdb.LogFiles):
     """apt install + erlang cookie + join_cluster fan-in
-    (db, rabbitmq.clj:30-100)."""
+    (db, rabbitmq.clj:30-100); kill/pause fault protocols via
+    SignalProcess (the beam VM hosts the broker, so signals target
+    the rabbitmq process tree)."""
+
+    process_pattern = "rabbitmq"
+
+    def _start(self, sess, test, node):
+        sess.exec("service", "rabbitmq-server", "start")
 
     def setup(self, test, node):
         sess = control.current_session().su()

@@ -30,15 +30,13 @@ PIDFILE = f"{DIR}/raftis.pid"
 LOGFILE = f"{DIR}/raftis.log"
 
 
-class RaftisDB(jdb.DB, jdb.LogFiles):
-    """go build + daemonize with the peer list (db, raftis.clj:79-110)."""
+class RaftisDB(jdb.DB, jdb.SignalProcess, jdb.LogFiles):
+    """go build + daemonize with the peer list (db, raftis.clj:79-110);
+    kill/pause fault protocols via SignalProcess."""
 
-    def setup(self, test, node):
-        sess = control.current_session().su()
-        sess.exec("sh", "-c",
-                  f"test -d {DIR} || git clone "
-                  f"https://github.com/goraft/raftis {DIR}")
-        sess.exec("sh", "-c", f"cd {DIR} && go build -o raftis .")
+    process_pattern = "raftis"
+
+    def _start(self, sess, test, node):
         nodes = test.get("nodes", [node])
         cluster = ",".join(f"{n}:{PORT}" for n in nodes)
         cutil.start_daemon(
@@ -46,6 +44,14 @@ class RaftisDB(jdb.DB, jdb.LogFiles):
             "-hosts", cluster,
             "-bind", f"{node}:{PORT}",
             logfile=LOGFILE, pidfile=PIDFILE, chdir=DIR)
+
+    def setup(self, test, node):
+        sess = control.current_session().su()
+        sess.exec("sh", "-c",
+                  f"test -d {DIR} || git clone "
+                  f"https://github.com/goraft/raftis {DIR}")
+        sess.exec("sh", "-c", f"cd {DIR} && go build -o raftis .")
+        self._start(sess, test, node)
 
     def teardown(self, test, node):
         sess = control.current_session().su()

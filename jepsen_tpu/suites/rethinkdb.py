@@ -23,12 +23,18 @@ from .sql import resolve
 LOGFILE = "/var/log/rethinkdb.log"
 
 
-class RethinkDB(jdb.DB, jdb.LogFiles):
+class RethinkDB(jdb.DB, jdb.SignalProcess, jdb.LogFiles):
     """apt repo + service, joining node 0 (install!/start!,
-    rethinkdb.clj:52-100)."""
+    rethinkdb.clj:52-100); kill/pause fault protocols via
+    SignalProcess."""
+
+    process_pattern = "rethinkdb"
 
     def __init__(self, version: str = "2.3.4~0jessie"):
         self.version = version
+
+    def _start(self, sess, test, node):
+        sess.exec("service", "rethinkdb", "start")
 
     def setup(self, test, node):
         sess = control.current_session().su()

@@ -43,12 +43,18 @@ def zoo_cfg(test: dict) -> str:
     return "\n".join(lines)
 
 
-class ZookeeperDB(jdb.DB, jdb.LogFiles):
+class ZookeeperDB(jdb.DB, jdb.SignalProcess, jdb.LogFiles):
     """apt install + myid + zoo.cfg + service restart
-    (db, zookeeper.clj:40-66)."""
+    (db, zookeeper.clj:40-66); kill/pause fault protocols via
+    SignalProcess."""
+
+    process_pattern = "zookeeper"
 
     def __init__(self, version: str = VERSION):
         self.version = version
+
+    def _start(self, sess, test, node):
+        sess.exec("service", "zookeeper", "start")
 
     def setup(self, test, node):
         sess = control.current_session().su()

@@ -37,6 +37,7 @@ class FaunaStore:
         self.indexes: dict[str, dict] = {}
         self.instances: dict[tuple, dict] = {}   # (cls, id) -> data
         self.ts = 0
+        self.next_id = 1000
         self.lock = threading.RLock()
         self.journal: list | None = None
 
@@ -71,6 +72,25 @@ class FaunaStore:
         if isinstance(v, tuple) and v and v[0] == "ref":
             return v[1], v[2]
         raise BadRequest("invalid expression", f"not a ref: {v!r}")
+
+    def _apply(self, f, item):
+        """Apply an evaluated lambda to one collection item; multi-param
+        lambdas destructure list items positionally."""
+        if not (isinstance(f, tuple) and f and f[0] == "lambda"):
+            raise BadRequest("invalid expression", f"not a lambda: {f!r}")
+        _, params, body, closure = f
+        env = dict(closure)
+        if isinstance(params, str):
+            params = [params]
+        if len(params) == 1:
+            env[params[0]] = item
+        else:
+            if not isinstance(item, (list, tuple)) or \
+                    len(item) != len(params):
+                raise BadRequest("invalid expression",
+                                 f"arity {len(params)} vs {item!r}")
+            env.update(zip(params, item))
+        return self.eval(body, env)
 
     def _doc(self, cls, id):
         data = self.instances[(cls, str(id))]
@@ -139,8 +159,25 @@ class FaunaStore:
             self.indexes[params["name"]] = params
             return {"ref": _ref_json("indexes", params["name"])}
 
+        if "lambda" in x:
+            return ("lambda", x["lambda"], x["expr"], dict(env))
+        if "map" in x:
+            f = self.eval(x["map"], env)
+            coll = self.eval(x["collection"], env)
+            return [self._apply(f, item) for item in coll]
+        if "foreach" in x:
+            f = self.eval(x["foreach"], env)
+            coll = self.eval(x["collection"], env)
+            for item in coll:
+                self._apply(f, item)
+            return coll
         if "create" in x:
-            cls, id = self._to_ref(self.eval(x["create"], env))
+            target = self.eval(x["create"], env)
+            if isinstance(target, tuple) and target[0] == "class":
+                # auto-generated document id (Create on a class ref)
+                self.next_id += 1
+                target = ("ref", target[1], str(self.next_id))
+            cls, id = self._to_ref(target)
             key = (cls, str(id))
             if key in self.instances:
                 raise BadRequest("instance already exists",
@@ -220,7 +257,8 @@ class FaunaStore:
                     if tvals != terms:
                         continue
                 if idx.get("values"):
-                    vals = [self._field(data, v["field"])
+                    vals = [(("ref", cls, id) if v["field"] == ["ref"]
+                             else self._field(data, v["field"]))
                             for v in idx["values"]]
                     rows.append(vals[0] if len(vals) == 1 else vals)
                 else:
@@ -298,8 +336,10 @@ class FakeFaunaServer:
             def _enc(v):
                 if isinstance(v, tuple) and v and v[0] == "ref":
                     return _ref_json(v[1], v[2])
-                if isinstance(v, tuple):
-                    return list(v)
+                if isinstance(v, (tuple, list)):
+                    return [Handler._enc(x) for x in v]
+                if isinstance(v, dict):
+                    return {k: Handler._enc(x) for k, x in v.items()}
                 return v
 
             def _err(self, status, code, desc):

@@ -143,6 +143,39 @@ def test_analyze_store_wr(tmp_path):
     assert res["valid?"] is True
 
 
+def test_analyze_store_wr_backend_cpu(tmp_path, monkeypatch):
+    """--backend cpu routes the wr sweep through the wr module's OWN
+    host analyzer (WrEncoded has edges, not append triples)."""
+    monkeypatch.setenv("JEPSEN_TPU_BACKEND", "cpu")
+    from jepsen_tpu.checker.elle import kernels as elle_kernels
+
+    def boom(*a, **kw):
+        raise AssertionError("device edge-batch ran under --backend cpu")
+
+    monkeypatch.setattr(elle_kernels, "check_edge_batch", boom)
+    store = Store(tmp_path / "store")
+    good = [
+        {"type": "invoke", "process": 0, "f": "txn",
+         "value": [["w", 1, 1]], "time": 0},
+        {"type": "ok", "process": 0, "f": "txn",
+         "value": [["w", 1, 1]], "time": 1},
+    ]
+    bad = good + [
+        {"type": "invoke", "process": 1, "f": "txn",
+         "value": [["r", 1, 1], ["r", 1, 2]], "time": 2},
+        {"type": "ok", "process": 1, "f": "txn",
+         "value": [["r", 1, 1], ["r", 1, 2]], "time": 3},
+    ]
+    d1 = make_run(store, "wr", "20200101T000000", good)
+    d2 = make_run(store, "wr", "20200101T000001", bad)
+    rc = cli.analyze_store(store, checker="wr")
+    assert rc == 1
+    assert json.loads((d1 / "results.json").read_text())["valid?"] is True
+    res2 = json.loads((d2 / "results.json").read_text())
+    assert res2["valid?"] is False
+    assert "internal" in res2["anomaly-types"]
+
+
 def test_analyze_store_flags_host_anomalies(tmp_path):
     """G1a (reading a failed write) has no cycle, so the device flags
     alone would miss it — the verdict must include host anomalies."""

@@ -188,3 +188,133 @@ def test_topology_nemesis_selected_by_opts():
     assert isinstance(t["nemesis"], faunadb.TopologyNemesis)
     assert "pages" in faunadb.workloads() \
         and "multimonotonic" in faunadb.workloads()
+
+
+def test_replica_aware_grudges():
+    nodes = [f"n{i}" for i in range(1, 10)]  # 9 nodes, 3 replicas
+    by_rep = faunadb.nodes_by_replica(nodes, 3)
+    assert by_rep["replica-0"] == ["n1", "n4", "n7"]
+    assert by_rep["replica-2"] == ["n3", "n6", "n9"]
+
+    # intra-replica: only members of ONE replica appear in the grudge
+    g = faunadb.intra_replica_grudge(3)(nodes)
+    cut = set(g)
+    reps = {r for r, ms in by_rep.items() if cut & set(ms)}
+    assert len(reps) == 1
+    for n, blocked in g.items():
+        assert set(blocked) <= set(by_rep[next(iter(reps))])
+
+    # inter-replica: every node is cut from SOME other replica's nodes,
+    # and no node is cut from a member of its own replica
+    g = faunadb.inter_replica_grudge(3)(nodes)
+    assert set(g) == set(nodes)
+    rep_of = {n: r for r, ms in by_rep.items() for n in ms}
+    for n, blocked in g.items():
+        assert blocked, n
+        assert all(rep_of[b] != rep_of[n] for b in blocked)
+
+    # single node: one loner cut from all, all cut from the loner
+    g = faunadb.single_node_grudge(nodes)
+    loner = [n for n, b in g.items() if len(b) == len(nodes) - 1]
+    assert len(loner) == 1
+    for n, b in g.items():
+        if n != loner[0]:
+            assert b == [loner[0]] or set(b) == {loner[0]}
+
+
+def test_fauna_nemesis_menu_selects():
+    for name in ("single-node-partition", "intra-replica-partition",
+                 "inter-replica-partition"):
+        t = faunadb.faunadb_test({"nemesis": name, "time-limit": 1})
+        from jepsen_tpu.nemesis import Partitioner
+        assert isinstance(t["nemesis"], Partitioner), name
+
+
+# ---------------------------------------------------------------------------
+# internal transaction consistency (internal.clj)
+# ---------------------------------------------------------------------------
+
+def test_internal_client_create_variants():
+    with FakeFaunaServer() as srv:
+        test = {"db-hosts": hosts_for(srv)}
+        c = faunadb.FaunaClient("internal").open(test, "n1")
+        for i, f in enumerate(("create-tabby-let", "create-tabby-obj",
+                               "create-tabby-arr")):
+            out = c.invoke(test, {"type": "invoke", "f": f, "value": i})
+            assert out["type"] == "ok", out
+            v = out["value"]
+            name = v["tabby"]["data"]["name"]
+            assert name == i
+            # the txn's own create is invisible before, visible after
+            assert name not in v["tabbies-0"]
+            assert name in v["tabbies-1"]
+            # earlier cats visible in both reads
+            for prev in range(i):
+                assert prev in v["tabbies-0"] and prev in v["tabbies-1"]
+
+
+def test_internal_client_change_type_and_reset():
+    with FakeFaunaServer() as srv:
+        test = {"db-hosts": hosts_for(srv)}
+        c = faunadb.FaunaClient("internal").open(test, "n1")
+        c.invoke(test, {"type": "invoke", "f": "create-tabby-let",
+                        "value": 7})
+        out = c.invoke(test, {"type": "invoke", "f": "change-type",
+                              "value": None})
+        assert out["type"] == "ok"
+        v = out["value"]
+        assert v["cat"]["data"]["name"] == 7
+        assert 7 not in v["tabbies"]
+        assert 7 in v["calicos"]
+        # change-type with no tabbies left: cat is None, no error
+        out2 = c.invoke(test, {"type": "invoke", "f": "change-type",
+                               "value": None})
+        assert out2["type"] == "ok" and out2["value"]["cat"] is None
+        assert c.invoke(test, {"type": "invoke", "f": "reset",
+                               "value": None})["type"] == "ok"
+        out3 = c.invoke(test, {"type": "invoke", "f": "change-type",
+                               "value": None})
+        assert out3["value"]["calicos"] == []
+
+
+def test_internal_checker_golden():
+    chk = faunadb.InternalChecker()
+
+    def op(f, v, i=0):
+        return {"type": "ok", "f": f, "value": v, "index": i}
+    good = op("create-tabby-let",
+              {"tabbies-0": [1], "tabby": {"data": {"name": 2}},
+               "tabbies-1": [1, 2]})
+    assert chk.check({}, [good], {})["valid?"] is True
+    bad1 = op("create-tabby-obj",
+              {"tabbies-0": [2], "tabby": {"data": {"name": 2}},
+               "tabbies-1": [2]})
+    res = chk.check({}, [bad1], {})
+    assert res["valid?"] is False
+    assert res["error-types"] == ["present-before-create"]
+    bad2 = op("create-tabby-arr",
+              {"tabbies-0": [], "tabby": {"data": {"name": 2}},
+               "tabbies-1": []})
+    assert chk.check({}, [bad2], {})["error-types"] == \
+        ["missing-after-create"]
+    bad3 = op("change-type",
+              {"cat": {"data": {"name": 5}}, "tabbies": [5],
+               "calicos": []})
+    assert sorted(chk.check({}, [bad3], {})["error-types"]) == \
+        ["missing-after-change", "present-after-change"]
+
+
+def test_internal_workload_full_run(tmp_path):
+    with FakeFaunaServer() as srv:
+        wl = faunadb._internal_workload({})
+        t = {"name": "fauna internal", "nodes": ["n1", "n2", "n3"],
+             "concurrency": 3, "ssh": {"dummy": True},
+             "db-hosts": hosts_for(srv),
+             "client": wl["client"], "checker": wl["checker"],
+             "generator": gen.time_limit(
+                 2, gen.clients(wl["generator"])),
+             "store": Store(tmp_path / "store")}
+        t = core.run(t)
+        assert t["results"]["valid?"] is True, t["results"]
+        oks = [o for o in t["history"] if o.get("type") == "ok"]
+        assert any(o["f"].startswith("create-tabby") for o in oks)

@@ -53,3 +53,40 @@ def test_cli_faults_flag_parses():
     assert t["faults"] == ["partition", "kill"]
     args = p.parse_args([])
     assert "faults" not in cli.test_map_from_args(args)
+
+
+def test_signal_process_dbs_support_kill_pause():
+    """The major daemonized suites implement the db.clj:22-35 fault
+    protocols, so kill/pause packages compose in for them."""
+    from jepsen_tpu import control, db as jdb
+    from jepsen_tpu.suites import (cockroach, consul, disque, mongodb,
+                                   raftis, rabbitmq, rethinkdb,
+                                   zookeeper)
+    dbs = [cockroach.CockroachDB(), consul.ConsulDB(),
+           disque.DisqueDB(), mongodb.MongoDB(), raftis.RaftisDB(),
+           rabbitmq.RabbitDB(), rethinkdb.RethinkDB(),
+           zookeeper.ZookeeperDB(), etcd.EtcdDB()]
+    test = {"nodes": ["n1"], "ssh": {"dummy": True}}
+    remote = control.remote_for(test)
+    for db in dbs:
+        assert isinstance(db, jdb.Process), type(db).__name__
+        assert isinstance(db, jdb.Pause), type(db).__name__
+        remote.actions.clear()
+        with control.bind_session(control.session(test, "n1")):
+            db.kill(test, "n1")
+            db.pause(test, "n1")
+            db.resume(test, "n1")
+            db.start(test, "n1")
+        cmds = " || ".join(str(p) for _, k, p in remote.actions
+                           if k == "execute")
+        assert "kill -KILL" in cmds, type(db).__name__
+        assert "kill -STOP" in cmds and "kill -CONT" in cmds, \
+            type(db).__name__
+
+
+def test_kill_pause_packages_compose_for_signal_dbs():
+    from jepsen_tpu.nemesis import combined as ncombined
+    from jepsen_tpu.suites import cockroach
+    pkg = ncombined.nemesis_package(
+        cockroach.CockroachDB(), 5, faults=["kill", "pause"])
+    assert pkg["generator"] is not None
