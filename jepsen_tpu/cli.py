@@ -130,10 +130,12 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
              "(the north-star batch path)")
     p_batch.add_argument("--store", default="store")
     p_batch.add_argument("--checker", default="append",
-                         choices=["append", "wr", "stored"],
+                         choices=["append", "wr", "register", "stored"],
                          help="append/wr: encode histories and batch-"
-                              "check on the mesh; stored: re-run each "
-                              "run's own checker")
+                              "check on the mesh; register: per-key "
+                              "CAS linearizability, every key of every "
+                              "run in one dense-kernel sweep; stored: "
+                              "re-run each run's own checker")
     p_batch.add_argument("--name", default=None,
                          help="only runs of this test name")
     p_batch.add_argument("--backend", default="auto",
@@ -181,7 +183,11 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
             stored = store.load_test(run_dir)
             test = test_fn(stored, args)
             test.setdefault("name", stored.get("name", "analyze"))
-            test["history"] = stored["history"]
+            from . import independent
+            # json/edn round trips erase the lifted-tuple type; re-lift
+            # so per-key checkers split the history again
+            test["history"] = independent.relift_history(
+                stored["history"])
             test["store"] = store
             test = core.analyze(test)
             print(json.dumps({"valid?": test["results"].get("valid?")}))
@@ -243,16 +249,7 @@ def analyze_store(store: Store, checker: str = "append",
         test["store"] = store
         return core.analyze(test)["results"]
 
-    def emit(d, res) -> int:
-        from . import edn as edn_mod
-        from .store import _results_to_edn
-        (d / "results.json").write_text(
-            json.dumps(_json_safe(res), indent=2))
-        (d / "results.edn").write_text(
-            edn_mod.dumps(_results_to_edn(_json_safe(res))) + "\n")
-        print(json.dumps({"dir": str(d), "valid?": res.get("valid?"),
-                          "anomalies": res.get("anomaly-types", [])}))
-        return validity_exit_code(res)
+    emit = _write_results
 
     worst = 0
     if checker == "stored":
@@ -262,6 +259,9 @@ def analyze_store(store: Store, checker: str = "append",
                               "valid?": res.get("valid?")}))
             worst = max(worst, validity_exit_code(res))
         return worst
+
+    if checker == "register":
+        return _analyze_store_register(store, run_dirs, stored_check)
 
     from . import parallel
     from .checker import elle
@@ -354,14 +354,110 @@ def analyze_store(store: Store, checker: str = "append",
                 worst = max(worst, emit(d, res))
 
     for d in fallback:
-        try:
-            res = stored_check(d)
-            print(json.dumps({"dir": str(d),
-                              "valid?": res.get("valid?")}))
-            worst = max(worst, validity_exit_code(res))
-        except Exception as e:
-            print(json.dumps({"dir": str(d), "error": str(e)}))
-            worst = max(worst, 254)
+        worst = max(worst, _stored_fallback(d, stored_check))
+    return worst
+
+
+def _write_results(d, res: dict) -> int:
+    """Persist results.json/.edn into a run dir and print the one-line
+    summary; returns the validity exit code."""
+    from . import edn as edn_mod
+    from .store import _results_to_edn
+    (d / "results.json").write_text(
+        json.dumps(_json_safe(res), indent=2))
+    (d / "results.edn").write_text(
+        edn_mod.dumps(_results_to_edn(_json_safe(res))) + "\n")
+    line = {"dir": str(d), "valid?": res.get("valid?")}
+    if "anomaly-types" in res:
+        line["anomalies"] = res.get("anomaly-types", [])
+    if "failures" in res:
+        line["failures"] = res["failures"]
+    print(json.dumps(line))
+    return validity_exit_code(res)
+
+
+def _stored_fallback(d, stored_check) -> int:
+    """Run a dir through its own stored checker, degrading to an error
+    line (never an exception) on failure."""
+    try:
+        res = stored_check(d)
+        print(json.dumps({"dir": str(d), "valid?": res.get("valid?")}))
+        return validity_exit_code(res)
+    except Exception as e:
+        print(json.dumps({"dir": str(d), "error": str(e)}))
+        return 254
+
+
+def _analyze_store_register(store: Store, run_dirs: list,
+                            stored_check) -> int:
+    """Per-key CAS-register linearizability over a whole store: every
+    key's subhistory from EVERY run goes down in one tiered device
+    sweep (dense grid -> bounded frontier -> CPU re-run), then verdicts
+    regroup per run — the etcd-shaped batch sweep of BASELINE config
+    #1. Runs whose client ops aren't register-shaped fall back to
+    their own stored checker."""
+    import os as _os
+
+    from . import independent, ingest
+    from .checker import linearizable, merge_valid, models
+
+    backend = ("cpu" if _os.environ.get("JEPSEN_TPU_BACKEND") == "cpu"
+               else "tpu")
+    c = linearizable(models.cas_register(), backend=backend)
+
+    subs: list[list] = []          # flattened subhistories
+    owners: list[tuple[int, object]] = []   # (run index, key)
+    fallback: list[int] = []
+    for i, (d, hist) in enumerate(
+            zip(run_dirs, ingest.parallel_load(run_dirs))):
+        if isinstance(hist, Exception):
+            fallback.append(i)
+            continue
+        hist = independent.relift_history(hist)
+        client_fs = {o.get("f") for o in hist
+                     if o.get("process") != "nemesis"
+                     and o.get("f") is not None}
+        if not client_fs or not client_fs <= {"read", "write", "cas"}:
+            fallback.append(i)
+            continue
+        ks = independent.history_keys(hist)
+        for k in (ks or [None]):
+            subs.append(independent.subhistory(k, hist)
+                        if ks else hist)
+            owners.append((i, k))
+
+    try:
+        results = c.check_batch({}, subs, {}) if subs else []
+    except Exception:
+        # one malformed run must not sink the sweep: re-dispatch each
+        # subhistory in isolation, degrading only the broken ones
+        log.warning("batched register sweep failed; isolating per key",
+                    exc_info=True)
+        results = []
+        for s in subs:
+            try:
+                results.append(c.check_batch({}, [s], {})[0])
+            except Exception as e:
+                results.append({"valid?": "unknown",
+                                "error": repr(e)[:200]})
+    per_run: dict[int, dict] = {}
+    for (i, k), res in zip(owners, results):
+        per_run.setdefault(i, {})[k] = res
+
+    worst = 0
+    for i, d in enumerate(run_dirs):
+        if i in fallback:
+            worst = max(worst, _stored_fallback(d, stored_check))
+            continue
+        keyed = per_run.get(i, {})
+        valid = merge_valid([r.get("valid?", True)
+                             for r in keyed.values()] or [True])
+        res = {"valid?": valid,
+               "key-count": len(keyed),
+               "results": {str(k): r for k, r in keyed.items()},
+               "failures": sorted(str(k) for k, r in keyed.items()
+                                  if r.get("valid?") is False)}
+        worst = max(worst, _write_results(d, res))
     return worst
 
 

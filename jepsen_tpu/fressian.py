@@ -295,7 +295,11 @@ def convert_tagged(tag: str, vals: list) -> Any:
         return datetime.datetime.fromtimestamp(
             vals[0] / 1000, tz=datetime.timezone.utc)
     if tag == "map-entry" and len(vals) == 2:
-        return (vals[0], vals[1])
+        # The reference's independent/tuple IS a MapEntry
+        # (independent.clj:22-30) — reconstruct the lifted type so
+        # re-analysis of reference stores splits per key again.
+        from .independent import Tuple
+        return Tuple(vals[0], vals[1])
     if tag == "multiset" and len(vals) == 1 and isinstance(vals[0], dict):
         out = []
         for v, n in vals[0].items():

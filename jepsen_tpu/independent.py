@@ -63,6 +63,34 @@ def key_of(v: Any):
     return v.key if is_tuple(v) else None
 
 
+def relift_history(history: list) -> list:
+    """Re-lift [k v] op values into Tuples after a serialization round
+    trip that erased the type (history.jsonl / history.edn render a
+    tuple as a plain two-element vector; the reference's MapEntry has
+    the same ambiguity, which is why its analyze path re-reads
+    fressian). Heuristic, applied only when unambiguous: every client
+    op value that isn't None must be a two-element list AND at least
+    one ok read's value must be one too (an UNlifted register history
+    has scalar read values, so it never matches; an unlifted cas-only
+    history is ambiguous and stays unlifted)."""
+    if any(is_tuple(o.get("value")) for o in history):
+        return history
+    client = [o for o in history if o.get("process") != "nemesis"]
+    vals = [o.get("value") for o in client if o.get("value") is not None]
+    if not vals or not all(isinstance(v, (list, tuple)) and len(v) == 2
+                           for v in vals):
+        return history
+    if not any(o.get("type") == "ok" and o.get("f") == "read"
+               and isinstance(o.get("value"), (list, tuple))
+               for o in client):
+        return history
+    return [({**o, "value": Tuple(o["value"][0], o["value"][1])}
+             if o.get("process") != "nemesis"
+             and isinstance(o.get("value"), (list, tuple))
+             and len(o["value"]) == 2 else o)
+            for o in history]
+
+
 def value_of(v: Any):
     return v.value if is_tuple(v) else v
 

@@ -60,6 +60,34 @@ def _worker(args):
         return e
 
 
+def _load_worker(run_dir):
+    try:
+        return load_history_dir(run_dir)
+    except Exception as e:
+        return e
+
+
+def parallel_load(run_dirs: Sequence[str | os.PathLike],
+                  processes: int | None = None) -> list:
+    """Load many run-dir histories via a process pool (same sharding as
+    parallel_encode, for sweeps that need raw ops rather than txn
+    encodings — e.g. the per-key register sweep). Returns histories or
+    per-run Exception objects, aligned with run_dirs."""
+    if processes is None:
+        processes = min(len(run_dirs), os.cpu_count() or 1)
+    if processes <= 1 or len(run_dirs) <= 1:
+        return [_load_worker(d) for d in run_dirs]
+    ctx = mp.get_context("spawn")
+    try:
+        with ctx.Pool(processes=processes) as pool:
+            return pool.map(_load_worker, list(run_dirs),
+                            chunksize=max(1, len(run_dirs) // (4 * processes)))
+    except Exception:
+        log.warning("process-pool load failed; falling back to serial",
+                    exc_info=True)
+        return [_load_worker(d) for d in run_dirs]
+
+
 def parallel_encode(run_dirs: Sequence[str | os.PathLike],
                     checker: str = "append",
                     processes: int | None = None) -> list:

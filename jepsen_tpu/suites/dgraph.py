@@ -25,8 +25,11 @@ VERSION = "v1.0.17"
 DIR = "/opt/dgraph"
 
 
-class DgraphDB(jdb.DB, jdb.LogFiles):
-    """dgraph zero + alpha daemons (dgraph/src/jepsen/dgraph/support.clj)."""
+class DgraphDB(jdb.DB, jdb.SignalProcess, jdb.LogFiles):
+    """dgraph zero + alpha daemons (dgraph/src/jepsen/dgraph/support.clj);
+    whole-node kill/pause via SignalProcess."""
+
+    process_pattern = f"{DIR}/dgraph"
 
     def __init__(self, version: str = VERSION):
         self.version = version
@@ -36,6 +39,9 @@ class DgraphDB(jdb.DB, jdb.LogFiles):
         url = (f"https://github.com/dgraph-io/dgraph/releases/download/"
                f"{self.version}/dgraph-linux-amd64.tar.gz")
         cutil.install_archive(sess, url, DIR)
+        self._start(sess, test, node)
+
+    def _start(self, sess, test, node):
         nodes = test.get("nodes", [])
         zero = nodes[0] if nodes else node
         if node == zero:

@@ -20,8 +20,12 @@ VERSION = "v3.0.3"
 DIR = "/opt/tidb"
 LOGDIR = f"{DIR}/logs"
 
-class TiDB(jdb.DB, jdb.LogFiles):
-    """pd + tikv + tidb daemons (tidb/src/tidb/db.clj's install)."""
+class TiDB(jdb.DB, jdb.SignalProcess, jdb.LogFiles):
+    """pd + tikv + tidb daemons (tidb/src/tidb/db.clj's install);
+    whole-node kill/pause across all three via SignalProcess (the
+    pattern matches every binary under the install dir)."""
+
+    process_pattern = f"{DIR}/bin"
 
     def __init__(self, version: str = VERSION):
         self.version = version
@@ -36,6 +40,9 @@ class TiDB(jdb.DB, jdb.LogFiles):
                f"tidb-{self.version}-linux-amd64.tar.gz")
         cutil.install_archive(sess, url, DIR)
         sess.exec("mkdir", "-p", LOGDIR)
+        self._start(sess, test, node)
+
+    def _start(self, sess, test, node):
         cutil.start_daemon(
             sess, f"{DIR}/bin/pd-server",
             "--name", node,

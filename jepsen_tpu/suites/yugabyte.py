@@ -23,8 +23,11 @@ DIR = "/opt/yugabyte"
 APIS = ("ysql", "ycql")
 
 
-class YugaByteDB(jdb.DB, jdb.LogFiles):
-    """yb-master + yb-tserver daemons (yugabyte/src/yugabyte/db.clj)."""
+class YugaByteDB(jdb.DB, jdb.SignalProcess, jdb.LogFiles):
+    """yb-master + yb-tserver daemons (yugabyte/src/yugabyte/db.clj);
+    whole-node kill/pause via SignalProcess."""
+
+    process_pattern = f"{DIR}/bin"
 
     def __init__(self, version: str = VERSION):
         self.version = version
@@ -34,6 +37,9 @@ class YugaByteDB(jdb.DB, jdb.LogFiles):
         url = (f"https://downloads.yugabyte.com/"
                f"yugabyte-ce-{self.version}-linux.tar.gz")
         cutil.install_archive(sess, url, DIR)
+        self._start(sess, test, node)
+
+    def _start(self, sess, test, node):
         masters = ",".join(f"{n}:7100" for n in test.get("nodes", [])[:3])
         if node in test.get("nodes", [])[:3]:
             cutil.start_daemon(

@@ -277,3 +277,106 @@ def test_analyze_store_routes_long_histories_via_condensation(
     assert rc == 0
     # the long-history path actually ran (not the dense bucketed sweep)
     assert calls and calls[0] > 50
+
+
+def test_analyze_store_register_batch(tmp_path):
+    """--checker register: every key of every stored run in one tiered
+    linearizability sweep, regrouped per run (BASELINE config #1's
+    etcd-shaped batch)."""
+    from jepsen_tpu import independent
+    kv = independent.tuple_
+
+    def reg_hist(bad_key=None):
+        hist = []
+        for k in ("a", "b"):
+            seq = [("write", 1), ("read", 1), ("cas", [1, 2]),
+                   ("read", 2)]
+            if k == bad_key:
+                seq[-1] = ("read", 3)  # value never written
+            for f, v in seq:
+                hist.append({"type": "invoke", "process": 0, "f": f,
+                             "value": kv(k, None if f == "read" else v)})
+                hist.append({"type": "ok", "process": 0, "f": f,
+                             "value": kv(k, v)})
+        return [{**o, "index": i, "time": i * 1000}
+                for i, o in enumerate(hist)]
+
+    store = Store(tmp_path / "store")
+    d1 = make_run(store, "etcd", "20200101T000000", reg_hist())
+    d2 = make_run(store, "etcd", "20200101T000001", reg_hist("b"))
+    rc = cli.analyze_store(store, checker="register")
+    assert rc == 1
+    r1 = json.loads((d1 / "results.json").read_text())
+    r2 = json.loads((d2 / "results.json").read_text())
+    assert r1["valid?"] is True and r1["key-count"] == 2
+    assert r2["valid?"] is False
+    assert r2["failures"] == ["b"]
+    assert r2["results"]["a"]["valid?"] is True
+
+
+def test_relift_history_heuristics():
+    from jepsen_tpu import independent
+    kv = independent.tuple_
+    # lifted history round-tripped to plain lists -> re-lifted
+    lifted = [
+        {"type": "invoke", "process": 0, "f": "write", "value": ["a", 1]},
+        {"type": "ok", "process": 0, "f": "write", "value": ["a", 1]},
+        {"type": "invoke", "process": 0, "f": "read", "value": ["a", None]},
+        {"type": "ok", "process": 0, "f": "read", "value": ["a", 1]},
+    ]
+    out = independent.relift_history(lifted)
+    assert all(independent.is_tuple(o["value"]) for o in out)
+    # plain cas-register history: scalar read values -> untouched
+    plain = [
+        {"type": "invoke", "process": 0, "f": "cas", "value": [1, 2]},
+        {"type": "ok", "process": 0, "f": "cas", "value": [1, 2]},
+        {"type": "invoke", "process": 0, "f": "read", "value": None},
+        {"type": "ok", "process": 0, "f": "read", "value": 2},
+    ]
+    assert independent.relift_history(plain) == plain
+    # already-lifted histories pass through unchanged
+    native = [{"type": "ok", "process": 0, "f": "read",
+               "value": kv("a", 1)}]
+    assert independent.relift_history(native) == native
+
+
+def test_analyze_store_register_isolates_malformed_run(tmp_path):
+    """A run with unhashable register values must not sink the sweep:
+    its keys degrade to unknown while sibling runs still verify."""
+    from jepsen_tpu import independent
+    kv = independent.tuple_
+
+    def ok_hist():
+        hist = []
+        for f, v in [("write", 1), ("read", 1)]:
+            hist.append({"type": "invoke", "process": 0, "f": f,
+                         "value": kv("a", None if f == "read" else v)})
+            hist.append({"type": "ok", "process": 0, "f": f,
+                         "value": kv("a", v)})
+        return [{**o, "index": i, "time": i * 1000}
+                for i, o in enumerate(hist)]
+
+    bad_hist = [
+        {"type": "invoke", "process": 0, "f": "write",
+         "value": {"un": "hashable"}, "time": 0, "index": 0},
+        {"type": "ok", "process": 0, "f": "write",
+         "value": {"un": "hashable"}, "time": 1, "index": 1},
+        {"type": "invoke", "process": 0, "f": "read", "value": None,
+         "time": 2, "index": 2},
+        {"type": "ok", "process": 0, "f": "read",
+         "value": {"un": "hashable"}, "time": 3, "index": 3},
+    ]
+    store = Store(tmp_path / "store")
+    d1 = make_run(store, "etcd", "20200101T000000", ok_hist())
+    d2 = store.base / "etcd" / "20200101T000001"
+    d2.mkdir(parents=True)
+    import json as _json
+    with open(d2 / "history.jsonl", "w") as f:
+        for o in bad_hist:
+            f.write(_json.dumps(o) + "\n")
+    rc = cli.analyze_store(store, checker="register")
+    r1 = json.loads((d1 / "results.json").read_text())
+    assert r1["valid?"] is True
+    r2 = json.loads((d2 / "results.json").read_text())
+    assert r2["valid?"] in ("unknown", False)
+    assert rc in (1, 2)

@@ -59,13 +59,15 @@ def test_signal_process_dbs_support_kill_pause():
     """The major daemonized suites implement the db.clj:22-35 fault
     protocols, so kill/pause packages compose in for them."""
     from jepsen_tpu import control, db as jdb
-    from jepsen_tpu.suites import (cockroach, consul, disque, mongodb,
-                                   raftis, rabbitmq, rethinkdb,
+    from jepsen_tpu.suites import (cockroach, consul, dgraph, disque,
+                                   mongodb, raftis, rabbitmq,
+                                   rethinkdb, tidb, yugabyte,
                                    zookeeper)
     dbs = [cockroach.CockroachDB(), consul.ConsulDB(),
            disque.DisqueDB(), mongodb.MongoDB(), raftis.RaftisDB(),
            rabbitmq.RabbitDB(), rethinkdb.RethinkDB(),
-           zookeeper.ZookeeperDB(), etcd.EtcdDB()]
+           zookeeper.ZookeeperDB(), etcd.EtcdDB(), tidb.TiDB(),
+           yugabyte.YugaByteDB(), dgraph.DgraphDB()]
     test = {"nodes": ["n1"], "ssh": {"dummy": True}}
     remote = control.remote_for(test)
     for db in dbs:
