@@ -74,7 +74,7 @@ def bench_elle(n_dev: int, devices, reps: int) -> dict:
 
     rate = timed(reps, classify=False)
     target = 10_000 / 60.0 * (n_dev / 8.0)  # north-star, chip-scaled
-    return {
+    out = {
         "metric": f"elle-append histories/sec ({T}-txn, {n_dev} dev)",
         "value": round(rate, 2),
         "unit": "histories/sec",
@@ -85,6 +85,17 @@ def bench_elle(n_dev: int, devices, reps: int) -> dict:
         "realtime_rate": timed(max(2, reps // 2), classify=False,
                                realtime=True),
     }
+    if accel and mesh is None:
+        # fused Pallas squaring vs the plain XLA matmul pipeline — the
+        # headline `value` above already uses whichever is the default
+        try:
+            out["pallas_rate"] = timed(max(2, reps // 2),
+                                       classify=False, use_pallas=True)
+        except Exception as e:  # lowering may fail on exotic hardware
+            out["pallas_rate"] = {"error": repr(e)[:200]}
+        out["xla_rate"] = timed(max(2, reps // 2), classify=False,
+                                use_pallas=False)
+    return out
 
 
 def bench_knossos(reps: int, accel: bool = True) -> dict:

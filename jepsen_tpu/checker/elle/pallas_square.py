@@ -102,13 +102,33 @@ def closure_square(m: jnp.ndarray, *, tile: int = 256,
     )(m, m)
 
 
+_works: bool | None = None
+
+
 def pallas_available() -> bool:
-    """True when the current default device can lower this kernel — a
-    real TPU. (Interpret mode is for tests; running it in production
-    on CPU would be slower than the XLA matmul.)"""
+    """True when the current default device is a real TPU AND this
+    kernel actually compiles on it (verified once per process with a
+    tiny probe input, so a lowering regression degrades the analysis
+    path to the XLA matmul instead of breaking it). Interpret mode is
+    for tests; running it in production on CPU would be slower than
+    the XLA matmul."""
+    global _works
+    if _works is not None:
+        return _works
     try:
         from ...devices import default_devices
         d = default_devices()[0]
-        return getattr(d, "platform", "") in ("tpu", "axon")
-    except Exception:
-        return False
+        if getattr(d, "platform", "") not in ("tpu", "axon"):
+            _works = False
+            return False
+        import numpy as np
+        m = jnp.asarray(np.eye(128, dtype=bool)[None])
+        out = np.asarray(closure_square(m))
+        _works = bool((out == np.eye(128, dtype=bool)[None]).all())
+    except Exception:  # pragma: no cover - hardware-specific
+        import logging
+        logging.getLogger(__name__).warning(
+            "pallas closure kernel failed its probe; using the XLA "
+            "matmul path", exc_info=True)
+        _works = False
+    return _works

@@ -396,14 +396,12 @@ def _analyze_store_register(store: Store, run_dirs: list,
     regroup per run — the etcd-shaped batch sweep of BASELINE config
     #1. Runs whose client ops aren't register-shaped fall back to
     their own stored checker."""
-    import os as _os
-
     from . import independent, ingest
     from .checker import linearizable, merge_valid, models
 
-    backend = ("cpu" if _os.environ.get("JEPSEN_TPU_BACKEND") == "cpu"
-               else "tpu")
-    c = linearizable(models.cas_register(), backend=backend)
+    # auto resolves to the device kernels when an accelerator is
+    # reachable and honors the --backend env export either way
+    c = linearizable(models.cas_register(), backend="auto")
 
     subs: list[list] = []          # flattened subhistories
     owners: list[tuple[int, object]] = []   # (run index, key)
@@ -421,6 +419,16 @@ def _analyze_store_register(store: Store, run_dirs: list,
             fallback.append(i)
             continue
         ks = independent.history_keys(hist)
+        if not ks and any(
+                isinstance(o.get("value"), (list, tuple))
+                and len(o["value"]) == 2 and o.get("f") != "cas"
+                for o in hist if o.get("process") != "nemesis"):
+            # looks lifted ([k v] values) but relift declined (e.g. no
+            # ok read survived the faults): checking it as ONE register
+            # would feed the oracle [key value] pairs — let the run's
+            # own stored checker handle it instead
+            fallback.append(i)
+            continue
         for k in (ks or [None]):
             subs.append(independent.subhistory(k, hist)
                         if ks else hist)

@@ -67,25 +67,32 @@ def _load_worker(run_dir):
         return e
 
 
-def parallel_load(run_dirs: Sequence[str | os.PathLike],
-                  processes: int | None = None) -> list:
-    """Load many run-dir histories via a process pool (same sharding as
-    parallel_encode, for sweeps that need raw ops rather than txn
-    encodings — e.g. the per-key register sweep). Returns histories or
-    per-run Exception objects, aligned with run_dirs."""
+def _pool_map(worker, items: list, processes: int | None) -> list:
+    """Shared process-pool recipe: spawned workers (the parent usually
+    holds live device runtimes), per-item exceptions returned not
+    raised, serial fallback on pool failure."""
     if processes is None:
-        processes = min(len(run_dirs), os.cpu_count() or 1)
-    if processes <= 1 or len(run_dirs) <= 1:
-        return [_load_worker(d) for d in run_dirs]
+        processes = min(len(items), os.cpu_count() or 1)
+    if processes <= 1 or len(items) <= 1:
+        return [worker(it) for it in items]
     ctx = mp.get_context("spawn")
     try:
         with ctx.Pool(processes=processes) as pool:
-            return pool.map(_load_worker, list(run_dirs),
-                            chunksize=max(1, len(run_dirs) // (4 * processes)))
+            return pool.map(worker, items,
+                            chunksize=max(1, len(items) // (4 * processes)))
     except Exception:
-        log.warning("process-pool load failed; falling back to serial",
+        log.warning("process-pool map failed; falling back to serial",
                     exc_info=True)
-        return [_load_worker(d) for d in run_dirs]
+        return [worker(it) for it in items]
+
+
+def parallel_load(run_dirs: Sequence[str | os.PathLike],
+                  processes: int | None = None) -> list:
+    """Load many run-dir histories via a process pool (for sweeps that
+    need raw ops rather than txn encodings — e.g. the per-key register
+    sweep). Returns histories or per-run Exception objects, aligned
+    with run_dirs."""
+    return _pool_map(_load_worker, list(run_dirs), processes)
 
 
 def parallel_encode(run_dirs: Sequence[str | os.PathLike],
@@ -96,19 +103,6 @@ def parallel_encode(run_dirs: Sequence[str | os.PathLike],
     Exception object on per-run failure (callers route those to their
     fallback checker).
 
-    processes=0 forces the serial path. Workers are spawned (not
-    forked): the parent usually holds live device runtimes, and the
-    encode path needs none of that."""
-    if processes is None:
-        processes = min(len(run_dirs), os.cpu_count() or 1)
-    if processes <= 1 or len(run_dirs) <= 1:
-        return [_worker((d, checker)) for d in run_dirs]
-    ctx = mp.get_context("spawn")
-    try:
-        with ctx.Pool(processes=processes) as pool:
-            return pool.map(_worker, [(d, checker) for d in run_dirs],
-                            chunksize=max(1, len(run_dirs) // (4 * processes)))
-    except Exception:
-        log.warning("process-pool ingest failed; falling back to serial",
-                    exc_info=True)
-        return [_worker((d, checker)) for d in run_dirs]
+    processes=0 forces the serial path."""
+    return _pool_map(_worker, [(d, checker) for d in run_dirs],
+                     processes)

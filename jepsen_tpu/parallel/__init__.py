@@ -52,16 +52,20 @@ def make_mesh(devices: Sequence | None = None,
 
 def sharded_check_fn(mesh: Mesh | None, shape: K.BatchShape, *,
                      classify: bool = True, realtime: bool = False,
-                     process_order: bool = False):
+                     process_order: bool = False,
+                     use_pallas: bool | None = None):
     """Build a jitted batched checker around kernels.check_batched_impl.
     With a mesh, inputs are expected sharded over 'dp' and the closure
     matrices are constrained to P('dp', None, 'mp'); without one, it's a
     plain single-device jit whose closure squaring runs as the fused
-    Pallas kernel on TPU hardware. Memoized per (mesh, shape, flags) so
-    repeated same-shape dispatches (bucketed sweeps, per-key loops)
-    compile once."""
-    from ..checker.elle import pallas_square
-    use_pallas = mesh is None and pallas_square.pallas_available()
+    Pallas kernel on TPU hardware (use_pallas=None resolves that
+    automatically; benchmarks pass an explicit bool to compare the two
+    formulations). Memoized per (mesh, shape, flags) so repeated
+    same-shape dispatches (bucketed sweeps, per-key loops) compile
+    once."""
+    if use_pallas is None:
+        from ..checker.elle import pallas_square
+        use_pallas = mesh is None and pallas_square.pallas_available()
     return _sharded_check_fn_cached(mesh, shape, classify, realtime,
                                     process_order, use_pallas)
 

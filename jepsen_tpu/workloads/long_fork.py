@@ -145,6 +145,62 @@ def find_forks(ops: Sequence[dict]) -> list:
     return forks
 
 
+# Past this many reads in one group the pairwise python comparator is
+# replaced by the vectorized matmul formulation below.
+VECTORIZE_THRESHOLD = 64
+
+
+def find_forks_vectorized(ops: Sequence[dict]) -> list:
+    """find_forks as one boolean matmul (SURVEY.md §5.7's blockwise
+    long-fork search for 100k-op histories, BASELINE config #5).
+
+    Keys are written once with value 1, so a read of a group is a 0/1
+    vector V[i] over the group's keys (1 = observed). Read i strictly
+    dominates j on some key iff (V @ (1-V)^T)[i, j] > 0; a long fork is
+    a pair dominating each other: G & G^T. Value/shape validation stays
+    host-side (read_op_value_map raises on distinct non-nil values the
+    same way the pairwise route does)."""
+    import numpy as np
+
+    if len(ops) < 2:
+        return []
+    keys = sorted(read_op_value_map(ops[0]),
+                  key=lambda k: (str(type(k)), str(k)))
+    maps = [read_op_value_map(o) for o in ops]
+    for m in maps[1:]:
+        if set(m) != set(keys):
+            raise IllegalHistory(
+                {"type": "illegal-history", "reads": [maps[0], m],
+                 "msg": "reads query different keys"})
+    # exact parity with read_compare's error rule: a key may show ONE
+    # non-nil value across all reads (keys are written once); two
+    # distinct non-nil values is an illegal history
+    for k in keys:
+        distinct = {m[k] for m in maps if m[k] is not None}
+        if len(distinct) > 1:
+            raise IllegalHistory(
+                {"type": "illegal-history", "key": k,
+                 "reads": [m for m in maps if m[k] is not None][:2],
+                 "msg": "distinct non-nil values for one key; "
+                        "keys are written once"})
+    V = np.asarray([[0 if m[k] is None else 1 for k in keys]
+                    for m in maps], dtype=np.float32)
+    W = 1.0 - V
+    R = len(maps)
+    block = 4096                       # memory stays O(block * R)
+    forks = []
+    for lo in range(0, R, block):
+        hi = min(lo + block, R)
+        A = (V[lo:hi] @ W.T) > 0       # i saw a key j missed
+        B = (W[lo:hi] @ V.T) > 0       # j saw a key i missed
+        F = A & B                      # mutual: a long fork
+        for il, j in zip(*np.nonzero(F)):
+            i = lo + int(il)
+            if i < j:                  # each unordered pair once
+                forks.append([ops[i], ops[int(j)]])
+    return forks
+
+
 def groups(n: int, read_ops: Sequence[dict]) -> list[list[dict]]:
     """Partition reads by their key set; each must cover exactly n keys
     (long_fork.clj:288-314)."""
@@ -189,7 +245,9 @@ class LongForkChecker(Checker):
                 seen.add(k)
         try:
             forks = [f for g in groups(self.n, reads)
-                     for f in find_forks(g)]
+                     for f in (find_forks_vectorized(g)
+                               if len(g) > VECTORIZE_THRESHOLD
+                               else find_forks(g))]
         except IllegalHistory as e:
             return {**base, "valid?": "unknown", "error": e.info}
         if forks:

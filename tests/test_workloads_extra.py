@@ -336,3 +336,67 @@ def test_bank_plot_renders(tmp_path):
     from pathlib import Path
     assert Path(r["plot"]).exists()
     assert Path(r["plot"]).name == "bank.png"
+
+
+class TestLongForkVectorized:
+    """The matmul formulation must agree with the pairwise comparator
+    (BASELINE config #5's blockwise long-fork search)."""
+
+    @staticmethod
+    def _read_op(vals: dict):
+        return {"type": "ok", "f": "txn",
+                "value": [["r", k, v] for k, v in vals.items()]}
+
+    def test_matches_pairwise_random(self):
+        import random as _r
+        from jepsen_tpu.workloads import long_fork as lf
+        rng = _r.Random(4)
+        for trial in range(30):
+            n = rng.choice([2, 3, 5])
+            R = rng.randrange(2, 12)
+            keys = list(range(n))
+            ops = [self._read_op({k: rng.choice([None, 1]) for k in keys})
+                   for _ in range(R)]
+            a = {(id(x), id(y)) for x, y in lf.find_forks(ops)}
+            b = {(id(x), id(y)) for x, y in lf.find_forks_vectorized(ops)}
+            assert a == b, trial
+
+    def test_finds_classic_fork(self):
+        from jepsen_tpu.workloads import long_fork as lf
+        ops = [self._read_op({0: 1, 1: None}),
+               self._read_op({0: None, 1: 1})]
+        assert len(lf.find_forks_vectorized(ops)) == 1
+        assert lf.find_forks_vectorized([ops[0]]) == []
+
+    def test_illegal_values_raise(self):
+        import pytest as _pytest
+        from jepsen_tpu.workloads import long_fork as lf
+        ops = [self._read_op({0: 1, 1: None}),
+               self._read_op({0: 2, 1: None})]
+        with _pytest.raises(lf.IllegalHistory):
+            lf.find_forks_vectorized(ops)
+        # same non-nil value everywhere is legal (matches pairwise)
+        ops2 = [self._read_op({0: 7, 1: None}),
+                self._read_op({0: 7, 1: 1})]
+        assert lf.find_forks_vectorized(ops2) == []
+
+    def test_checker_uses_vectorized_for_big_groups(self, monkeypatch):
+        from jepsen_tpu.workloads import long_fork as lf
+        calls = []
+        orig = lf.find_forks_vectorized
+        monkeypatch.setattr(lf, "find_forks_vectorized",
+                            lambda g: calls.append(len(g)) or orig(g))
+        hist = []
+        for k in (0, 1):
+            hist.append({"type": "invoke", "process": k, "f": "txn",
+                         "value": [["w", k, 1]]})
+            hist.append({"type": "ok", "process": k, "f": "txn",
+                         "value": [["w", k, 1]]})
+        for _ in range(lf.VECTORIZE_THRESHOLD + 1):
+            hist.append({"type": "invoke", "process": 2, "f": "txn",
+                         "value": [["r", 0, None], ["r", 1, None]]})
+            hist.append({"type": "ok", "process": 2, "f": "txn",
+                         "value": [["r", 0, 1], ["r", 1, 1]]})
+        res = lf.checker(2).check({}, hist, {})
+        assert res["valid?"] is True
+        assert calls and calls[0] > lf.VECTORIZE_THRESHOLD
