@@ -265,12 +265,15 @@ def _write_synth_store(root: Path, B: int, T: int, K: int,
     the same execution shape as synth_encoded_history (txn i appends
     (key (i+rot)%K, pos i//K+1) and externally reads a key it has seen),
     written as raw JSON lines without per-op dict churn. Every
-    `bad_every`-th history gets one read observing a position one ahead
-    of commit order: a ww/wr (G1c) cycle for the classify pass to find."""
+    `bad_every`-th history gets two adjacent txns reading EACH OTHER's
+    appends (one of them a future observation): mutual wr edges — a
+    G1c cycle for the classify pass to find, with no same-txn read
+    that would trip the encoder's `internal` check instead."""
     dirs = []
     for h in range(B):
         rot = h % K
         corrupt = bad_every and h % bad_every == bad_every - 1
+        a = T // 2
         lines = []
         for i in range(T):
             ak = (i + rot) % K
@@ -278,8 +281,10 @@ def _write_synth_store(root: Path, B: int, T: int, K: int,
             rk = (i * 7 + 3 + rot) % K
             first = (rk - rot) % K
             rp = (i - 1 - first) // K + 1 if i > first else 0
-            if corrupt and i == T // 2:
-                rk, rp = ak, ap + 1
+            if corrupt and i == a:          # reads txn a+1's append
+                rk, rp = (a + 1 + rot) % K, (a + 1) // K + 1
+            elif corrupt and i == a + 1:    # reads txn a's append
+                rk, rp = (a + rot) % K, a // K + 1
             obs = list(range(1, rp + 1))
             p = i % 5
             lines.append(

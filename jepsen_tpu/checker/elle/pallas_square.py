@@ -122,9 +122,16 @@ def pallas_available() -> bool:
             _works = False
             return False
         import numpy as np
-        m = jnp.asarray(np.eye(128, dtype=bool)[None])
+        # 256 is divisible by both effective tiles, so this lowers the
+        # same tile=256 configuration the production shapes use
+        m = jnp.asarray(np.eye(256, dtype=bool)[None])
         out = np.asarray(closure_square(m))
-        _works = bool((out == np.eye(128, dtype=bool)[None]).all())
+        _works = bool((out == np.eye(256, dtype=bool)[None]).all())
+        if not _works:
+            import logging
+            logging.getLogger(__name__).warning(
+                "pallas closure kernel MISCOMPUTED its probe; using "
+                "the XLA matmul path")
     except Exception:  # pragma: no cover - hardware-specific
         import logging
         logging.getLogger(__name__).warning(

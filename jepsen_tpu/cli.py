@@ -419,9 +419,13 @@ def _analyze_store_register(store: Store, run_dirs: list,
             fallback.append(i)
             continue
         ks = independent.history_keys(hist)
+        # a plain cas value is [old new] (scalars); a LIFTED cas value
+        # is [key [old new]] — second element a list marks it lifted
         if not ks and any(
                 isinstance(o.get("value"), (list, tuple))
-                and len(o["value"]) == 2 and o.get("f") != "cas"
+                and len(o["value"]) == 2
+                and (o.get("f") != "cas"
+                     or isinstance(o["value"][1], (list, tuple)))
                 for o in hist if o.get("process") != "nemesis"):
             # looks lifted ([k v] values) but relift declined (e.g. no
             # ok read survived the faults): checking it as ONE register

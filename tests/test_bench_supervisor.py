@@ -1,0 +1,62 @@
+"""The bench supervisor must ALWAYS emit one parseable JSON line:
+healthy child, wedged/slow child (timeout -> CPU retry), and
+double-failure all covered. Round 2 shipped rc=1 with no output when
+the TPU transport wedged backend init — this pins the fix."""
+
+import json
+import os
+import subprocess
+import sys
+
+BENCH = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+
+TINY = {
+    # the pytest conftest exports an 8-virtual-device XLA_FLAGS; the
+    # bench child would then build an 8-way mesh for a B=2 batch
+    "XLA_FLAGS": "",
+    "BENCH_REPS": "1",
+    "BENCH_B": "2", "BENCH_T": "128", "BENCH_K": "8",
+    "BENCH_KN_B": "3", "BENCH_KN_OPS": "60", "BENCH_KN_CONC": "4",
+    "BENCH_KN20_B": "2", "BENCH_KN20_OPS": "60",
+    "BENCH_LONG_T": "1500",
+    "BENCH_E2E_B": "3", "BENCH_E2E_T": "128",
+    "BENCH_NS_B": "3", "BENCH_NS_T": "128", "BENCH_NS_K": "8",
+}
+
+
+def run_bench(extra_env, timeout=900):
+    env = {**os.environ, **TINY,
+           "JEPSEN_TPU_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu",
+           **extra_env}
+    p = subprocess.run([sys.executable, BENCH], capture_output=True,
+                       text=True, timeout=timeout, env=env)
+    assert p.returncode == 0, p.stderr[-800:]
+    lines = [ln for ln in p.stdout.strip().splitlines() if ln]
+    return json.loads(lines[-1])
+
+
+def test_supervisor_happy_path():
+    out = run_bench({})
+    assert out["unit"] == "histories/sec"
+    assert out["value"] > 0
+    assert out["backend"] == "cpu"
+    for block in ("knossos", "long_history", "end_to_end",
+                  "north_star"):
+        assert block in out, block
+        assert "error" not in out[block], out[block]
+    assert out["north_star"]["invalid_found"] >= 1
+
+
+def test_supervisor_child_timeout_falls_back_to_cpu():
+    # first attempt is given an impossible budget; the CPU retry runs
+    out = run_bench({"BENCH_TIMEOUT": "1", "BENCH_CPU_TIMEOUT": "600"})
+    assert out["value"] > 0
+    assert out["backend"] == "cpu"
+    assert "exceeded" in out.get("tpu_error", "")
+
+
+def test_supervisor_double_failure_still_emits_json():
+    out = run_bench({"BENCH_TIMEOUT": "1", "BENCH_CPU_TIMEOUT": "1"})
+    assert out["value"] == 0.0
+    assert "error" in out
+    assert "tpu attempt" in out["error"]
