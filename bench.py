@@ -259,6 +259,66 @@ def bench_end_to_end(n_dev: int, devices) -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_generator(reps: int) -> dict:
+    """Pure-generator op yield rate against the reference's single
+    published perf figure: ">20,000 operations/sec" from one generator
+    thread (jepsen/src/jepsen/generator/pure.clj:66-70). Drives a
+    representative generator stack (mix + stagger-free limit over fn
+    generators, independent-style tuples) through the pure algebra with
+    immediate synthetic completions — the same deterministic-executor
+    pattern as the reference's pure_test.clj simulators."""
+    import heapq
+    import itertools
+
+    from jepsen_tpu import generator as gen
+
+    N = int(os.environ.get("BENCH_GEN_OPS", 20_000))
+    CONC = int(os.environ.get("BENCH_GEN_CONC", 10))
+
+    def run_once() -> float:
+        g = gen.limit(N, gen.mix([
+            gen.repeat_gen({"f": "read"}),
+            gen.repeat_gen({"f": "write", "value": 3}),
+            gen.repeat_gen({"f": "cas", "value": [1, 2]}),
+        ]))
+        test = {"concurrency": CONC}
+        ctx = gen.Context.for_test(test)
+        inflight: list = []
+        tiebreak = itertools.count()
+        n_ops = 0
+        t0 = time.perf_counter()
+        while True:
+            res = gen.op(g, test, ctx)
+            if res is None:
+                if not inflight:
+                    break
+            op_, g2 = (res if res is not None else (None, g))
+            if op_ is not None and op_ is not gen.PENDING:
+                g = g2
+                thread = ctx.process_to_thread(op_["process"])
+                ctx = ctx.with_time(op_["time"]).busy(thread)
+                g = gen.update(g, test, ctx, op_)
+                n_ops += 1
+                heapq.heappush(inflight, (op_["time"] + 1_000_000,
+                                          next(tiebreak),
+                                          {**op_, "type": "ok"}))
+                continue
+            t, _, comp = heapq.heappop(inflight)
+            comp = {**comp, "time": t}
+            thread = ctx.process_to_thread(comp["process"])
+            ctx = ctx.with_time(t).free(thread)
+            g = gen.update(g, test, ctx, comp)
+        return n_ops / (time.perf_counter() - t0)
+
+    rate = max(run_once() for _ in range(max(2, reps // 2)))
+    return {
+        "metric": f"pure-generator op yield rate (conc {CONC})",
+        "value": round(rate, 1),
+        "unit": "ops/sec",
+        "vs_reference": round(rate / 20_000, 3),
+    }
+
+
 def _write_synth_store(root: Path, B: int, T: int, K: int,
                        bad_every: int) -> list[Path]:
     """Materialize B serial list-append runs as history.jsonl dirs —
@@ -399,6 +459,8 @@ def run_benches() -> int:
     from jepsen_tpu import devices as devmod
 
     try:
+        from jepsen_tpu import parallel as _parallel
+        _parallel.init_distributed()   # no-op without a coordinator env
         devices = devmod.default_devices(probe=True)
     except Exception as e:
         print(json.dumps({
@@ -423,7 +485,8 @@ def run_benches() -> int:
             ("knossos", bench_knossos, (reps, _accel(devices))),
             ("long_history", bench_long_history, (reps,)),
             ("end_to_end", bench_end_to_end, (n_dev, devices)),
-            ("north_star", bench_north_star, (n_dev, devices))):
+            ("north_star", bench_north_star, (n_dev, devices)),
+            ("generator", bench_generator, (reps,))):
         try:
             out[name] = fn(*args)
         except Exception as e:  # the elle metric must still report

@@ -140,6 +140,11 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
                          help="only runs of this test name")
     p_batch.add_argument("--backend", default="auto",
                          choices=["auto", "tpu", "cpu"])
+    p_batch.add_argument("--resume", action="store_true",
+                         help="continue an interrupted sweep: skip "
+                              "runs this checker already verdicted "
+                              "(results.json naming the checker, or "
+                              "the fallback's .sweep-* sidecar)")
 
     p_serve = sub.add_parser("serve", help="serve the store over HTTP")
     p_serve.add_argument("--port", type=int, default=8080)
@@ -214,7 +219,7 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
             return worst
         if args.command == "analyze-store":
             return analyze_store(Store(args.store), checker=args.checker,
-                                 name=args.name)
+                                 name=args.name, resume=args.resume)
         if args.command == "serve":
             from . import web
             web.serve(Store(args.store), host=args.host, port=args.port)
@@ -228,7 +233,8 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
 
 
 def analyze_store(store: Store, checker: str = "append",
-                  name: str | None = None) -> int:
+                  name: str | None = None,
+                  resume: bool = False) -> int:
     """Batch re-check every stored run — the north-star batch path
     (SURVEY.md §3.4, §7 stage 8): encodable histories are packed,
     length-bucketed, and dispatched across the device mesh in one sweep;
@@ -239,9 +245,30 @@ def analyze_store(store: Store, checker: str = "append",
     run_dirs = sorted(store.all_run_dirs())
     if name is not None:
         run_dirs = [d for d in run_dirs if d.parent.name == name]
+    if resume:
+        # resumable analysis (SURVEY.md §5.4): skip runs THIS sweep
+        # already verdicted (the marker records which checker wrote it,
+        # so an append sweep never masks a pending wr sweep)
+        pending = [d for d in run_dirs
+                   if not _verdicted(d, checker)]
+        if not pending:
+            print(f"all {len(run_dirs)} runs already verdicted "
+                  f"({checker}); nothing to resume", file=sys.stderr)
+            return 0 if run_dirs else 254
+        run_dirs = pending
     if not run_dirs:
         print("no stored runs", file=sys.stderr)
         return 254
+
+    # multi-host pods: join the job before any device work so meshes
+    # span every host's chips (no-op without a coordinator env)
+    if checker != "stored":
+        from . import parallel as _parallel
+        try:
+            _parallel.init_distributed()
+        except Exception:
+            log.warning("jax.distributed init failed; continuing "
+                        "single-process", exc_info=True)
 
     def stored_check(d) -> dict:
         stored = store.load_test(d)
@@ -334,6 +361,7 @@ def analyze_store(store: Store, checker: str = "append",
             for d, enc in zip(mapping, encs):
                 res = elle.render_verdict(enc, cycles_by_dir[d],
                                           prohibited)
+                res["checker"] = "append"   # --resume marker
                 worst = max(worst, emit(d, res))
         else:  # wr: edge lists are host-built; one device dispatch
             if host_only:
@@ -351,22 +379,46 @@ def analyze_store(store: Store, checker: str = "append",
             prohibited = elle_wr.WrChecker().prohibited
             for d, enc, cycles in zip(mapping, encs, cycles_per_run):
                 res = elle_wr.render_wr_verdict(enc, cycles, prohibited)
+                res["checker"] = "wr"       # --resume marker
                 worst = max(worst, emit(d, res))
 
     for d in fallback:
-        worst = max(worst, _stored_fallback(d, stored_check))
+        worst = max(worst, _stored_fallback(d, stored_check, checker))
     return worst
+
+
+def _verdicted(d, checker: str) -> bool:
+    """Did a prior sweep of THIS checker fully verdict this run? Batch
+    checkers leave a parseable results.json naming the checker;
+    fallback/stored verdicts leave a `.sweep-<checker>` sidecar (their
+    results.json belongs to the run's own checker). For `stored`, any
+    results.json counts too."""
+    if (d / f".sweep-{checker}").exists():
+        return True
+    p = d / "results.json"
+    if not p.exists():
+        return False
+    if checker == "stored":
+        return True
+    try:
+        return json.loads(p.read_text()).get("checker") == checker
+    except (OSError, json.JSONDecodeError):
+        return False  # truncated marker: redo the run
 
 
 def _write_results(d, res: dict) -> int:
     """Persist results.json/.edn into a run dir and print the one-line
-    summary; returns the validity exit code."""
+    summary; returns the validity exit code. results.json lands last,
+    via temp-file + rename, so its presence (parseable) marks the run
+    fully verdicted for --resume."""
+    import os as _os
     from . import edn as edn_mod
     from .store import _results_to_edn
-    (d / "results.json").write_text(
-        json.dumps(_json_safe(res), indent=2))
     (d / "results.edn").write_text(
         edn_mod.dumps(_results_to_edn(_json_safe(res))) + "\n")
+    tmp = d / "results.json.tmp"
+    tmp.write_text(json.dumps(_json_safe(res), indent=2))
+    _os.replace(tmp, d / "results.json")
     line = {"dir": str(d), "valid?": res.get("valid?")}
     if "anomaly-types" in res:
         line["anomalies"] = res.get("anomaly-types", [])
@@ -376,12 +428,16 @@ def _write_results(d, res: dict) -> int:
     return validity_exit_code(res)
 
 
-def _stored_fallback(d, stored_check) -> int:
+def _stored_fallback(d, stored_check, checker: str | None = None) -> int:
     """Run a dir through its own stored checker, degrading to an error
-    line (never an exception) on failure."""
+    line (never an exception) on failure. With `checker`, a success
+    leaves the `.sweep-<checker>` sidecar so --resume counts the run
+    done for that sweep."""
     try:
         res = stored_check(d)
         print(json.dumps({"dir": str(d), "valid?": res.get("valid?")}))
+        if checker is not None:
+            (d / f".sweep-{checker}").write_text("")
         return validity_exit_code(res)
     except Exception as e:
         print(json.dumps({"dir": str(d), "error": str(e)}))
@@ -459,12 +515,14 @@ def _analyze_store_register(store: Store, run_dirs: list,
     worst = 0
     for i, d in enumerate(run_dirs):
         if i in fallback:
-            worst = max(worst, _stored_fallback(d, stored_check))
+            worst = max(worst,
+                        _stored_fallback(d, stored_check, "register"))
             continue
         keyed = per_run.get(i, {})
         valid = merge_valid([r.get("valid?", True)
                              for r in keyed.values()] or [True])
         res = {"valid?": valid,
+               "checker": "register",       # --resume marker
                "key-count": len(keyed),
                "results": {str(k): r for k, r in keyed.items()},
                "failures": sorted(str(k) for k, r in keyed.items()

@@ -50,6 +50,39 @@ def make_mesh(devices: Sequence | None = None,
     return Mesh(np.asarray(devices).reshape(dp, mp), axes)
 
 
+def init_distributed() -> bool:
+    """Join a multi-host analysis job (SURVEY.md §5.8's DCN plane):
+    when JAX_COORDINATOR_ADDRESS (or COORDINATOR_ADDRESS) is set —
+    optionally with JAX_NUM_PROCESSES/JAX_PROCESS_ID — initialize
+    jax.distributed so `jax.devices()` spans every host's chips and
+    the dp×mp meshes built here shard across ICI within a slice and
+    DCN between them. Called by analyze-store and the bench before any
+    device work. Returns True when distributed mode came up; a
+    single-process run (no coordinator env) returns False and
+    everything behaves as before. Idempotent."""
+    import os
+
+    if not (os.environ.get("JAX_COORDINATOR_ADDRESS")
+            or os.environ.get("COORDINATOR_ADDRESS")):
+        return False
+    try:
+        if jax._src.distributed.global_state.client is not None:
+            return True  # already initialized
+    except Exception:
+        pass
+    kw = {}
+    addr = (os.environ.get("JAX_COORDINATOR_ADDRESS")
+            or os.environ.get("COORDINATOR_ADDRESS"))
+    if addr:
+        kw["coordinator_address"] = addr
+    if os.environ.get("JAX_NUM_PROCESSES"):
+        kw["num_processes"] = int(os.environ["JAX_NUM_PROCESSES"])
+    if os.environ.get("JAX_PROCESS_ID"):
+        kw["process_id"] = int(os.environ["JAX_PROCESS_ID"])
+    jax.distributed.initialize(**kw)
+    return True
+
+
 def sharded_check_fn(mesh: Mesh | None, shape: K.BatchShape, *,
                      classify: bool = True, realtime: bool = False,
                      process_order: bool = False,

@@ -224,14 +224,23 @@ class Store:
         test["history"] = self.load_history(d)
         rj = d / "results.json"
         if rj.exists():
-            test["results"] = json.loads(rj.read_text())
+            try:
+                test["results"] = json.loads(rj.read_text())
+            except (OSError, json.JSONDecodeError):
+                # results are a derived artifact: a write truncated by
+                # a crash must not make the run unloadable (re-analysis
+                # regenerates it)
+                pass
         return test
 
     def load_results(self, run_dir: str | os.PathLike) -> dict | None:
         d = Path(run_dir)
         rj = d / "results.json"
         if rj.exists():
-            return json.loads(rj.read_text())
+            try:
+                return json.loads(rj.read_text())
+            except (OSError, json.JSONDecodeError):
+                pass
         re_ = d / "results.edn"
         if re_.exists():
             v = edn.loads(re_.read_text())

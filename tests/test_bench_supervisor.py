@@ -21,6 +21,7 @@ TINY = {
     "BENCH_LONG_T": "1500",
     "BENCH_E2E_B": "3", "BENCH_E2E_T": "128",
     "BENCH_NS_B": "3", "BENCH_NS_T": "128", "BENCH_NS_K": "8",
+    "BENCH_GEN_OPS": "2000",
 }
 
 
@@ -41,10 +42,11 @@ def test_supervisor_happy_path():
     assert out["value"] > 0
     assert out["backend"] == "cpu"
     for block in ("knossos", "long_history", "end_to_end",
-                  "north_star"):
+                  "north_star", "generator"):
         assert block in out, block
         assert "error" not in out[block], out[block]
     assert out["north_star"]["invalid_found"] >= 1
+    assert out["generator"]["value"] > 0
 
 
 def test_supervisor_child_timeout_falls_back_to_cpu():

@@ -406,3 +406,59 @@ def test_analyze_store_register_declined_relift_falls_back(tmp_path):
     if (d / "results.json").exists():  # written by the stored analyze
         res = json.loads((d / "results.json").read_text())
         assert "key-count" not in res
+
+
+def test_analyze_store_resume_skips_verdicted_runs(tmp_path, capsys):
+    store = Store(tmp_path / "store")
+    d1 = make_run(store, "etcd", "20200101T000000",
+                  synth_append_history(T=40, K=4, seed=1))
+    d2 = make_run(store, "etcd", "20200101T000001",
+                  synth_append_history(T=40, K=4, seed=2))
+    assert cli.analyze_store(store, checker="append") == 0
+    capsys.readouterr()
+    stamp1 = (d1 / "results.json").stat().st_mtime_ns
+    # make d2 look un-verdicted; a resumed sweep must only redo d2
+    (d2 / "results.json").unlink()
+    assert cli.analyze_store(store, checker="append", resume=True) == 0
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    assert [ln["dir"] for ln in lines] == [str(d2)]
+    assert (d1 / "results.json").stat().st_mtime_ns == stamp1
+    assert (d2 / "results.json").exists()
+    # everything verdicted for THIS checker: success, nothing to do
+    assert cli.analyze_store(store, checker="append", resume=True) == 0
+    # a different checker's sweep is NOT masked by append's markers
+    capsys.readouterr()
+    assert cli.analyze_store(store, checker="wr", resume=True) in (0, 1, 2)
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    assert len(lines) == 2  # both runs re-checked under wr
+    # ...and, once done (here via the stored fallback's sidecar), a
+    # resumed wr sweep is complete
+    assert (d1 / ".sweep-wr").exists()
+    assert cli.analyze_store(store, checker="wr", resume=True) == 0
+    # a truncated/absent marker means the run is redone, not skipped
+    (d2 / "results.json").write_text("{truncated")
+    (d2 / ".sweep-wr").unlink()
+    capsys.readouterr()
+    assert cli.analyze_store(store, checker="wr", resume=True) in (0, 1, 2)
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    assert [ln["dir"] for ln in lines] == [str(d2)]
+
+
+def test_init_distributed_gating(monkeypatch):
+    from jepsen_tpu import parallel
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("COORDINATOR_ADDRESS", raising=False)
+    assert parallel.init_distributed() is False  # single-process: no-op
+    called = {}
+    import jax as _jax
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+    monkeypatch.setenv("JAX_PROCESS_ID", "2")
+    monkeypatch.setattr(_jax.distributed, "initialize",
+                        lambda **kw: called.update(kw))
+    assert parallel.init_distributed() is True
+    assert called == {"coordinator_address": "10.0.0.1:1234",
+                      "num_processes": 4, "process_id": 2}
