@@ -461,6 +461,10 @@ def run_benches() -> int:
     try:
         from jepsen_tpu import parallel as _parallel
         _parallel.init_distributed()   # no-op without a coordinator env
+    except Exception as e:
+        print(f"init_distributed failed; continuing single-process: "
+              f"{e!r}"[:200], file=sys.stderr)
+    try:
         devices = devmod.default_devices(probe=True)
     except Exception as e:
         print(json.dumps({

@@ -245,16 +245,24 @@ def analyze_store(store: Store, checker: str = "append",
     run_dirs = sorted(store.all_run_dirs())
     if name is not None:
         run_dirs = [d for d in run_dirs if d.parent.name == name]
+    prior_worst = 0
     if resume:
         # resumable analysis (SURVEY.md §5.4): skip runs THIS sweep
         # already verdicted (the marker records which checker wrote it,
-        # so an append sweep never masks a pending wr sweep)
-        pending = [d for d in run_dirs
-                   if not _verdicted(d, checker)]
+        # so an append sweep never masks a pending wr sweep). Skipped
+        # runs still contribute their recorded validity to the exit
+        # code — an invalid verdict from the completed part of an
+        # interrupted sweep must not read as success.
+        pending = []
+        for d in run_dirs:
+            if _verdicted(d, checker):
+                prior_worst = max(prior_worst, _prior_code(d))
+            else:
+                pending.append(d)
         if not pending:
             print(f"all {len(run_dirs)} runs already verdicted "
                   f"({checker}); nothing to resume", file=sys.stderr)
-            return 0 if run_dirs else 254
+            return prior_worst if run_dirs else 254
         run_dirs = pending
     if not run_dirs:
         print("no stored runs", file=sys.stderr)
@@ -276,19 +284,20 @@ def analyze_store(store: Store, checker: str = "append",
         test["store"] = store
         return core.analyze(test)["results"]
 
-    emit = _write_results
+    def emit(d, res):
+        return _write_results(d, res, checker)
 
-    worst = 0
+    worst = prior_worst
     if checker == "stored":
         for d in run_dirs:
-            res = stored_check(d)
-            print(json.dumps({"dir": str(d),
-                              "valid?": res.get("valid?")}))
-            worst = max(worst, validity_exit_code(res))
+            worst = max(worst,
+                        _stored_fallback(d, stored_check, "stored"))
         return worst
 
     if checker == "register":
-        return _analyze_store_register(store, run_dirs, stored_check)
+        return max(prior_worst,
+                   _analyze_store_register(store, run_dirs,
+                                           stored_check))
 
     from . import parallel
     from .checker import elle
@@ -388,37 +397,48 @@ def analyze_store(store: Store, checker: str = "append",
 
 
 def _verdicted(d, checker: str) -> bool:
-    """Did a prior sweep of THIS checker fully verdict this run? Batch
-    checkers leave a parseable results.json naming the checker;
-    fallback/stored verdicts leave a `.sweep-<checker>` sidecar (their
-    results.json belongs to the run's own checker). For `stored`, any
-    results.json counts too."""
+    """Did a prior sweep of THIS checker fully verdict this run? Every
+    completed verdict leaves an additive `.sweep-<checker>` sidecar
+    (so alternating sweeps never erase each other's progress); a
+    parseable results.json naming the checker counts too."""
     if (d / f".sweep-{checker}").exists():
         return True
     p = d / "results.json"
-    if not p.exists():
-        return False
-    if checker == "stored":
-        return True
+    if not p.exists() or checker == "stored":
+        return False  # stored sweeps mark ONLY via the sidecar: the
+        #               run's own results.json predates the sweep
     try:
         return json.loads(p.read_text()).get("checker") == checker
     except (OSError, json.JSONDecodeError):
         return False  # truncated marker: redo the run
 
 
-def _write_results(d, res: dict) -> int:
+def _prior_code(d) -> int:
+    """Exit-code contribution of an already-verdicted (skipped) run."""
+    try:
+        return validity_exit_code(
+            json.loads((d / "results.json").read_text()))
+    except (OSError, json.JSONDecodeError):
+        return 0  # sidecar-only marker: validity was reported when run
+
+
+def _write_results(d, res: dict, checker: str | None = None) -> int:
     """Persist results.json/.edn into a run dir and print the one-line
-    summary; returns the validity exit code. results.json lands last,
-    via temp-file + rename, so its presence (parseable) marks the run
-    fully verdicted for --resume."""
+    summary; returns the validity exit code. results.json lands via
+    per-process temp-file + atomic rename (multi-host sweeps over a
+    shared store race benignly — identical content, last writer wins),
+    then the additive `.sweep-<checker>` sidecar marks the run done
+    for --resume."""
     import os as _os
     from . import edn as edn_mod
     from .store import _results_to_edn
     (d / "results.edn").write_text(
         edn_mod.dumps(_results_to_edn(_json_safe(res))) + "\n")
-    tmp = d / "results.json.tmp"
+    tmp = d / f"results.json.tmp.{_os.getpid()}"
     tmp.write_text(json.dumps(_json_safe(res), indent=2))
     _os.replace(tmp, d / "results.json")
+    if checker is not None:
+        (d / f".sweep-{checker}").write_text("")
     line = {"dir": str(d), "valid?": res.get("valid?")}
     if "anomaly-types" in res:
         line["anomalies"] = res.get("anomaly-types", [])
@@ -527,7 +547,7 @@ def _analyze_store_register(store: Store, run_dirs: list,
                "results": {str(k): r for k, r in keyed.items()},
                "failures": sorted(str(k) for k, r in keyed.items()
                                   if r.get("valid?") is False)}
-        worst = max(worst, _write_results(d, res))
+        worst = max(worst, _write_results(d, res, "register"))
     return worst
 
 

@@ -25,7 +25,6 @@ from __future__ import annotations
 import logging
 import queue
 import threading
-import time as _time
 from typing import Any
 
 from .. import client as jclient
@@ -89,7 +88,8 @@ def _make_worker(test: dict, wid) -> Any:
     return NemesisWorker()
 
 
-def _worker_loop(test: dict, wid, in_q: queue.Queue, out_q: queue.Queue):
+def _worker_loop(test: dict, wid, in_q: queue.Queue, out_q: queue.Queue,
+                 drain_event: threading.Event):
     worker = _make_worker(test, wid)
     try:
         while True:
@@ -99,7 +99,11 @@ def _worker_loop(test: dict, wid, in_q: queue.Queue, out_q: queue.Queue):
                 return
             try:
                 if t == "sleep":
-                    _time.sleep(op.get("value") or 0)
+                    # interruptible: once the generator is exhausted the
+                    # event loop sets drain_event, so a long nemesis
+                    # sleep can't hold the whole run open past its
+                    # time limit (the sleep's pacing is moot by then)
+                    drain_event.wait(op.get("value") or 0)
                     out_q.put(op)
                 elif t == "log":
                     log.info("%s", op.get("value"))
@@ -123,12 +127,14 @@ def run(test: dict) -> list[dict]:
     worker_ids = ctx.all_threads()
     completions: queue.Queue = queue.Queue()
     invocations: dict = {}
+    drain_event = threading.Event()
     threads = []
     for wid in worker_ids:
         in_q: queue.Queue = queue.Queue(maxsize=1)
         invocations[wid] = in_q
         th = threading.Thread(
-            target=_worker_loop, args=(test, wid, in_q, completions),
+            target=_worker_loop,
+            args=(test, wid, in_q, completions, drain_event),
             name=f"jepsen-worker-{wid}", daemon=True)
         th.start()
         threads.append(th)
@@ -166,6 +172,7 @@ def run(test: dict) -> list[dict]:
             ctx = ctx.with_time(now)
             res = gen.op(g, test, ctx)
             if res is None:
+                drain_event.set()   # wake any sleeping workers
                 if outstanding > 0:
                     poll_timeout = MAX_PENDING_INTERVAL_S
                     continue
@@ -193,6 +200,7 @@ def run(test: dict) -> list[dict]:
             poll_timeout = 0.0
     except BaseException:
         log.info("Shutting down workers after abnormal exit")
+        drain_event.set()
         for in_q in invocations.values():
             try:
                 # Workers drain their single-slot queue quickly; if one is

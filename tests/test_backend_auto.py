@@ -92,9 +92,9 @@ def test_etcd_dummy_run_analyze_routes_device_kernels(
 
     monkeypatch.setattr(dense, "check_encoded_dense_batch", spy)
 
-    # nemesis-interval must stay below time-limit: the nemesis's sleep
-    # ops run on its worker thread, and the post-time-limit drain waits
-    # for the in-flight sleep to finish.
+    # short nemesis-interval keeps fault ops inside the window (drain
+    # now interrupts in-flight sleeps, so a long interval would merely
+    # be a no-op nemesis, not a hang)
     t = etcd.etcd_test({"time-limit": 2, "ops-per-key": 15,
                         "threads-per-key": 2, "nemesis-interval": 1})
     t.update(nodes=["n1", "n2", "n3"], concurrency=2,

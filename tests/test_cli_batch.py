@@ -417,8 +417,10 @@ def test_analyze_store_resume_skips_verdicted_runs(tmp_path, capsys):
     assert cli.analyze_store(store, checker="append") == 0
     capsys.readouterr()
     stamp1 = (d1 / "results.json").stat().st_mtime_ns
-    # make d2 look un-verdicted; a resumed sweep must only redo d2
+    # make d2 look un-verdicted (an interrupted run has neither the
+    # results.json nor the sidecar — the sidecar lands last)
     (d2 / "results.json").unlink()
+    (d2 / ".sweep-append").unlink()
     assert cli.analyze_store(store, checker="append", resume=True) == 0
     lines = [json.loads(ln) for ln in
              capsys.readouterr().out.strip().splitlines()]
@@ -462,3 +464,26 @@ def test_init_distributed_gating(monkeypatch):
     assert parallel.init_distributed() is True
     assert called == {"coordinator_address": "10.0.0.1:1234",
                       "num_processes": 4, "process_id": 2}
+
+
+def test_analyze_store_stored_resume(tmp_path, capsys):
+    """stored sweeps mark progress via the sidecar only — a run's
+    pre-existing results.json (from its original invocation) must not
+    count as 'this sweep already visited it'."""
+    store = Store(tmp_path / "store")
+    hist = [{"type": "invoke", "process": 0, "f": "read", "value": None},
+            {"type": "ok", "process": 0, "f": "read", "value": 1}]
+    d = make_run(store, "x", "20200101T000000", hist)
+    (d / "test.json").write_text(json.dumps({"name": "x"}))
+    # simulate the run's own analyze having written results already
+    (d / "results.json").write_text(json.dumps({"valid?": True}))
+    capsys.readouterr()
+    rc = cli.analyze_store(store, checker="stored", resume=True)
+    assert rc == 0
+    out = capsys.readouterr()
+    assert "nothing to resume" not in out.err  # it DID re-check
+    assert (d / ".sweep-stored").exists()
+    # now the sweep is recorded: resume has nothing left
+    rc = cli.analyze_store(store, checker="stored", resume=True)
+    assert rc == 0
+    assert "nothing to resume" in capsys.readouterr().err

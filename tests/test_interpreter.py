@@ -147,3 +147,43 @@ def test_generator_exception_shuts_down_workers():
 
     with pytest.raises(RuntimeError):
         run_test(client=OkClient(), generator=Boom())
+
+
+def test_drain_interrupts_long_sleeps():
+    """A nemesis mid-sleep must not hold the run open after the
+    generator is exhausted — the drain wakes sleeping workers."""
+    import time as _t
+
+    from jepsen_tpu import generator as gen
+    from jepsen_tpu.generator import interpreter
+    from jepsen_tpu.util import relative_time
+
+    class OkClient:
+        def open(self, test, node):
+            return self
+
+        def setup(self, test):
+            pass
+
+        def invoke(self, test, op):
+            return {**op, "type": "ok"}
+
+        def teardown(self, test):
+            pass
+
+        def close(self, test):
+            pass
+
+    test = {
+        "nodes": ["n1"], "concurrency": 1,
+        "client": OkClient(),
+        # clients do one quick op; the nemesis starts a 60 s sleep
+        "generator": gen.time_limit(0.5, gen.clients(
+            gen.limit(3, gen.repeat_gen({"f": "read"})),
+            gen.Seq.of([gen.sleep(60)]))),
+    }
+    t0 = _t.monotonic()
+    with relative_time():
+        hist = interpreter.run(test)
+    assert _t.monotonic() - t0 < 10, "drain blocked on the 60s sleep"
+    assert any(o.get("f") == "read" for o in hist)
