@@ -173,6 +173,63 @@ def pad_to_multiple(xs: Sequence[T], k: int) -> list[T]:
     return xs
 
 
+class LazyAtom:
+    """A thread-safe mutable ref whose initial value is computed by
+    `f()` on first use; reset bypasses initialization
+    (util.clj:730-777). swap applies a function under the lock."""
+
+    _FRESH = object()
+
+    def __init__(self, f):
+        import threading
+        self._f = f
+        self._lock = threading.Lock()
+        self._value = LazyAtom._FRESH
+
+    def _init(self):
+        if self._value is LazyAtom._FRESH:
+            with self._lock:
+                if self._value is LazyAtom._FRESH:
+                    self._value = self._f()
+        return self._value
+
+    def deref(self):
+        return self._init()
+
+    def swap(self, f, *args):
+        self._init()
+        with self._lock:
+            self._value = f(self._value, *args)
+            return self._value
+
+    def reset(self, v):
+        with self._lock:
+            self._value = v
+            return v
+
+
+def lazy_atom(f) -> LazyAtom:
+    return LazyAtom(f)
+
+
+def named_locks():
+    """A dynamic pool of named locks (util.clj:779-808): call the
+    returned function with any hashable name to get the canonical Lock
+    for it — e.g. to serialize concurrent daemon restarts per node.
+    Use as `with locks(node): ...`."""
+    import threading
+    pool: dict = {}
+    guard = threading.Lock()
+
+    def lock_for(name):
+        with guard:
+            if name not in pool:
+                pool[name] = threading.Lock()
+            return pool[name]
+
+    return lock_for
+
+
 def chunk_vec(n: int, xs: Sequence[T]) -> list[list[T]]:
     """Split xs into chunks of at most n elements."""
     return [list(xs[i : i + n]) for i in range(0, len(xs), n)]

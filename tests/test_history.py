@@ -84,3 +84,38 @@ def test_latencies_and_intervals():
     spans = h.nemesis_intervals(hist)
     assert len(spans) == 1
     assert spans[0][0]["time"] == 400 and spans[0][1]["time"] == 900
+
+
+def test_lazy_atom():
+    import threading
+
+    from jepsen_tpu.util import lazy_atom
+
+    calls = []
+
+    def init():
+        calls.append(1)
+        return 10
+
+    a = lazy_atom(init)
+    outs = []
+    ts = [threading.Thread(target=lambda: outs.append(a.deref()))
+          for _ in range(8)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert outs == [10] * 8 and calls == [1]  # initialized exactly once
+    assert a.swap(lambda v, d: v + d, 5) == 15
+    assert a.deref() == 15
+    a.reset(0)
+    assert a.deref() == 0
+
+
+def test_named_locks():
+    from jepsen_tpu.util import named_locks
+    locks = named_locks()
+    assert locks("n1") is locks("n1")
+    assert locks("n1") is not locks("n2")
+    with locks("n1"):
+        assert not locks("n1").acquire(blocking=False)
+    assert locks("n1").acquire(blocking=False)
+    locks("n1").release()
