@@ -265,9 +265,11 @@ def check_condensed(enc: EncodedHistory, *, classify: bool = True,
             "process": np.asarray(enc.process)[rows],
         })
     if per_scc:
-        for res in K.check_edge_batch(per_scc, classify=True,
-                                      realtime=realtime,
-                                      process_order=False,
-                                      devices=devices):
+        # bucketed: many small SCCs padded to the largest one's T would
+        # otherwise pack into a single over-budget [B,T,T]x3 dispatch
+        for res in K.check_edge_batch_bucketed(per_scc, classify=True,
+                                               realtime=realtime,
+                                               process_order=False,
+                                               devices=devices):
             flags.update(res)
     return flags

@@ -407,6 +407,35 @@ def check_edge_batch(per_history: list[dict], realtime: bool = False,
     return [flags_to_names(int(w)) for w in np.asarray(flags)[:n]]
 
 
+def check_edge_batch_bucketed(per_history: list[dict],
+                              realtime: bool = False,
+                              process_order: bool = False,
+                              classify: bool = True, devices=None,
+                              budget_cells: int = 1 << 27) -> list[dict]:
+    """check_edge_batch with device-memory-aware length bucketing: the
+    packed matrices are B·T_pad² cells × 3 edge classes, so one
+    unbucketed dispatch over a big store would blow HBM. Reuses
+    parallel.bucket_by_length (including its dp-padding headroom —
+    check_edge_batch replicates the last entry up to a device
+    multiple); results return in input order."""
+    if not per_history:
+        return []
+    from ...parallel import bucket_by_length
+    dp = (len(devices) if devices is not None
+          else len(default_devices()))
+    out: list[dict | None] = [None] * len(per_history)
+    for bucket in bucket_by_length(per_history,
+                                   budget_cells=budget_cells,
+                                   dp=max(1, dp)):
+        res = check_edge_batch([per_history[j] for j in bucket],
+                               realtime=realtime,
+                               process_order=process_order,
+                               classify=classify, devices=devices)
+        for j, r in zip(bucket, res):
+            out[j] = r
+    return out  # type: ignore[return-value]
+
+
 def flags_to_names(word: int) -> dict:
     """Anomaly names for a flag word. In detect-only mode (classify=False)
     no classify bits exist, so a set CYCLE bit reports as a generic

@@ -452,9 +452,9 @@ class WrChecker(Checker):
 
     def check_batch(self, test, histories: list, opts) -> list[dict]:
         """Batched per-key dispatch: host version-order inference per
-        history, then ONE device cycle dispatch over the packed edge
-        matrices (kernels.check_edge_batch); flagged histories re-run
-        the host oracle for witnesses."""
+        history, then length-bucketed device cycle dispatches over the
+        packed edge matrices (kernels.check_edge_batch_bucketed);
+        flagged histories re-run the host oracle for witnesses."""
         from ...devices import resolve_backend
         backend = resolve_backend(self.backend)
         encs = [encode_wr_history(h, **self.opts) for h in histories]
@@ -464,7 +464,7 @@ class WrChecker(Checker):
             return [render_wr_verdict(e, cycle_anomalies_cpu(e, **kw),
                                       self.prohibited) for e in encs]
         from . import artifacts, kernels
-        cycles_list = kernels.check_edge_batch(
+        cycles_list = kernels.check_edge_batch_bucketed(
             [{"n": e.n, "edges": e.edges,
               "invoke_index": e.invoke_index,
               "complete_index": e.complete_index,

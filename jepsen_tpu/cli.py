@@ -372,7 +372,7 @@ def analyze_store(store: Store, checker: str = "append",
                                           prohibited)
                 res["checker"] = "append"   # --resume marker
                 worst = max(worst, emit(d, res))
-        else:  # wr: edge lists are host-built; one device dispatch
+        else:  # wr: edge lists host-built; bucketed device dispatches
             if host_only:
                 # wr encodings carry prebuilt edges; the wr module's
                 # own host analyzer consumes them (the append-side
@@ -380,7 +380,7 @@ def analyze_store(store: Store, checker: str = "append",
                 cycles_per_run = [elle_wr.cycle_anomalies_cpu(e)
                                   for e in encs]
             else:
-                cycles_per_run = elle_kernels.check_edge_batch(
+                cycles_per_run = elle_kernels.check_edge_batch_bucketed(
                     [{"n": e.n, "edges": e.edges,
                       "invoke_index": e.invoke_index,
                       "complete_index": e.complete_index,

@@ -196,22 +196,29 @@ def check_long_history(enc, mesh: Mesh | None = None, *,
 # stays under a budget, instead of padding everything to the longest.
 # ---------------------------------------------------------------------------
 
+def _size_of(e) -> int:
+    """Txn count of an encoded history (attribute) or packed edge dict
+    (key) — both bucket the same way."""
+    return e["n"] if isinstance(e, dict) else e.n
+
+
 def bucket_by_length(encs: Sequence, *, multiple: int = 128,
                      budget_cells: int = 1 << 27,
                      dp: int = 1) -> list[list[int]]:
     """Partition history indices into buckets of similar padded txn
     count. Each bucket satisfies B_pad * T_pad² <= budget_cells, where
     T_pad is the bucket max rounded up to `multiple` and B_pad is the
-    bucket size rounded up to a multiple of `dp` (check_bucketed pads
+    bucket size rounded up to a multiple of `dp` (dispatchers pad
     ragged buckets to a dp multiple, so that headroom must be budgeted
     here, not discovered at dispatch). Returns buckets of indices into
-    encs, longest histories first."""
-    order = sorted(range(len(encs)), key=lambda i: -encs[i].n)
+    encs, longest histories first. Elements may be EncodedHistory-like
+    (`.n`) or packed edge dicts (`["n"]`)."""
+    order = sorted(range(len(encs)), key=lambda i: -_size_of(encs[i]))
     buckets: list[list[int]] = []
     cur: list[int] = []
     cur_tpad = 0
     for i in order:
-        tpad = max(K.pad_to(max(encs[i].n, 1), multiple), 1)
+        tpad = max(K.pad_to(max(_size_of(encs[i]), 1), multiple), 1)
         t = max(cur_tpad, tpad)
         b_pad = -(-(len(cur) + 1) // dp) * dp
         if cur and b_pad * t * t > budget_cells:

@@ -181,7 +181,6 @@ class LazyAtom:
     _FRESH = object()
 
     def __init__(self, f):
-        import threading
         self._f = f
         self._lock = threading.Lock()
         self._value = LazyAtom._FRESH
@@ -217,7 +216,6 @@ def named_locks():
     returned function with any hashable name to get the canonical Lock
     for it — e.g. to serialize concurrent daemon restarts per node.
     Use as `with locks(node): ...`."""
-    import threading
     pool: dict = {}
     guard = threading.Lock()
 

@@ -308,3 +308,33 @@ class TestWorkload:
         t = wr_workload.test(seed=1)
         assert t["name"] == "rw-register"
         assert t["checker"] is not None and t["generator"] is not None
+
+
+def test_edge_batch_bucketed_matches_unbucketed():
+    """Length bucketing must not change verdicts; tiny budget forces
+    multiple dispatches over ragged sizes."""
+    from jepsen_tpu.checker.elle import kernels as K
+    from jepsen_tpu.checker.elle import wr as wr_mod
+
+    def hist(n_pairs, bad=False):
+        ops = []
+        for i in range(n_pairs):
+            v = [["w", "x", i + 1]] if i % 2 == 0 else [["r", "x", i]]
+            ops += ok_txn(i % 3, v)
+        if bad:
+            ops += ok_txn(4, [["w", "y", 1], ["r", "y", 2]])  # internal
+        return hist_list(ops)
+
+    def hist_list(ops):
+        return [{"type": ty, "process": p, "f": "txn", "value": txn,
+                 "index": i, "time": i * 1000}
+                for i, (ty, p, txn) in enumerate(ops)]
+
+    encs = [wr_mod.encode_wr_history(hist(n, bad=(n == 9)))
+            for n in (3, 9, 30, 5, 60)]
+    per = [{"n": e.n, "edges": e.edges, "invoke_index": e.invoke_index,
+            "complete_index": e.complete_index, "process": e.process}
+           for e in encs]
+    full = K.check_edge_batch(per)
+    small = K.check_edge_batch_bucketed(per, budget_cells=130 * 130 * 2)
+    assert full == small
