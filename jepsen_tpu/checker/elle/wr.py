@@ -72,6 +72,14 @@ class WrEncoded:
     key_count: int = 0
 
 
+def to_edge_dict(enc: WrEncoded) -> dict:
+    """The packed-edge form kernels.check_edge_batch consumes."""
+    return {"n": enc.n, "edges": enc.edges,
+            "invoke_index": enc.invoke_index,
+            "complete_index": enc.complete_index,
+            "process": enc.process}
+
+
 def ext_reads(txn: list) -> dict:
     """key -> value for reads that observe *external* state: the first
     read of a key at a point where the txn has not yet written it."""
@@ -465,10 +473,7 @@ class WrChecker(Checker):
                                       self.prohibited) for e in encs]
         from . import artifacts, kernels
         cycles_list = kernels.check_edge_batch_bucketed(
-            [{"n": e.n, "edges": e.edges,
-              "invoke_index": e.invoke_index,
-              "complete_index": e.complete_index,
-              "process": e.process} for e in encs], **kw)
+            [to_edge_dict(e) for e in encs], **kw)
         out = []
         for enc, cycles in zip(encs, cycles_list):
             divergent: dict = {}

@@ -381,10 +381,7 @@ def analyze_store(store: Store, checker: str = "append",
                                   for e in encs]
             else:
                 cycles_per_run = elle_kernels.check_edge_batch_bucketed(
-                    [{"n": e.n, "edges": e.edges,
-                      "invoke_index": e.invoke_index,
-                      "complete_index": e.complete_index,
-                      "process": e.process} for e in encs])
+                    [elle_wr.to_edge_dict(e) for e in encs])
             prohibited = elle_wr.WrChecker().prohibited
             for d, enc, cycles in zip(mapping, encs, cycles_per_run):
                 res = elle_wr.render_wr_verdict(enc, cycles, prohibited)

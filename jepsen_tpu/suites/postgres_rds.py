@@ -69,15 +69,19 @@ def main(argv=None) -> int:
         p.add_argument("--endpoint", default=None,
                        help="RDS endpoint hostname")
         p.add_argument("--user", default="postgres")
-        p.add_argument("--password", default=None)
+        # --password is taken by the standard SSH options
+        p.add_argument("--db-password", dest="db_password",
+                       default=None)
         p.add_argument("--database", default="postgres")
 
     def opts_from(tmap, args):
         out = dict(tmap)
-        for k in ("endpoint", "user", "password", "database"):
+        for k in ("endpoint", "user", "database"):
             v = getattr(args, k, None)
             if v is not None:
                 out[k] = v
+        if getattr(args, "db_password", None) is not None:
+            out["password"] = args.db_password
         out["workload"] = resolve_workload(args, tmap, "bank")
         return out
 

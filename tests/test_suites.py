@@ -288,3 +288,25 @@ def test_cockroach_nemesis_menu():
     import pytest as _pytest
     with _pytest.raises(ValueError, match="unknown nemesis"):
         c.cockroach_test({"ssh": {"dummy": True}, "nemesis": "bogus"})
+
+
+def test_every_suite_cli_help():
+    """Every suite main must parse `test --help` — catches option
+    collisions between suite opt_fns and the standard test options."""
+    import contextlib
+    import importlib
+    import io
+
+    from jepsen_tpu import suites as suites_mod
+    for name in sorted(suites_mod.SUITES):
+        mod = importlib.import_module(f"jepsen_tpu.suites.{name}")
+        main = getattr(mod, "main", None)
+        assert main is not None, name
+        buf = io.StringIO()
+        try:
+            with contextlib.redirect_stdout(buf), \
+                    contextlib.redirect_stderr(buf):
+                rc = main(["test", "--help"])
+        except SystemExit as e:
+            rc = 0 if e.code in (0, None) else e.code
+        assert rc == 0, (name, buf.getvalue()[-300:])
