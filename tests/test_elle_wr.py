@@ -332,9 +332,7 @@ def test_edge_batch_bucketed_matches_unbucketed():
 
     encs = [wr_mod.encode_wr_history(hist(n, bad=(n == 9)))
             for n in (3, 9, 30, 5, 60)]
-    per = [{"n": e.n, "edges": e.edges, "invoke_index": e.invoke_index,
-            "complete_index": e.complete_index, "process": e.process}
-           for e in encs]
+    per = [wr_mod.to_edge_dict(e) for e in encs]
     full = K.check_edge_batch(per)
     small = K.check_edge_batch_bucketed(per, budget_cells=130 * 130 * 2)
     assert full == small
