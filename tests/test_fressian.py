@@ -87,6 +87,13 @@ def test_tagged_value_roundtrip_and_conversions():
     assert f.convert_tagged("multiset", [{"a": 2, "b": 1}]) == \
         ["a", "a", "b"]
     assert f.convert_tagged("map-entry", [1, 2]) == (1, 2)
+    # ...specifically the reference's independent/tuple type
+    # (a MapEntry, independent.clj:22-30), so re-analysis of reference
+    # stores splits per key again
+    from jepsen_tpu import independent
+    me = f.convert_tagged("map-entry", ["k", 5])
+    assert independent.is_tuple(me)
+    assert me.key == "k" and me.value == 5
 
 
 def test_reader_rejects_garbage():
