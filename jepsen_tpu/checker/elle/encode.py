@@ -200,9 +200,15 @@ def encode_history(history: list[dict]) -> EncodedHistory:
         for mf, k, v in row["txn"]:
             if mf == "r" and v is not None:
                 reads_by_key.setdefault(k, []).append((row["op"], v))
-                # duplicate elements inside one read
+                # duplicate elements inside one read (values are
+                # usually ints: hash directly, repr only as the
+                # fallback for unhashables)
                 vals = list(v)
-                if len(vals) != len(set(map(repr, vals))):
+                try:
+                    uniq = len(set(vals))
+                except TypeError:
+                    uniq = len(set(map(repr, vals)))
+                if len(vals) != uniq:
                     _note(anomalies, "duplicate-elements",
                           {"key": k, "value": vals, "op": row["op"]})
 
