@@ -200,12 +200,14 @@ def encode_history(history: list[dict]) -> EncodedHistory:
         for mf, k, v in row["txn"]:
             if mf == "r" and v is not None:
                 reads_by_key.setdefault(k, []).append((row["op"], v))
-                # duplicate elements inside one read (values are
-                # usually ints: hash directly, repr only as the
-                # fallback for unhashables)
+                # duplicate elements inside one read. Hash (type, v)
+                # pairs: Python's cross-type equality would conflate
+                # 1 == True == 1.0 into one element and flag a
+                # legitimate [1, True] read; repr stays the fallback
+                # for unhashables.
                 vals = list(v)
                 try:
-                    uniq = len(set(vals))
+                    uniq = len({(type(x), x) for x in vals})
                 except TypeError:
                     uniq = len(set(map(repr, vals)))
                 if len(vals) != uniq:

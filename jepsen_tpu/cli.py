@@ -256,7 +256,7 @@ def analyze_store(store: Store, checker: str = "append",
         pending = []
         for d in run_dirs:
             if _verdicted(d, checker):
-                prior_worst = max(prior_worst, _prior_code(d))
+                prior_worst = max(prior_worst, _prior_code(d, checker))
             else:
                 pending.append(d)
         if not pending:
@@ -410,13 +410,25 @@ def _verdicted(d, checker: str) -> bool:
         return False  # truncated marker: redo the run
 
 
-def _prior_code(d) -> int:
-    """Exit-code contribution of an already-verdicted (skipped) run."""
+def _prior_code(d, checker: str | None = None) -> int:
+    """Exit-code contribution of an already-verdicted (skipped) run.
+    THIS sweep's sidecar is consulted first: results.json is whichever
+    checker wrote it last, so a later sweep by a different checker
+    would mask this checker's recorded validity (and stored-fallback
+    runs never write results.json at all) — an invalid verdict from
+    the completed part of an interrupted sweep must not read as
+    success. Legacy empty sidecars fall through to results.json."""
+    if checker is not None:
+        try:
+            return validity_exit_code(
+                json.loads((d / f".sweep-{checker}").read_text()))
+        except (OSError, json.JSONDecodeError, ValueError):
+            pass
     try:
         return validity_exit_code(
             json.loads((d / "results.json").read_text()))
     except (OSError, json.JSONDecodeError):
-        return 0  # sidecar-only marker: validity was reported when run
+        return 0  # legacy empty sidecar: validity was reported when run
 
 
 def _write_results(d, res: dict, checker: str | None = None) -> int:
@@ -435,7 +447,8 @@ def _write_results(d, res: dict, checker: str | None = None) -> int:
     tmp.write_text(json.dumps(_json_safe(res), indent=2))
     _os.replace(tmp, d / "results.json")
     if checker is not None:
-        (d / f".sweep-{checker}").write_text("")
+        (d / f".sweep-{checker}").write_text(
+            json.dumps({"valid?": res.get("valid?")}))
     line = {"dir": str(d), "valid?": res.get("valid?")}
     if "anomaly-types" in res:
         line["anomalies"] = res.get("anomaly-types", [])
@@ -454,7 +467,11 @@ def _stored_fallback(d, stored_check, checker: str | None = None) -> int:
         res = stored_check(d)
         print(json.dumps({"dir": str(d), "valid?": res.get("valid?")}))
         if checker is not None:
-            (d / f".sweep-{checker}").write_text("")
+            # record the validity: the fallback may not write a
+            # results.json, and --resume must reproduce this run's
+            # exit-code contribution from the sidecar alone
+            (d / f".sweep-{checker}").write_text(
+                json.dumps({"valid?": res.get("valid?")}))
         return validity_exit_code(res)
     except Exception as e:
         print(json.dumps({"dir": str(d), "error": str(e)}))

@@ -25,9 +25,26 @@ import sys
 # (True, None) = healthy, (False, "err...") = dead/unreachable.
 _probe_result: tuple[bool, str | None] | None = None
 
+# Platform the successful probe reported (e.g. "tpu", "cpu"); None
+# until a probe succeeds. Lets `auto` resolution answer "is there an
+# accelerator?" without ever importing jax in this process.
+_probe_platform: str | None = None
+
 # Why the last default_devices() call fell back to CPU (None if it
 # didn't). Benchmarks surface this in their structured output.
 backend_error: str | None = None
+
+
+class BackendUnavailable(RuntimeError):
+    """The default JAX backend failed its bounded health probe.
+
+    Raised instead of attempting any in-process fallback: once a dead
+    device plugin's sitecustomize hook has registered itself, even
+    `jax.devices("cpu")` after a config re-pin can initialize the dead
+    backend and wedge forever (observed >90s in round 3). The only safe
+    CPU fallback is a FRESH process with JAX_PLATFORMS=cpu in the env
+    before jax import — which is what the bench supervisor and the
+    jax-free CPU oracles provide."""
 
 
 def probe_timeout() -> float:
@@ -52,10 +69,15 @@ def probe_default_backend(timeout: float | None = None) -> tuple[bool, str | Non
     in-process risks wedging the caller forever, because backend init
     holds the lock `jax.devices()` needs and a dead transport never
     returns."""
-    global _probe_result
+    global _probe_result, _probe_platform
     if _probe_result is not None:
         return _probe_result
     if _backends_already_alive():
+        try:
+            import jax
+            _probe_platform = jax.devices()[0].platform
+        except Exception:
+            pass
         _probe_result = (True, None)
         return _probe_result
     timeout = probe_timeout() if timeout is None else timeout
@@ -65,6 +87,11 @@ def probe_default_backend(timeout: float | None = None) -> tuple[bool, str | Non
         p = subprocess.run([sys.executable, "-c", code],
                            capture_output=True, text=True, timeout=timeout)
         if p.returncode == 0 and "JEPSEN_PROBE_OK" in p.stdout:
+            for line in p.stdout.splitlines():
+                if line.startswith("JEPSEN_PROBE_OK"):
+                    parts = line.split()
+                    if len(parts) >= 3:
+                        _probe_platform = parts[2]
             _probe_result = (True, None)
         else:
             tail = (p.stderr or p.stdout).strip().splitlines()[-1:]
@@ -79,11 +106,15 @@ def probe_default_backend(timeout: float | None = None) -> tuple[bool, str | Non
 
 
 def _pin_platform(want: str) -> None:
-    """Pin jax_platforms even when a plugin (e.g. a TPU tunnel) has
-    force-updated the config from sitecustomize, overriding the
-    JAX_PLATFORMS env var. Without the re-pin, merely creating an array
-    initializes every configured backend — and a dead tunnel hangs the
-    process."""
+    """Best-effort re-pin of jax_platforms after a plugin (e.g. a TPU
+    tunnel) force-updated the config from sitecustomize, overriding the
+    JAX_PLATFORMS env var. NOT a hang guarantee: some plugin hooks
+    initialize their backend regardless of this config (observed in
+    round 3 — a post-pin `jax.devices("cpu")` still wedged >90s on a
+    dead tunnel). Only a fresh process with JAX_PLATFORMS=cpu set
+    before jax import is truly safe; code that must not hang should
+    avoid jax entirely (see resolve_backend) or run in an env-pinned
+    subprocess (see bench.py's supervisor)."""
     import jax
     if jax.config.jax_platforms != want:
         try:
@@ -110,23 +141,39 @@ def ensure_platform_pin() -> None:
     _requested_platform()
 
 
+def _cpu_only_pin() -> bool:
+    """True when the env pins an explicitly CPU-only platform set —
+    the one case where probing is pure waste. A pin that *mentions* a
+    device transport (e.g. the axon plugin exporting
+    JAX_PLATFORMS=axon,cpu) still needs the bounded probe: its
+    transport may be down, and in-process init would wedge."""
+    want = os.environ.get("JEPSEN_TPU_PLATFORM") \
+        or os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return False
+    return {p.strip() for p in want.split(",") if p.strip()} <= {"cpu"}
+
+
 def default_devices(min_count: int = 1, *, probe: bool = False) -> list:
-    """The analysis devices. With probe=True (benchmarks, `auto` checker
-    backends), an unpinned default backend is first health-checked in a
-    bounded subprocess; on failure we pin cpu and record the reason in
-    `devices.backend_error` instead of hanging."""
+    """The analysis devices. With probe=True (benchmarks, explicit
+    device entry points), a backend whose platform set isn't CPU-only
+    is first health-checked in a bounded subprocess; on failure we
+    raise BackendUnavailable with the reason in `devices.backend_error`
+    — we do NOT attempt an in-process CPU fallback, because a dead
+    plugin's hook can wedge even `jax.devices("cpu")` (round-3
+    finding). Callers degrade via a fresh env-pinned process or the
+    jax-free CPU oracles."""
     global backend_error
+    if probe and not _backends_already_alive() and not _cpu_only_pin():
+        ok, err = probe_default_backend()
+        if not ok:
+            backend_error = err
+            raise BackendUnavailable(err)
     import jax
 
     plat = _requested_platform()
     if plat:
         return jax.devices(plat)
-    if probe and not os.environ.get("JAX_PLATFORMS"):
-        ok, err = probe_default_backend()
-        if not ok:
-            backend_error = err
-            _pin_platform("cpu")
-            return jax.devices("cpu")
     devs = jax.devices()
     if len(devs) < min_count:
         try:
@@ -139,16 +186,42 @@ def default_devices(min_count: int = 1, *, probe: bool = False) -> list:
 
 
 def device_platform(devices: list | None = None) -> str:
-    devs = devices if devices is not None else default_devices(probe=True)
-    return devs[0].platform if devs else "none"
+    """Platform of the analysis backend, WITHOUT importing jax in this
+    process unless its backends are already initialized. Resolution
+    order: explicit devices arg -> live in-process backends -> env pin
+    string -> bounded subprocess probe (failed probe => "cpu"). This is
+    the hang-safety boundary for `auto` resolution: a dead transport
+    must yield a CPU verdict within the probe timeout, never an
+    in-process jax.devices() call that can wedge forever."""
+    if devices is not None:
+        return devices[0].platform if devices else "none"
+    if _backends_already_alive():
+        import jax
+        devs = jax.devices()
+        return devs[0].platform if devs else "none"
+    want = os.environ.get("JEPSEN_TPU_PLATFORM") \
+        or os.environ.get("JAX_PLATFORMS")
+    if want:
+        plats = [p.strip() for p in want.split(",") if p.strip()]
+        if plats and set(plats) <= {"cpu"}:
+            return "cpu"
+        # a pinned device transport (e.g. "axon,cpu") may be down:
+        # fall through to the bounded probe rather than trusting it
+    ok, err = probe_default_backend()
+    if not ok:
+        global backend_error
+        backend_error = err
+        return "cpu"
+    return _probe_platform or "cpu"
 
 
 def accelerator_available() -> bool:
     """True when a non-CPU backend is reachable — the `auto` checker
     backend resolves to the device kernels exactly when this holds.
-    Bounded: never hangs on a dead transport."""
+    Bounded by the subprocess probe timeout; resolves jax-free, so a
+    dead transport yields False instead of a wedged process."""
     try:
-        return device_platform() != "cpu"
+        return device_platform() not in ("cpu", "none")
     except Exception:
         return False
 

@@ -99,6 +99,12 @@ def sharded_check_fn(mesh: Mesh | None, shape: K.BatchShape, *,
     if use_pallas is None:
         from ..checker.elle import pallas_square
         use_pallas = mesh is None and pallas_square.pallas_available()
+    elif use_pallas and mesh is not None:
+        # the Pallas squaring path bypasses the P('dp',None,'mp')
+        # sharding constraint and would silently degrade sharded
+        # layouts; sharded dispatch always uses the XLA formulation
+        raise ValueError("use_pallas=True is single-device only: "
+                         "sharded dispatch uses the XLA closure path")
     return _sharded_check_fn_cached(mesh, shape, classify, realtime,
                                     process_order, use_pallas)
 

@@ -21,6 +21,7 @@ from .. import db as jdb
 from .. import generator as gen
 from .. import nemesis as jnemesis, os_setup
 from . import base_opts, nemesis_cycle
+from . import chronos_checker
 from .sql import resolve
 
 
@@ -32,6 +33,12 @@ class ChronosDB(jdb.DB, jdb.LogFiles):
         sess = control.current_session().su()
         sess.exec("apt-get", "install", "-y",
                   "zookeeperd", "mesos", "chronos")
+        # fresh run-log dir: stale files from a previous test on the
+        # same node would read as this test's runs (job names restart
+        # at 1), masking real misses; legacy markers likewise
+        sess.exec("rm", "-rf", JOB_DIR)
+        sess.exec("sh", "-c", "rm -f /tmp/chronos-run-*")
+        sess.exec("mkdir", "-p", JOB_DIR)
         nodes = test.get("nodes", [node])
         zk = ",".join(f"{n}:2181" for n in nodes)
         sess.exec("sh", "-c",
@@ -52,10 +59,43 @@ class ChronosDB(jdb.DB, jdb.LogFiles):
                 "/var/log/mesos/mesos-master.INFO"]
 
 
+JOB_DIR = "/tmp/chronos-test"
+
+
+def job_schedule_str(job: dict) -> str:
+    """ISO8601 repeating interval (chronos.clj:101-106):
+    R<count>/<start>/PT<interval>S."""
+    from datetime import datetime, timezone
+    start = chronos_checker.parse_time(job["start"])
+    iso = datetime.fromtimestamp(start, tz=timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
+    return f"R{job['count']}/{iso}/PT{job['interval']}S"
+
+
+def job_command(job: dict) -> str:
+    """Each run logs its job name, start and end times into a fresh
+    tempfile the final read collects (chronos.clj:108-116)."""
+    return (f"MEW=$(mktemp -p {JOB_DIR}); "
+            f"echo \"{job['name']}\" >> $MEW; "
+            "date -u -Ins >> $MEW; "
+            f"sleep {job['duration']}; "
+            "date -u -Ins >> $MEW;")
+
+
+def parse_run_file(node: str, text: str) -> dict:
+    """name / start / end lines -> a run map (chronos.clj:152-159);
+    a file with no end line is an incomplete run."""
+    lines = text.strip().split("\n")
+    return {"node": node,
+            "name": int(lines[0]) if lines and lines[0].strip() else None,
+            "start": lines[1].strip() if len(lines) > 1 else None,
+            "end": lines[2].strip() if len(lines) > 2 else None}
+
+
 class ChronosClient(jclient.Client):
-    """Schedules run-once jobs over the HTTP API; each job touches a
-    marker file the final read collects (chronos.clj's add-job! /
-    read-runs shape)."""
+    """Schedules repeating jobs over the HTTP API; each run logs a
+    marker file the final read collects and parses (chronos.clj's
+    add-job! / read-runs)."""
 
     def __init__(self, port: int = 4400, node: str | None = None,
                  timeout: float = 10.0):
@@ -66,37 +106,69 @@ class ChronosClient(jclient.Client):
     def open(self, test, node):
         return ChronosClient(self.port, node, self.timeout)
 
+    def read_runs(self, test) -> list[dict]:
+        """All runs from all nodes (chronos.clj:161-170)."""
+        runs = []
+        for n in test.get("nodes", []):
+            sess = control.session(test, n)
+            try:
+                # \036 (ASCII RS): octal escapes are POSIX printf;
+                # \x1e is a bashism dash would emit literally
+                out = sess.exec_raw(
+                    f"for f in {JOB_DIR}/*; do "
+                    "[ -f \"$f\" ] || continue; "
+                    "cat \"$f\"; printf '\\036'; done").out
+                for rec in out.split("\x1e"):
+                    if rec.strip():
+                        runs.append(parse_run_file(n, rec))
+            finally:
+                sess.disconnect()
+        return runs
+
     def invoke(self, test, op):
         crash = "fail" if op["f"] == "read" else "info"
         host, port = resolve(self.node, self.port, test or {})
         try:
-            if op["f"] == "add":
-                j = op["value"]
-                body = json.dumps({
-                    "name": f"jepsen-{j}",
-                    "command": f"touch /tmp/chronos-run-{j}",
-                    "schedule": "R1//PT10S", "epsilon": "PT30S",
-                    "owner": "jepsen@localhost",
-                }).encode()
+            if op["f"] in ("add", "add-job"):
+                if op["f"] == "add":   # legacy run-once set workload
+                    j = op["value"]
+                    body = {"name": f"jepsen-{j}",
+                            "command": f"touch /tmp/chronos-run-{j}",
+                            "schedule": "R1//PT10S", "epsilon": "PT30S"}
+                else:
+                    job = op["value"]
+                    body = {"name": str(job["name"]),
+                            "command": job_command(job),
+                            "schedule": job_schedule_str(job),
+                            "scheduleTimeZone": "UTC",
+                            "epsilon": f"PT{job['epsilon']}S",
+                            "mem": 1, "disk": 1, "cpus": 0.001,
+                            "async": False}
+                body["owner"] = "jepsen@localhost"
                 req = urllib.request.Request(
                     f"http://{host}:{port}/scheduler/iso8601",
-                    data=body, method="POST",
+                    data=json.dumps(body).encode(), method="POST",
                     headers={"Content-Type": "application/json"})
                 urllib.request.urlopen(req, timeout=self.timeout).read()
                 return {**op, "type": "ok"}
             if op["f"] == "read":
-                # collect run markers from every node over SSH
-                runs = set()
-                for n in test.get("nodes", []):
-                    sess = control.session(test, n)
-                    try:
-                        out = sess.exec_raw(
-                            "ls /tmp/ | grep chronos-run- || true").out
-                        for line in out.split():
-                            runs.add(int(line.rsplit("-", 1)[-1]))
-                    finally:
-                        sess.disconnect()
-                return {**op, "type": "ok", "value": sorted(runs)}
+                if (op.get("value") or {}) == "markers" or \
+                        test.get("workload") == "jobs":
+                    # legacy set workload: marker filenames only
+                    runs = set()
+                    for n in test.get("nodes", []):
+                        sess = control.session(test, n)
+                        try:
+                            out = sess.exec_raw(
+                                "ls /tmp/ | grep chronos-run- "
+                                "|| true").out
+                            for line in out.split():
+                                runs.add(int(line.rsplit("-", 1)[-1]))
+                        finally:
+                            sess.disconnect()
+                    return {**op, "type": "ok", "value": sorted(runs)}
+                return {**op, "type": "ok",
+                        "value": self.read_runs(test)}
             return {**op, "type": "fail", "error": f"unknown f {op['f']!r}"}
         except urllib.error.HTTPError as e:
             return {**op, "type": "fail" if 400 <= e.code < 500
@@ -115,34 +187,69 @@ def generator():
     return gen.stagger(1.0, add)
 
 
+def add_job_generator(head_start: float = 10.0):
+    """Random repeating jobs (chronos.clj:194-219): interval always
+    exceeds duration + epsilon + forgiveness so one job's runs never
+    overlap — the premise of the disjoint target windows the checker
+    matches against."""
+    import itertools
+    import random
+    import time as _time
+
+    counter = itertools.count(1)
+
+    def add(test=None, ctx=None):
+        duration = random.randint(0, 9)
+        epsilon = 10 + random.randint(0, 19)
+        interval = (1 + duration + epsilon
+                    + int(chronos_checker.EPSILON_FORGIVENESS)
+                    + random.randint(0, 29))
+        return {"type": "invoke", "f": "add-job",
+                "value": {"name": next(counter),
+                          "start": _time.time() + head_start,
+                          "count": 1 + random.randint(0, 98),
+                          "duration": duration,
+                          "epsilon": epsilon,
+                          "interval": interval}}
+
+    return gen.stagger(30.0, add)
+
+
 def final_read():
     return gen.clients(gen.until_ok(gen.repeat_gen({"f": "read"})))
 
 
 def workloads(opts: dict | None = None) -> dict:
-    return {"jobs": lambda: {
-        "generator": generator(),
-        "checker": jchecker.set_checker()}}
+    return {
+        "jobs": lambda: {
+            "generator": generator(),
+            "checker": jchecker.set_checker()},
+        "schedule": lambda: {
+            "generator": add_job_generator(),
+            "checker": chronos_checker.ChronosChecker()},
+    }
 
 
 def chronos_test(opts: dict | None = None) -> dict:
     opts = base_opts(**(opts or {}))
+    wl = opts.get("workload", "schedule")
+    spec = workloads(opts)[wl]()
     test = {
-        "name": "chronos jobs",
+        "name": f"chronos {wl}",
         "os": os_setup.debian(),
         "db": ChronosDB(),
         "client": opts.get("client") or ChronosClient(),
         "nemesis": jnemesis.partition_random_halves(),
-        "checker": jchecker.set_checker(),
+        "checker": spec["checker"],
         "generator": gen.phases(
             gen.time_limit(
                 opts.get("time-limit", 60),
-                gen.clients(generator(),
+                gen.clients(spec["generator"],
                             nemesis_cycle(
                                 opts.get("nemesis-interval", 10)))),
             gen.nemesis(gen.once({"type": "info", "f": "stop"})),
             final_read()),
-        "workload": "jobs",
+        "workload": wl,
     }
     for k, v in opts.items():
         test.setdefault(k, v)

@@ -507,6 +507,29 @@ def _pages_workload(opts: dict) -> dict:
 # (multimonotonic.clj)
 # ---------------------------------------------------------------------------
 
+def _ts_sort_key(ts):
+    """Sortable key for a read timestamp: Fauna @ts values arrive as
+    microsecond ints or decoded ISO-8601 strings. Lexicographic string
+    comparison mis-orders timestamps with differing fractional-second
+    precision ('...T10:00:00Z' vs '...T10:00:00.5Z'), so ISO strings
+    are parsed to epoch seconds; numerics are scaled to seconds too
+    (micro/milli magnitudes detected by range, post-2001 epochs), so a
+    history mixing raw and decoded forms still orders by actual time.
+    Unparseable strings sort after everything, amongst themselves."""
+    if isinstance(ts, str):
+        try:
+            from ..util import iso_to_epoch
+            return (0, iso_to_epoch(ts))
+        except ValueError:
+            return (1, ts)
+    v = float(ts)
+    if v >= 1e14:        # microseconds since epoch (>= ~2001-09)
+        v /= 1e6
+    elif v >= 1e11:      # milliseconds since epoch
+        v /= 1e3
+    return (0, v)
+
+
 class TsOrderChecker(jchecker.Checker):
     """Order reads by their read timestamp and fold a running lower
     bound per register; any read below the bound means timestamp order
@@ -516,7 +539,7 @@ class TsOrderChecker(jchecker.Checker):
         reads = [o for o in history
                  if o.get("type") == "ok" and o.get("f") == "read"
                  and (o.get("value") or {}).get("ts") is not None]
-        reads.sort(key=lambda o: o["value"]["ts"])
+        reads.sort(key=lambda o: _ts_sort_key(o["value"]["ts"]))
         inferred: dict = {}
         errs = []
         for o in reads:

@@ -8,6 +8,7 @@ loops (util.clj:359), and interval-set rendering (util.clj:548).
 
 from __future__ import annotations
 
+import re as _re
 import threading
 import time as _time
 from concurrent.futures import ThreadPoolExecutor
@@ -86,6 +87,29 @@ def relative_time_nanos() -> int:
 def sleep_nanos(dt: int) -> None:
     if dt > 0:
         _time.sleep(dt / 1e9)
+
+
+_ISO_FRAC = _re.compile(r"[.,](\d+)(?=$|[Z+\-])")
+
+
+def iso_to_epoch(s: str) -> float:
+    """ISO-8601 string -> epoch seconds, preserving FULL fractional
+    precision. datetime.fromisoformat silently truncates fractions
+    beyond 6 digits (`date -Ins` and Fauna @ts strings carry 9), which
+    collapses nanosecond-distinct timestamps onto one microsecond —
+    so the fraction is split off and re-added exactly. Comma fractions
+    (valid ISO, emitted by `date` in some locales) are handled; naive
+    strings are interpreted as LOCAL time, matching the naive producer
+    (core.py's start-time)."""
+    from datetime import datetime
+    frac = 0.0
+    m = _ISO_FRAC.search(s)
+    if m:
+        digits = m.group(1)
+        frac = int(digits) / 10 ** len(digits)
+        s = s[:m.start()] + s[m.end():]
+    s = s.replace("Z", "+00:00")
+    return datetime.fromisoformat(s).timestamp() + frac
 
 
 class RetryFailed(Exception):

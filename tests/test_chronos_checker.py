@@ -1,0 +1,217 @@
+"""Chronos interval checker (chronos/src/jepsen/chronos/checker.clj):
+targets, greedy target->run matching, verdict categories for on-time /
+late / missed / duplicate / incomplete runs, and the suite plumbing."""
+
+from __future__ import annotations
+
+import pytest
+
+from jepsen_tpu.suites import chronos, chronos_checker as cc
+
+
+def run(name, start, end="auto", duration=2.0):
+    if end == "auto":
+        end = start + duration
+    return {"name": name, "node": "n1", "start": start, "end": end}
+
+
+JOB = {"name": 1, "start": 100.0, "count": 3, "interval": 60.0,
+       "epsilon": 10.0, "duration": 2.0}
+
+
+# --------------------------------------------------------------------------
+# job_targets
+# --------------------------------------------------------------------------
+
+def test_targets_windows_and_cutoff():
+    # read at 400: finish = 400-10-2 = 388 -> targets 100, 160, 220
+    ts = cc.job_targets(400.0, JOB)
+    assert ts == [(100.0, 115.0), (160.0, 175.0), (220.0, 235.0)]
+    # window = epsilon + 5s forgiveness (checker.clj:26-28, 39-47)
+    assert ts[0][1] - ts[0][0] == JOB["epsilon"] + cc.EPSILON_FORGIVENESS
+
+
+def test_targets_respect_count_and_unstarted():
+    # count caps the schedule even for a late read
+    assert len(cc.job_targets(10_000.0, JOB)) == 3
+    # a target that could still legally start is NOT yet required:
+    # finish = 232.5-10-2 = 220.5, so target 220 barely makes the cut
+    assert len(cc.job_targets(232.5, JOB)) == 3
+    assert len(cc.job_targets(232.0, JOB)) == 2
+    assert cc.job_targets(50.0, JOB) == []
+
+
+# --------------------------------------------------------------------------
+# job_solution verdict categories
+# --------------------------------------------------------------------------
+
+def test_on_time_and_late_within_epsilon_valid():
+    runs = [run(1, 100.0),            # exactly on target
+            run(1, 169.9),            # late but within epsilon
+            run(1, 234.0)]            # inside the 5s forgiveness tail
+    s = cc.job_solution(400.0, JOB, runs)
+    assert s["valid?"] is True
+    assert all(r is not None for _, r in s["solution"])
+    assert s["extra"] == []
+
+
+def test_missed_target_invalid():
+    runs = [run(1, 100.0), run(1, 220.0)]      # second target never ran
+    s = cc.job_solution(400.0, JOB, runs)
+    assert s["valid?"] is False
+    missed = [t for t, r in s["solution"] if r is None]
+    assert missed == [(160.0, 175.0)]
+
+
+def test_too_late_run_does_not_satisfy():
+    # 176 is past 160+10+5: the run happened, but outside the window
+    s = cc.job_solution(400.0, JOB,
+                        [run(1, 100.0), run(1, 176.0), run(1, 220.0)])
+    assert s["valid?"] is False
+    assert [t for t, r in s["solution"] if r is None] == [(160.0, 175.0)]
+    assert s["extra"] == [run(1, 176.0)]
+
+
+def test_duplicate_runs_are_extra_not_reused():
+    # two runs inside the first window: one satisfies, one is extra —
+    # a single run can never satisfy two targets ($distinct)
+    runs = [run(1, 100.0), run(1, 101.0), run(1, 160.0), run(1, 220.0)]
+    s = cc.job_solution(400.0, JOB, runs)
+    assert s["valid?"] is True
+    assert s["extra"] == [run(1, 101.0)]
+
+
+def test_incomplete_runs_never_satisfy():
+    runs = [run(1, 100.0), run(1, 160.0, end=None), run(1, 220.0)]
+    s = cc.job_solution(400.0, JOB, runs)
+    assert s["valid?"] is False
+    assert s["incomplete"] == [run(1, 160.0, end=None)]
+
+
+def test_no_runs_all_targets_missed():
+    s = cc.job_solution(400.0, JOB, None)
+    assert s["valid?"] is False
+    assert all(r is None for _, r in s["solution"])
+
+
+def test_greedy_matches_overlapping_windows():
+    # Overlapping windows (interval < window width): a run that fits
+    # both targets must go to the EARLIER one so the later target can
+    # use a later run — the exchange-argument case.
+    job = {"name": 2, "start": 100.0, "count": 2, "interval": 8.0,
+           "epsilon": 10.0, "duration": 0.0}
+    # windows [100,115] and [108,123]; runs at 109 and 110 fit both
+    s = cc.job_solution(400.0, job, [run(2, 109.0), run(2, 110.0)])
+    assert s["valid?"] is True
+
+
+# --------------------------------------------------------------------------
+# multi-job solution + checker
+# --------------------------------------------------------------------------
+
+def test_solution_groups_by_name():
+    job2 = {**JOB, "name": 2, "start": 130.0}
+    runs = ([run(1, 100.0), run(1, 160.0), run(1, 220.0)]
+            + [run(2, 130.0), run(2, 190.0)])   # job2 misses 250
+    soln = cc.solution(400.0, [JOB, job2], runs)
+    assert soln["valid?"] is False
+    assert soln["jobs"][1]["valid?"] is True
+    assert soln["jobs"][2]["valid?"] is False
+
+
+def test_parse_time_formats():
+    assert cc.parse_time(5) == 5.0
+    assert cc.parse_time("1970-01-01T00:00:10+00:00") == 10.0
+    assert cc.parse_time("1970-01-01T00:00:10Z") == 10.0
+    # `date -u -Ins` comma fractions (chronos.clj:143-149)
+    assert cc.parse_time("1970-01-01T00:00:10,500000000+00:00") == 10.5
+    assert cc.parse_time(None) is None
+
+
+def test_chronos_checker_end_to_end(tmp_path):
+    from jepsen_tpu.store import Store
+    hist = [
+        {"type": "invoke", "f": "add-job", "process": 0, "time": 0,
+         "value": JOB},
+        {"type": "ok", "f": "add-job", "process": 0, "time": 1_000,
+         "value": JOB},
+        {"type": "invoke", "f": "read", "process": 1,
+         "time": int(400e9)},
+        {"type": "ok", "f": "read", "process": 1, "time": int(401e9),
+         "value": [run(1, 100.0), run(1, 161.0), run(1, 221.0)]},
+    ]
+    test = {"start-time": 0.0, "name": "chronos", "start-time-str": "t",
+            "store": Store(tmp_path / "store")}
+    res = cc.ChronosChecker().check(test, hist, {})
+    assert res["valid?"] is True
+    assert res["target-count"] == 3 and res["missed-count"] == 0
+
+    # drop the middle run: missed target, and the verdict says which
+    hist[-1] = {**hist[-1], "value": [run(1, 100.0), run(1, 221.0)]}
+    res = cc.ChronosChecker().check(test, hist, {})
+    assert res["valid?"] is False
+    assert res["missed-count"] == 1
+
+    bad_hist = [h for h in hist if h.get("f") != "read"]
+    assert cc.ChronosChecker().check(test, bad_hist, {})["valid?"] \
+        == "unknown"
+
+
+def test_plot_writes_png(tmp_path):
+    soln = cc.solution(400.0, [JOB],
+                       [run(1, 100.0), run(1, 160.0, end=None)])
+    p = tmp_path / "chronos.png"
+    cc.plot_solution(soln, 0.0, p)
+    assert p.stat().st_size > 0
+
+
+# --------------------------------------------------------------------------
+# suite plumbing
+# --------------------------------------------------------------------------
+
+def test_job_schedule_and_command_strings():
+    assert chronos.job_schedule_str(JOB) == \
+        "R3/1970-01-01T00:01:40.000Z/PT60.0S"
+    cmd = chronos.job_command(JOB)
+    assert "mktemp -p /tmp/chronos-test" in cmd
+    assert "sleep 2.0" in cmd and 'echo "1"' in cmd
+
+
+def test_parse_run_file_shapes():
+    full = chronos.parse_run_file(
+        "n2", "7\n2026-01-01T00:00:10,5+00:00\n2026-01-01T00:00:12Z\n")
+    assert full["name"] == 7 and full["node"] == "n2"
+    assert cc.parse_time(full["end"]) > cc.parse_time(full["start"])
+    partial = chronos.parse_run_file("n1", "7\n2026-01-01T00:00:10Z")
+    assert partial["end"] is None
+    assert chronos.parse_run_file("n1", "7")["start"] is None
+
+
+def test_add_job_generator_jobs_never_self_overlap():
+    g = chronos.add_job_generator()
+    # unwrap the stagger to reach the fn generator
+    from jepsen_tpu import generator as gen
+    ctx = gen.Context.for_test({"concurrency": 2})
+    seen = 0
+    for _ in range(20):
+        res = gen.op(g, {"concurrency": 2}, ctx)
+        if res is None:
+            break
+        op_, g = res
+        if op_ is gen.PENDING:
+            break
+        j = op_["value"]
+        assert j["interval"] > (j["duration"] + j["epsilon"]
+                                + cc.EPSILON_FORGIVENESS)
+        ctx = ctx.with_time(op_["time"])
+        seen += 1
+    assert seen > 0
+
+
+def test_chronos_test_default_workload_is_schedule():
+    t = chronos.chronos_test({"ssh": {"dummy": True}})
+    assert t["workload"] == "schedule"
+    assert isinstance(t["checker"], cc.ChronosChecker)
+    legacy = chronos.chronos_test({"ssh": {"dummy": True},
+                                   "workload": "jobs"})
+    assert legacy["workload"] == "jobs"

@@ -449,6 +449,46 @@ def test_analyze_store_resume_skips_verdicted_runs(tmp_path, capsys):
     assert [ln["dir"] for ln in lines] == [str(d2)]
 
 
+def test_stored_fallback_sidecar_records_validity(tmp_path, capsys):
+    """ADVICE r3: a stored-fallback run writes no results.json, so its
+    `.sweep-<checker>` sidecar must carry the verdict's validity —
+    otherwise an invalid verdict from the completed part of an
+    interrupted sweep reads as exit code 0 on --resume."""
+    from jepsen_tpu.cli import _prior_code, _stored_fallback
+    rc = _stored_fallback(tmp_path, lambda d: {"valid?": False}, "stored")
+    assert rc == 1
+    assert not (tmp_path / "results.json").exists()
+    assert _prior_code(tmp_path, "stored") == 1
+    rc = _stored_fallback(tmp_path, lambda d: {"valid?": "unknown"},
+                          "stored")
+    assert rc == 2
+    assert _prior_code(tmp_path, "stored") == 2
+    # legacy empty sidecar (pre-upgrade stores) still counts as done=ok
+    (tmp_path / ".sweep-stored").write_text("")
+    assert _prior_code(tmp_path, "stored") == 0
+    # a later sweep by a DIFFERENT checker rewrites results.json; this
+    # sweep's sidecar must still win (cross-checker masking)
+    _stored_fallback(tmp_path, lambda d: {"valid?": False}, "stored")
+    (tmp_path / "results.json").write_text(
+        json.dumps({"valid?": True, "checker": "append"}))
+    assert _prior_code(tmp_path, "stored") == 1
+    capsys.readouterr()
+
+
+def test_sharded_check_fn_rejects_pallas_on_mesh():
+    """ADVICE r3: the Pallas squaring path would silently drop the
+    dp/mp sharding constraint; an explicit use_pallas=True with a mesh
+    must be a loud error, not a degraded layout."""
+    import pytest as _pytest
+
+    from jepsen_tpu import parallel
+    from jepsen_tpu.checker.elle import synth
+    mesh = parallel.make_mesh()
+    shape = synth.synth_valid_batch(B=2, T=32, K=4, seed=0)["shape"]
+    with _pytest.raises(ValueError, match="single-device"):
+        parallel.sharded_check_fn(mesh, shape, use_pallas=True)
+
+
 def test_init_distributed_gating(monkeypatch):
     from jepsen_tpu import parallel
     monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)

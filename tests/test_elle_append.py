@@ -147,6 +147,17 @@ def test_duplicate_elements():
     assert "duplicate-elements" in r["anomaly-types"]
 
 
+def test_no_false_duplicate_across_types():
+    """ADVICE r3: Python cross-type equality (1 == True == 1.0) must
+    not conflate distinct read elements into a duplicate."""
+    hist = seq_history(
+        ([["append", "x", 1]], [["append", "x", 1]]),
+        ([["r", "x", None]], [["r", "x", [1, True]]]),
+    )
+    r = check(hist)
+    assert "duplicate-elements" not in r["anomaly-types"]
+
+
 # -- cycle anomalies (CPU oracle) -----------------------------------------
 
 def g0_history():

@@ -123,6 +123,32 @@ def test_ts_order_checker_golden():
     assert res["valid?"] is False and res["error-count"] == 1
 
 
+def test_ts_sort_key_fractional_seconds():
+    """ADVICE r3: lexicographic ISO comparison puts '...00.5Z' BEFORE
+    '...00Z' ('.' < 'Z'); the parsed key must order by actual time, so
+    mixed-precision timestamps can't fabricate ts-order errors."""
+    ts = ["2026-01-01T10:00:00.5Z", "2026-01-01T10:00:00Z",
+          "2026-01-01T10:00:01Z"]
+    assert sorted(ts) != ts[1:2] + ts[:1] + ts[2:]  # lexicographic wrong
+    assert sorted(ts, key=faunadb._ts_sort_key) == \
+        [ts[1], ts[0], ts[2]]
+    # numeric (microsecond-int) timestamps still sort
+    assert sorted([3, 1, 2], key=faunadb._ts_sort_key) == [1, 2, 3]
+    # raw microsecond ints and decoded ISO strings order by actual
+    # time when one history mixes both forms
+    mixed = [1_700_000_000_500_000, "2023-11-14T22:13:20+00:00"]
+    assert sorted(mixed, key=faunadb._ts_sort_key) == \
+        ["2023-11-14T22:13:20+00:00", 1_700_000_000_500_000]
+
+
+def test_ts_order_checker_mixed_precision_not_false_positive():
+    # value 1 at 10:00:00Z, value 2 half a second later: monotonic —
+    # but lexicographic ordering would reverse the reads and flag it
+    good = [_read_op("2026-01-01T10:00:00.5Z", {0: 2}, 1),
+            _read_op("2026-01-01T10:00:00Z", {0: 1}, 0)]
+    assert faunadb.TsOrderChecker().check({}, good, {})["valid?"] is True
+
+
 def test_read_skew_checker_golden():
     chk = faunadb.ReadSkewChecker()
     # r1 sees x=1,y=2; r2 sees x=2,y=1: each is in the other's future
