@@ -87,7 +87,9 @@ def bench_elle(n_dev: int, devices, reps: int) -> dict:
     }
     if accel and mesh is None:
         # fused Pallas squaring vs the plain XLA matmul pipeline — the
-        # headline `value` above already uses whichever is the default
+        # headline `value` above already uses whichever is the default,
+        # and `pallas_default` records which one that is so the faster
+        # formulation can be made (or kept) the default with evidence
         try:
             out["pallas_rate"] = timed(max(2, reps // 2),
                                        classify=False, use_pallas=True)
@@ -95,6 +97,8 @@ def bench_elle(n_dev: int, devices, reps: int) -> dict:
             out["pallas_rate"] = {"error": repr(e)[:200]}
         out["xla_rate"] = timed(max(2, reps // 2), classify=False,
                                 use_pallas=False)
+        from jepsen_tpu.checker.elle import pallas_square
+        out["pallas_default"] = bool(pallas_square.pallas_available())
     return out
 
 
@@ -425,12 +429,27 @@ def bench_north_star(n_dev: int, devices) -> dict:
         total = t_ingest + t_check + t_render
         rate = B / total
         target = 10_000 / 60.0 * (n_dev / 8.0)
-        # MFU from the closure FLOPs model: the detect pass squares one
-        # [T_pad, T_pad] bf16 matrix ~`rounds` times per history at
-        # 2·T³ FLOPs per squaring (assumed rounds below — the kernel
-        # early-exits at the fixpoint, measured 4-6 on this shape).
+        # MFU from MEASURED closure rounds: the detect pass squares one
+        # [T_pad, T_pad] bf16 matrix per round per history at 2·T³
+        # FLOPs; the kernel early-exits at its fixpoint, so the round
+        # count is read back from the while_loop counter on a sample of
+        # the real batch instead of assumed (VERDICT r3 weak-3).
         t_pad = K_.pad_to(T, 128)
-        rounds = float(os.environ.get("BENCH_NS_ROUNDS", 5))
+        env_rounds = os.environ.get("BENCH_NS_ROUNDS")
+        if env_rounds is not None:
+            rounds, rounds_src = float(env_rounds), "env override"
+        else:
+            try:
+                sample = encs[:min(len(encs), 32)]
+                packed = K_.pack_batch(sample)
+                sh = packed["shape"]
+                rounds = float(K_.closure_rounds_device(
+                    packed["appends"], packed["reads"],
+                    n_keys=sh.n_keys, max_pos=sh.max_pos,
+                    n_txns=sh.n_txns, steps=K_.closure_steps(sh.n_txns)))
+                rounds_src = f"measured on {len(sample)} histories"
+            except Exception as e:
+                rounds, rounds_src = 5.0, f"fallback: {e!r}"[:120]
         peak = float(os.environ.get("BENCH_PEAK_TFLOPS", 197)) * 1e12
         mfu = (B * rounds * 2 * t_pad ** 3) / (t_check * peak * n_dev) \
             if accel else None
@@ -444,9 +463,11 @@ def bench_north_star(n_dev: int, devices) -> dict:
             "check_secs": round(t_check, 3),
             "render_secs": round(t_render, 3),
             "invalid_found": n_bad,
-            "mfu_estimate": round(mfu, 4) if mfu is not None else None,
-            "mfu_model": f"{rounds:g} rounds x 2T^3 bf16, "
-                         f"peak {peak / 1e12:g} TF/chip",
+            "closure_rounds": rounds,
+            "rounds_source": rounds_src,
+            "mfu_measured": round(mfu, 4) if mfu is not None else None,
+            "mfu_model": f"{rounds:g} rounds ({rounds_src}) x 2T^3 "
+                         f"bf16, peak {peak / 1e12:g} TF/chip",
         }
     finally:
         shutil.rmtree(root, ignore_errors=True)

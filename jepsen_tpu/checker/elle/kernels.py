@@ -187,7 +187,12 @@ def _closure_batched(m: jnp.ndarray, steps: int, constrain,
     the fused Pallas kernel (pallas_square.closure_square): the
     cast/matmul/threshold pipeline stays in VMEM instead of making
     bf16/f32 round-trips through HBM. Sharded dispatches keep the XLA
-    matmul so the compiler can insert the dp/mp collectives."""
+    matmul so the compiler can insert the dp/mp collectives.
+
+    Returns (closure, rounds): the round counter is the ACTUAL number
+    of squarings executed before the fixpoint — closure_rounds_device
+    reads it back so the bench's measured MFU can never drift from
+    what this kernel really does."""
     eye = jnp.eye(m.shape[-1], dtype=bool)
     m = m | eye
 
@@ -209,9 +214,25 @@ def _closure_batched(m: jnp.ndarray, steps: int, constrain,
             m2 = constrain(m2)
         return m2, jnp.any(m2 != m), i + 1
 
-    m, _, _ = jax.lax.while_loop(
+    m, _, i = jax.lax.while_loop(
         cond, body, (m, jnp.bool_(True), jnp.int32(0)))
-    return m
+    return m, i
+
+
+@functools.partial(jax.jit, static_argnames=("n_keys", "max_pos",
+                                             "n_txns", "steps"))
+def closure_rounds_device(appends, reads, *, n_keys: int, max_pos: int,
+                          n_txns: int, steps: int) -> jnp.ndarray:
+    """How many squaring rounds the detect closure ACTUALLY executes on
+    this batch before the fixpoint — the measured input to the bench's
+    MFU number, replacing the old assumed-rounds model. Runs the SAME
+    _closure_batched loop as production and reads back its round
+    counter; one extra dispatch of the detect-mode work, bench-only."""
+    edges = jax.vmap(functools.partial(
+        _edges_one, n_keys=n_keys, max_pos=max_pos, n_txns=n_txns))
+    ww, wr, rw = edges(appends, reads)
+    _, i = _closure_batched(ww | wr | rw, steps, _identity)
+    return i
 
 
 # NOTE: an iterated-peeling cycle test (live = adj·live > 0 to fixpoint,
@@ -275,7 +296,7 @@ def classify_matrices_impl(ww, wr, rw, invoke_index, complete_index, process,
     wwr = ww | wr
     full = wwr | rw
     if not classify:
-        c_full = _closure_batched(full, steps, constrain, use_pallas)
+        c_full, _ = _closure_batched(full, steps, constrain, use_pallas)
         cycle = jnp.any(full & jnp.swapaxes(c_full, 1, 2) & nI,
                         axis=(1, 2))
         return cycle.astype(jnp.int32) << CYCLE
@@ -283,9 +304,10 @@ def classify_matrices_impl(ww, wr, rw, invoke_index, complete_index, process,
     # seeding each wider closure with the previous result is exact and
     # each seeded closure converges in the few rounds its NEW edge
     # class adds, instead of re-walking the whole graph three times.
-    c_ww = _closure_batched(ww, steps, constrain, use_pallas)
-    c_wwr = _closure_batched(c_ww | wr, steps, constrain, use_pallas)
-    c_full = _closure_batched(c_wwr | rw, steps, constrain, use_pallas)
+    c_ww, _ = _closure_batched(ww, steps, constrain, use_pallas)
+    c_wwr, _ = _closure_batched(c_ww | wr, steps, constrain, use_pallas)
+    c_full, _ = _closure_batched(c_wwr | rw, steps, constrain,
+                                 use_pallas)
     cycle = jnp.any(full & jnp.swapaxes(c_full, 1, 2) & nI, axis=(1, 2))
     cT_wwr = jnp.swapaxes(c_wwr, 1, 2)
     g0 = jnp.any(ww & jnp.swapaxes(c_ww, 1, 2) & nI, axis=(1, 2))

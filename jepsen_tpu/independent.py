@@ -96,9 +96,12 @@ def value_of(v: Any):
 
 
 def _wrap(k, res):
-    """Wrap a sub-generator op result's value into a [k v] tuple."""
+    """Wrap a sub-generator op result's value into a [k v] tuple.
+    Only client invocations are lifted: interpreter pseudo-ops
+    (sleep/log) carry scalar payloads the event loop consumes directly
+    — a lifted sleep duration would crash the worker."""
     o, g2 = res
-    if isinstance(o, dict):
+    if isinstance(o, dict) and o.get("type") in (None, "invoke"):
         o = {**o, "value": Tuple(k, o.get("value"))}
     return o, g2
 

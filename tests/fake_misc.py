@@ -569,11 +569,25 @@ class _ESHandler(BaseHTTPRequestHandler):
             srv.docs[doc_id] = json.loads(self.rfile.read(n) or b"{}")
         self._reply(201, {"result": "created"})
 
+    def do_GET(self):
+        srv = self.server.owner  # type: ignore
+        path = urlparse(self.path).path
+        parts = path.strip("/").split("/")
+        doc_id = parts[-1]
+        with srv.lock:
+            doc = srv.docs.get(doc_id)
+        if doc is None:
+            self._reply(404, {"found": False})
+        else:
+            self._reply(200, {"found": True, "_id": doc_id,
+                              "_source": doc})
+
     def do_POST(self):
         srv = self.server.owner  # type: ignore
         path = urlparse(self.path).path
         if path.endswith("/_refresh"):
-            self._reply(200, {})
+            self._reply(200, {"_shards": {"total": 10, "successful": 10,
+                                          "failed": 0}})
             return
         if path.endswith("/_search"):
             with srv.lock:

@@ -58,7 +58,7 @@ class MiniDB:
         re.I | re.S)
     _re_update = re.compile(
         r"UPDATE (\w+)\s+SET\s+(\w+)\s*=\s*(.+?)\s+WHERE\s+(\w+)\s*=\s*"
-        r"(-?\d+)\s*$", re.I)
+        r"(-?\d+)(?:\s+AND\s+\"?(\w+)\"?\s*=\s*(-?\d+))?\s*$", re.I)
 
     def execute(self, sql: str, txn: "Txn") -> tuple[list, list, str]:
         """-> (columns, rows, tag)."""
@@ -109,7 +109,8 @@ class MiniDB:
         raise SQLFail("42601", f"minidb cannot parse: {sql!r}")
 
     def _select(self, m, txn):
-        cols = [c.strip().lower() for c in m.group(1).split(",")]
+        # crate-style quoted system columns: SELECT val, "_version" ...
+        cols = [c.strip().strip('"').lower() for c in m.group(1).split(",")]
         table = m.group(2).lower()
         with txn.held():
             t = self.tables.get(table)
@@ -156,7 +157,9 @@ class MiniDB:
                             "42601", f"minidb bad upsert: {clause!r}")
                     col = sm.group(1).lower()
                     old[col] = row[col]
+                old["_version"] = old.get("_version", 0) + 1
                 return [], [], "INSERT 0 1"
+            row["_version"] = 1   # crate-style per-row version column
             t["rows"][pk] = row
             return [], [], "INSERT 0 1"
 
@@ -164,6 +167,8 @@ class MiniDB:
         table, col, expr = m.group(1).lower(), m.group(2).lower(), \
             m.group(3).strip()
         wc, wv = m.group(4).lower(), int(m.group(5))
+        wc2 = m.group(6).lower() if m.group(6) else None
+        wv2 = int(m.group(7)) if m.group(7) is not None else None
         with txn.held():
             t = self.tables.get(table)
             if t is None:
@@ -172,6 +177,8 @@ class MiniDB:
             for r in t["rows"].values():
                 if r.get(wc) != wv:
                     continue
+                if wc2 is not None and r.get(wc2) != wv2:
+                    continue   # e.g. optimistic `AND _version = ?` miss
                 em = re.match(rf"{col}\s*([+-])\s*(\d+)$", expr)
                 if em:
                     delta = int(em.group(2))
@@ -179,6 +186,7 @@ class MiniDB:
                         delta if em.group(1) == "+" else -delta)
                 else:
                     r[col] = _parse_val(expr)
+                r["_version"] = r.get("_version", 0) + 1
                 n += 1
             return [], [], f"UPDATE {n}"
 

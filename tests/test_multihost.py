@@ -1,0 +1,17 @@
+"""The DCN-join path: parallel.init_distributed + cross-process global
+arrays must execute somewhere before they ever meet real multi-host
+hardware (VERDICT r3 item 9). dryrun_multihost spawns two REAL
+processes that rendezvous through jax.distributed and run one
+dp-sharded classify step over the global mesh."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def test_two_process_multihost_dryrun():
+    import __graft_entry__ as g
+    summary = g.dryrun_multihost(2, 2)   # 2 procs x 2 devices = 4 global
+    assert summary.count("MULTIHOST_WORKER_OK") == 2
+    assert "pid=0/2" in summary and "pid=1/2" in summary
