@@ -257,8 +257,15 @@ def chronos_test(opts: dict | None = None) -> dict:
 
 
 def main(argv=None) -> int:
-    return jcli.run_cli(lambda tmap, args: chronos_test(tmap),
-                        name="chronos", argv=argv)
+    from . import resolve_workload
+    return jcli.run_cli(
+        lambda tmap, args: chronos_test(
+            {**tmap,
+             "workload": resolve_workload(args, tmap, "schedule")}),
+        name="chronos",
+        opt_fn=lambda p: p.add_argument(
+            "--workload", default=None, choices=sorted(workloads())),
+        argv=argv)
 
 
 if __name__ == "__main__":

@@ -200,6 +200,15 @@ class RWGen(gen.Generator):
     def _nodes(self, test) -> int:
         return max(1, len(test.get("nodes") or ()))
 
+    @staticmethod
+    def _node_of(ctx, p, n_nodes: int) -> int:
+        """Node index for a process: clients bind to nodes by THREAD
+        (interpreter nodes[wid % len(nodes)]), and a crashed process
+        retires to p + concurrency — so the thread, not the raw
+        process id, decides which node an op lands on."""
+        t = ctx.process_to_thread(p)
+        return t % n_nodes if isinstance(t, int) else 0
+
     def op(self, test, ctx):
         p = ctx.some_free_process()
         if p is None:
@@ -211,8 +220,8 @@ class RWGen(gen.Generator):
                  "process": p, "time": ctx.time}
         else:
             inf = self.in_flight or (0,) * n_nodes
-            n = p % n_nodes if isinstance(p, int) else 0
-            o = {"type": "invoke", "f": "read", "value": inf[n],
+            o = {"type": "invoke", "f": "read",
+                 "value": inf[self._node_of(ctx, p, n_nodes)],
                  "process": p, "time": ctx.time}
         return (o, self)
 
@@ -220,8 +229,7 @@ class RWGen(gen.Generator):
         if event.get("type") == "invoke" and event.get("f") == "write":
             n_nodes = self._nodes(test)
             inf = list(self.in_flight or (0,) * n_nodes)
-            p = event.get("process")
-            n = p % n_nodes if isinstance(p, int) else 0
+            n = self._node_of(ctx, event.get("process"), n_nodes)
             inf[n] = event["value"]
             return RWGen(self.w, self.next_write + 1, tuple(inf))
         return self
@@ -329,8 +337,14 @@ def elasticsearch_test(opts: dict | None = None) -> dict:
 
 
 def main(argv=None) -> int:
-    return jcli.run_cli(lambda tmap, args: elasticsearch_test(tmap),
-                        name="elasticsearch", argv=argv)
+    from . import resolve_workload
+    return jcli.run_cli(
+        lambda tmap, args: elasticsearch_test(
+            {**tmap, "workload": resolve_workload(args, tmap, "set")}),
+        name="elasticsearch",
+        opt_fn=lambda p: p.add_argument(
+            "--workload", default=None, choices=sorted(workloads())),
+        argv=argv)
 
 
 if __name__ == "__main__":

@@ -232,6 +232,25 @@ def test_es_rw_gen_shapes():
     assert all(isinstance(o["value"], int) for o in reads)
 
 
+def test_es_rw_gen_tracks_node_by_thread_after_crash():
+    """Crashed processes retire to p + concurrency, but clients stay
+    bound to nodes by THREAD — the in-flight vector must follow the
+    thread's node, not (raw process) % n_nodes."""
+    from jepsen_tpu import generator as gen
+    test = {"concurrency": 5, "nodes": ["n1", "n2", "n3"]}
+    g = elasticsearch.RWGen(2)
+    ctx = gen.Context.for_test(test)
+    # thread 1 crashed once: its process is now 1 + 5 = 6
+    ctx = ctx.with_worker(1, 6)
+    ev = {"type": "invoke", "f": "write", "value": 42, "process": 6,
+          "time": 0}
+    g2 = gen.update(g, test, ctx, ev)
+    # thread 1 runs on nodes[1 % 3] = n2 -> slot 1 (not 6 % 3 = 0)
+    assert g2.in_flight == (0, 42, 0)
+    # and a reader on thread 1's node chases that write
+    assert g2._node_of(ctx, 6, 3) == 1
+
+
 def test_es_dirty_read_client_ops(tmp_path):
     with FakeESServer() as srv:
         test = {"db-hosts": hosts_for(srv)}

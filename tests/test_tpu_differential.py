@@ -72,6 +72,46 @@ def test_condensed_long_history_on_device():
     assert "G1c" in flags
 
 
+def test_closure_rounds_measured_on_device():
+    """The bench's measured-MFU input: the fixpoint round counter must
+    come off the chip within the adversarial bound and reproduce."""
+    encs = [elle_synth.synth_encoded_history(1000, K=32)
+            for _ in range(4)]
+    packed = elle_kernels.pack_batch(encs)
+    sh = packed["shape"]
+    steps = elle_kernels.closure_steps(sh.n_txns)
+    r1 = int(elle_kernels.closure_rounds_device(
+        packed["appends"], packed["reads"], n_keys=sh.n_keys,
+        max_pos=sh.max_pos, n_txns=sh.n_txns, steps=steps))
+    r2 = int(elle_kernels.closure_rounds_device(
+        packed["appends"], packed["reads"], n_keys=sh.n_keys,
+        max_pos=sh.max_pos, n_txns=sh.n_txns, steps=steps))
+    assert 1 <= r1 <= steps
+    assert r1 == r2   # deterministic on the same batch
+
+
+def test_pallas_and_xla_formulations_agree_on_device():
+    """Both squaring formulations must produce identical flags on the
+    chip — the precondition for the bench's pallas-vs-xla comparison
+    (and for making either the default)."""
+    from jepsen_tpu import parallel
+    from jepsen_tpu.checker.elle import pallas_square, synth
+    if not pallas_square.pallas_available():
+        pytest.skip("pallas lowering unavailable on this backend")
+    import jax
+    import numpy as np
+    batch = synth.synth_valid_batch(B=4, T=256, K=16, seed=2)
+    batch = synth.inject_g1c(batch, np.asarray([1]), 16)
+    shape = batch["shape"]
+    args = parallel.shard_batch(None, batch)
+    f_p = parallel.sharded_check_fn(None, shape, use_pallas=True)
+    f_x = parallel.sharded_check_fn(None, shape, use_pallas=False)
+    fp = np.asarray(jax.block_until_ready(f_p(*args)))
+    fx = np.asarray(jax.block_until_ready(f_x(*args)))
+    assert fp.tolist() == fx.tolist()
+    assert fx[1] & (1 << elle_kernels.G1C)
+
+
 def test_wr_edge_batch_parity_on_device():
     def hist(txns):
         out = []
