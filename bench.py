@@ -96,7 +96,15 @@ def bench_elle(n_dev: int, devices, reps: int) -> dict:
         except Exception as e:  # lowering may fail on exotic hardware
             out["pallas_rate"] = {"error": repr(e)[:200]}
         out["xla_rate"] = timed(max(2, reps // 2), classify=False,
-                                use_pallas=False)
+                                use_pallas=False, use_int8=False)
+        # int8×int8→int32 squaring: exact for the boolean closure and
+        # ~2× the bf16 MXU throughput on v5e — if it wins on hardware,
+        # JEPSEN_TPU_CLOSURE=int8 makes it the production default
+        try:
+            out["int8_rate"] = timed(max(2, reps // 2), classify=False,
+                                     use_pallas=False, use_int8=True)
+        except Exception as e:
+            out["int8_rate"] = {"error": repr(e)[:200]}
         from jepsen_tpu.checker.elle import pallas_square
         out["pallas_default"] = bool(pallas_square.pallas_available())
     return out
@@ -412,9 +420,20 @@ def bench_north_star(n_dev: int, devices) -> dict:
         # this measures the steady state, like end_to_end.
         parallel.check_bucketed(encs, mesh, budget_cells=budget)
 
-        t0 = time.perf_counter()
-        cycles = parallel.check_bucketed(encs, mesh, budget_cells=budget)
-        t_check = time.perf_counter() - t0
+        import contextlib
+        profile_dir = os.environ.get("BENCH_PROFILE_DIR")
+        if profile_dir:
+            # opt-in xplane capture of the timed sweep: ground truth
+            # for the measured-MFU number when hardware is available
+            import jax.profiler as _prof
+            tracer = _prof.trace(profile_dir)
+        else:
+            tracer = contextlib.nullcontext()
+        with tracer:
+            t0 = time.perf_counter()
+            cycles = parallel.check_bucketed(encs, mesh,
+                                             budget_cells=budget)
+            t_check = time.perf_counter() - t0
         t0 = time.perf_counter()
         verdicts = [elle.render_verdict(e, c, prohibited)
                     for e, c in zip(encs, cycles)]

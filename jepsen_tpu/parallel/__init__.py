@@ -86,34 +86,49 @@ def init_distributed() -> bool:
 def sharded_check_fn(mesh: Mesh | None, shape: K.BatchShape, *,
                      classify: bool = True, realtime: bool = False,
                      process_order: bool = False,
-                     use_pallas: bool | None = None):
+                     use_pallas: bool | None = None,
+                     use_int8: bool | None = None):
     """Build a jitted batched checker around kernels.check_batched_impl.
     With a mesh, inputs are expected sharded over 'dp' and the closure
     matrices are constrained to P('dp', None, 'mp'); without one, it's a
     plain single-device jit whose closure squaring runs as the fused
     Pallas kernel on TPU hardware (use_pallas=None resolves that
-    automatically; benchmarks pass an explicit bool to compare the two
-    formulations). Memoized per (mesh, shape, flags) so repeated
-    same-shape dispatches (bucketed sweeps, per-key loops) compile
-    once."""
+    automatically; benchmarks pass explicit bools to compare the
+    formulations). use_int8 switches the squaring matmul to
+    int8×int8→int32 — exact for the boolean closure, ~2× MXU
+    throughput on v5e — either explicitly or via
+    JEPSEN_TPU_CLOSURE=int8 once benched on hardware. Memoized per
+    (mesh, shape, flags) so repeated same-shape dispatches (bucketed
+    sweeps, per-key loops) compile once."""
+    import os
+    env = os.environ.get("JEPSEN_TPU_CLOSURE", "")
+    if use_int8 is None:
+        # an explicit formulation request wins over the env default:
+        # use_pallas=True with JEPSEN_TPU_CLOSURE=int8 exported must
+        # still measure/run Pallas, not raise as "exclusive"
+        use_int8 = env == "int8" and not use_pallas
     if use_pallas is None:
         from ..checker.elle import pallas_square
-        use_pallas = mesh is None and pallas_square.pallas_available()
+        use_pallas = (not use_int8 and env != "bf16" and mesh is None
+                      and pallas_square.pallas_available())
     elif use_pallas and mesh is not None:
         # the Pallas squaring path bypasses the P('dp',None,'mp')
         # sharding constraint and would silently degrade sharded
         # layouts; sharded dispatch always uses the XLA formulation
         raise ValueError("use_pallas=True is single-device only: "
                          "sharded dispatch uses the XLA closure path")
+    if use_pallas and use_int8:
+        raise ValueError("use_pallas and use_int8 are exclusive")
     return _sharded_check_fn_cached(mesh, shape, classify, realtime,
-                                    process_order, use_pallas)
+                                    process_order, use_pallas, use_int8)
 
 
 @functools.lru_cache(maxsize=64)
 def _sharded_check_fn_cached(mesh: Mesh | None, shape: K.BatchShape,
                              classify: bool, realtime: bool,
                              process_order: bool,
-                             use_pallas: bool = False):
+                             use_pallas: bool = False,
+                             use_int8: bool = False):
     if mesh is not None:
         spec = P("dp", None, "mp")
 
@@ -128,7 +143,7 @@ def _sharded_check_fn_cached(mesh: Mesh | None, shape: K.BatchShape,
         K.check_batched_impl, n_keys=shape.n_keys, max_pos=shape.max_pos,
         n_txns=shape.n_txns, steps=K.closure_steps(shape.n_txns),
         classify=classify, realtime=realtime, process_order=process_order,
-        constrain=constrain, use_pallas=use_pallas)
+        constrain=constrain, use_pallas=use_pallas, use_int8=use_int8)
     if mesh is None:
         return jax.jit(f)
     in_shard = NamedSharding(mesh, P("dp"))

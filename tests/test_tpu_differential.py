@@ -112,6 +112,27 @@ def test_pallas_and_xla_formulations_agree_on_device():
     assert fx[1] & (1 << elle_kernels.G1C)
 
 
+def test_int8_formulation_agrees_on_device():
+    """int8×int8→int32 squaring must match bf16 on the real MXU — the
+    precondition for flipping JEPSEN_TPU_CLOSURE=int8 when the bench
+    shows the ~2× int8 path winning."""
+    from jepsen_tpu import parallel
+    import jax
+    import numpy as np
+    from jepsen_tpu.checker.elle import synth
+    batch = synth.synth_valid_batch(B=4, T=512, K=32, seed=6)
+    batch = synth.inject_g1c(batch, np.asarray([2]), 32)
+    shape = batch["shape"]
+    args = parallel.shard_batch(None, batch)
+    f_bf = parallel.sharded_check_fn(None, shape, use_pallas=False)
+    f_i8 = parallel.sharded_check_fn(None, shape, use_pallas=False,
+                                     use_int8=True)
+    bf = np.asarray(jax.block_until_ready(f_bf(*args)))
+    i8 = np.asarray(jax.block_until_ready(f_i8(*args)))
+    assert bf.tolist() == i8.tolist()
+    assert i8[2] & (1 << elle_kernels.G1C)
+
+
 def test_wr_edge_batch_parity_on_device():
     def hist(txns):
         out = []
