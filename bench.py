@@ -91,20 +91,27 @@ def bench_elle(n_dev: int, devices, reps: int) -> dict:
         # and `pallas_default` records which one that is so the faster
         # formulation can be made (or kept) the default with evidence
         try:
-            out["pallas_rate"] = timed(max(2, reps // 2),
-                                       classify=False, use_pallas=True)
+            out["pallas_rate"] = timed(max(2, reps // 2), classify=False,
+                                       use_pallas=True, use_int8=False)
         except Exception as e:  # lowering may fail on exotic hardware
             out["pallas_rate"] = {"error": repr(e)[:200]}
         out["xla_rate"] = timed(max(2, reps // 2), classify=False,
                                 use_pallas=False, use_int8=False)
         # int8×int8→int32 squaring: exact for the boolean closure and
-        # ~2× the bf16 MXU throughput on v5e — if it wins on hardware,
-        # JEPSEN_TPU_CLOSURE=int8 makes it the production default
+        # ~2× the bf16 MXU throughput on v5e. Fusion (pallas) and
+        # arithmetic (int8) are orthogonal; the four-way race decides
+        # which JEPSEN_TPU_CLOSURE value becomes the production default
         try:
             out["int8_rate"] = timed(max(2, reps // 2), classify=False,
                                      use_pallas=False, use_int8=True)
         except Exception as e:
             out["int8_rate"] = {"error": repr(e)[:200]}
+        try:
+            out["pallas_int8_rate"] = timed(
+                max(2, reps // 2), classify=False,
+                use_pallas=True, use_int8=True)
+        except Exception as e:
+            out["pallas_int8_rate"] = {"error": repr(e)[:200]}
         from jepsen_tpu.checker.elle import pallas_square
         out["pallas_default"] = bool(pallas_square.pallas_available())
     return out

@@ -102,6 +102,44 @@ def pack_batch(encs: list[EncodedHistory],
             "process": process, "shape": shape}
 
 
+_env_warned = False
+
+
+def resolve_formulation(use_pallas: bool | None = None,
+                        use_int8: bool | None = None, *,
+                        single_device: bool) -> tuple[bool, bool]:
+    """THE closure-formulation resolver, shared by every dispatch layer
+    (parallel.sharded_check_fn, check_encoded_batch, check_edge_batch)
+    so JEPSEN_TPU_CLOSURE reaches the production analyze-store paths,
+    not just the bench. Explicit arguments win; the env picks the
+    default: "bf16" / "int8" pin the XLA formulations, "pallas" /
+    "pallas-int8" the fused ones. Pallas needs a single-device
+    dispatch (sharded closures stay XLA for the collectives) and a
+    per-VARIANT lowering probe — an int8-specific Mosaic regression
+    degrades to the XLA matmul instead of breaking production."""
+    import os
+
+    from . import pallas_square
+    env = os.environ.get("JEPSEN_TPU_CLOSURE", "").strip()
+    if env not in ("", "bf16", "int8", "pallas", "pallas-int8"):
+        global _env_warned
+        if not _env_warned:
+            _env_warned = True
+            import logging
+            logging.getLogger(__name__).warning(
+                "unrecognized JEPSEN_TPU_CLOSURE=%r (want bf16|int8|"
+                "pallas|pallas-int8); using the auto default", env)
+        env = ""
+    if use_int8 is None:
+        use_int8 = env in ("int8", "pallas-int8")
+    if use_pallas is None:
+        if env in ("bf16", "int8") or not single_device:
+            use_pallas = False
+        else:   # "", "pallas", "pallas-int8": fuse when it lowers
+            use_pallas = pallas_square.pallas_available(int8=use_int8)
+    return bool(use_pallas), bool(use_int8)
+
+
 def closure_steps(n_txns: int) -> int:
     """Squaring rounds needed for a T-node graph: path lengths double each
     round; (A|I)^(2^s) covers all simple paths once 2^s >= T."""
@@ -179,8 +217,10 @@ def _closure_batched(m: jnp.ndarray, steps: int, constrain,
     default, or int8×int8→int32 with use_int8: the MXU's int8 path has
     ~2× the bf16 throughput on v5e (399 TOPS vs 197 TFLOPS) and the
     boolean closure is exact in either (non-negative terms, int32
-    accumulation never overflows below T=2^31; the bench races the two
-    and the winner should become the dispatch default on hardware).
+    accumulation never overflows below T=2^31). use_pallas composes
+    with use_int8 (fusion × arithmetic); the bench races all four
+    formulations and JEPSEN_TPU_CLOSURE (via resolve_formulation)
+    flips the dispatch default once hardware numbers justify it.
 
     Runs to the fixpoint, not a fixed count: path lengths double each
     round, so convergence takes ~log2(graph diameter) rounds — for real
@@ -211,7 +251,7 @@ def _closure_batched(m: jnp.ndarray, steps: int, constrain,
         if use_pallas:
             from . import pallas_square
             m2 = pallas_square.closure_square(
-                m, interpret=pallas_square.INTERPRET)
+                m, interpret=pallas_square.INTERPRET, int8=use_int8)
         elif use_int8:
             mb = constrain(m.astype(jnp.int8))
             m2 = jax.lax.dot_general(
@@ -440,12 +480,12 @@ def check_edge_batch(per_history: list[dict], realtime: bool = False,
     else:
         args = [jax.device_put(p[k], devices[0] if devices else None)
                 for k in names]
-    from . import pallas_square
+    use_pallas, use_int8 = resolve_formulation(
+        single_device=len(devices) == 1)
     flags = classify_matrices_device(
         *args, steps=closure_steps(p["T"]), classify=classify,
         realtime=realtime, process_order=process_order,
-        use_pallas=(len(devices) == 1
-                    and pallas_square.pallas_available()))
+        use_pallas=use_pallas, use_int8=use_int8)
     return [flags_to_names(int(w)) for w in np.asarray(flags)[:n]]
 
 
@@ -519,11 +559,11 @@ def check_encoded_batch(encs: list[EncodedHistory],
             mesh, jax.sharding.PartitionSpec("dp"))
         args = [jax.device_put(a, sharding) for a in args]
 
-    from . import pallas_square
+    use_pallas, use_int8 = resolve_formulation(
+        single_device=len(devices) == 1)
     flags = check_batch_device(
         *args, n_keys=shape.n_keys, max_pos=shape.max_pos,
         n_txns=shape.n_txns, steps=closure_steps(shape.n_txns),
         classify=classify, realtime=realtime, process_order=process_order,
-        use_pallas=(len(devices) == 1
-                    and pallas_square.pallas_available()))
+        use_pallas=use_pallas, use_int8=use_int8)
     return [flags_to_names(int(w)) for w in np.asarray(flags)[:n]]

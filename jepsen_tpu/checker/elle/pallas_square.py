@@ -42,26 +42,32 @@ except ImportError:  # pragma: no cover
 INTERPRET = False
 
 
-def _square_kernel(a_ref, b_ref, out_ref, acc_ref):
+def _square_kernel(a_ref, b_ref, out_ref, acc_ref, *, dot_dtype):
     @pl.when(pl.program_id(3) == 0)
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    a = a_ref[0].astype(jnp.bfloat16)
-    b = b_ref[0].astype(jnp.bfloat16)
+    a = a_ref[0].astype(dot_dtype)
+    b = b_ref[0].astype(dot_dtype)
     acc_ref[...] += jax.lax.dot_general(
         a, b, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        preferred_element_type=acc_ref.dtype)
 
     @pl.when(pl.program_id(3) == pl.num_programs(3) - 1)
     def _emit():
-        out_ref[0] = acc_ref[...] > 0.0
+        out_ref[0] = acc_ref[...] > 0
 
 
-@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+@functools.partial(jax.jit, static_argnames=("tile", "interpret",
+                                             "int8"))
 def closure_square(m: jnp.ndarray, *, tile: int = 256,
-                   interpret: bool = False) -> jnp.ndarray:
-    """One closure round: (bf16(m) @ bf16(m)) > 0 for m [B, T, T] bool.
+                   interpret: bool = False,
+                   int8: bool = False) -> jnp.ndarray:
+    """One closure round: (cast(m) @ cast(m)) > 0 for m [B, T, T] bool —
+    bf16 dots accumulated in f32 by default, or int8 dots accumulated
+    in int32 (exact for boolean operands, ~2× MXU throughput on v5e):
+    the fusion (VMEM residency) and the arithmetic (int8) are
+    orthogonal levers, and this kernel stacks them.
 
     `tile` shrinks to T when T < tile; T must divide evenly by the
     effective tile (guaranteed by the 128-padding in the encoders)."""
@@ -80,8 +86,10 @@ def closure_square(m: jnp.ndarray, *, tile: int = 256,
                                      "arbitrary"))
         except Exception:  # older API spellings: let the compiler infer
             pass
+    dot_dtype = jnp.int8 if int8 else jnp.bfloat16
+    acc_dtype = jnp.int32 if int8 else jnp.float32
     return pl.pallas_call(
-        _square_kernel,
+        functools.partial(_square_kernel, dot_dtype=dot_dtype),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, t, t), lambda b, i, j, k: (b, i, k)),
@@ -90,7 +98,7 @@ def closure_square(m: jnp.ndarray, *, tile: int = 256,
         out_specs=pl.BlockSpec((1, t, t), lambda b, i, j, k: (b, i, j)),
         out_shape=jax.ShapeDtypeStruct((B, T, T), jnp.bool_),
         scratch_shapes=[
-            (pltpu.VMEM((t, t), jnp.float32) if pltpu is not None
+            (pltpu.VMEM((t, t), acc_dtype) if pltpu is not None
              else pl.pallas_core.MemorySpace.ANY)  # pragma: no cover
         ],
         cost_estimate=pl.CostEstimate(
@@ -102,40 +110,42 @@ def closure_square(m: jnp.ndarray, *, tile: int = 256,
     )(m, m)
 
 
-_works: bool | None = None
+_works: dict[bool, bool] = {}
 
 
-def pallas_available() -> bool:
-    """True when the current default device is a real TPU AND this
-    kernel actually compiles on it (verified once per process with a
-    tiny probe input, so a lowering regression degrades the analysis
-    path to the XLA matmul instead of breaking it). Interpret mode is
-    for tests; running it in production on CPU would be slower than
-    the XLA matmul."""
-    global _works
-    if _works is not None:
-        return _works
+def pallas_available(int8: bool = False) -> bool:
+    """True when the current default device is a real TPU AND the
+    requested kernel variant actually compiles on it (verified once
+    per process per variant with a tiny probe input, so a lowering
+    regression — bf16 OR int8-specific — degrades the analysis path to
+    the XLA matmul instead of breaking it). Interpret mode is for
+    tests; running it in production on CPU would be slower than the
+    XLA matmul."""
+    cached = _works.get(int8)
+    if cached is not None:
+        return cached
     try:
         from ...devices import default_devices
         d = default_devices()[0]
         if getattr(d, "platform", "") not in ("tpu", "axon"):
-            _works = False
+            _works[int8] = False
             return False
         import numpy as np
         # 256 is divisible by both effective tiles, so this lowers the
         # same tile=256 configuration the production shapes use
         m = jnp.asarray(np.eye(256, dtype=bool)[None])
-        out = np.asarray(closure_square(m))
-        _works = bool((out == np.eye(256, dtype=bool)[None]).all())
-        if not _works:
+        out = np.asarray(closure_square(m, int8=int8))
+        ok = bool((out == np.eye(256, dtype=bool)[None]).all())
+        _works[int8] = ok
+        if not ok:
             import logging
             logging.getLogger(__name__).warning(
-                "pallas closure kernel MISCOMPUTED its probe; using "
-                "the XLA matmul path")
+                "pallas closure kernel (int8=%s) MISCOMPUTED its "
+                "probe; using the XLA matmul path", int8)
     except Exception:  # pragma: no cover - hardware-specific
         import logging
         logging.getLogger(__name__).warning(
-            "pallas closure kernel failed its probe; using the XLA "
-            "matmul path", exc_info=True)
-        _works = False
-    return _works
+            "pallas closure kernel (int8=%s) failed its probe; using "
+            "the XLA matmul path", int8, exc_info=True)
+        _works[int8] = False
+    return _works[int8]

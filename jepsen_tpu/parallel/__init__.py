@@ -94,31 +94,24 @@ def sharded_check_fn(mesh: Mesh | None, shape: K.BatchShape, *,
     plain single-device jit whose closure squaring runs as the fused
     Pallas kernel on TPU hardware (use_pallas=None resolves that
     automatically; benchmarks pass explicit bools to compare the
-    formulations). use_int8 switches the squaring matmul to
+    formulations). use_int8 switches the squaring dots to
     int8×int8→int32 — exact for the boolean closure, ~2× MXU
-    throughput on v5e — either explicitly or via
-    JEPSEN_TPU_CLOSURE=int8 once benched on hardware. Memoized per
-    (mesh, shape, flags) so repeated same-shape dispatches (bucketed
-    sweeps, per-key loops) compile once."""
-    import os
-    env = os.environ.get("JEPSEN_TPU_CLOSURE", "")
-    if use_int8 is None:
-        # an explicit formulation request wins over the env default:
-        # use_pallas=True with JEPSEN_TPU_CLOSURE=int8 exported must
-        # still measure/run Pallas, not raise as "exclusive"
-        use_int8 = env == "int8" and not use_pallas
-    if use_pallas is None:
-        from ..checker.elle import pallas_square
-        use_pallas = (not use_int8 and env != "bf16" and mesh is None
-                      and pallas_square.pallas_available())
-    elif use_pallas and mesh is not None:
+    throughput on v5e — and composes with use_pallas (the VMEM fusion
+    and the arithmetic are orthogonal levers). The production default
+    flips via JEPSEN_TPU_CLOSURE once benched on hardware: "bf16" /
+    "int8" pin the XLA formulations, "pallas" / "pallas-int8" the
+    fused ones (mesh dispatches always stay XLA so the compiler can
+    insert collectives). Explicit arguments win over the env. Memoized
+    per (mesh, shape, flags) so repeated same-shape dispatches
+    (bucketed sweeps, per-key loops) compile once."""
+    if use_pallas and mesh is not None:
         # the Pallas squaring path bypasses the P('dp',None,'mp')
         # sharding constraint and would silently degrade sharded
         # layouts; sharded dispatch always uses the XLA formulation
         raise ValueError("use_pallas=True is single-device only: "
                          "sharded dispatch uses the XLA closure path")
-    if use_pallas and use_int8:
-        raise ValueError("use_pallas and use_int8 are exclusive")
+    use_pallas, use_int8 = K.resolve_formulation(
+        use_pallas, use_int8, single_device=mesh is None)
     return _sharded_check_fn_cached(mesh, shape, classify, realtime,
                                     process_order, use_pallas, use_int8)
 

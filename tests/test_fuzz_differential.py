@@ -81,6 +81,36 @@ def test_fuzz_append_parity():
                 (t["valid?"], sorted(t["anomaly-types"])), (trial, rt, po)
 
 
+def test_fuzz_int8_closure_parity():
+    """The int8 squaring must agree with bf16 (and so with the CPU
+    oracle) on randomly corrupted batches — the exactness argument
+    (non-negative terms, int32 accumulation) fuzz-checked end to end."""
+    import numpy as np
+
+    from jepsen_tpu.checker.elle import encode as elle_encode
+    from jepsen_tpu.checker.elle import kernels as K
+    rng = random.Random(31)
+    for trial in range(TRIALS):
+        hists = [rand_append_history(rng, rng.choice([30, 120]),
+                                     rng.choice([2, 8]),
+                                     rng.choice([1, 5]))
+                 for _ in range(3)]
+        encs = [elle_encode.encode_history(h) for h in hists]
+        packed = K.pack_batch(encs)
+        sh = packed["shape"]
+        names = ("appends", "reads", "invoke_index", "complete_index",
+                 "process", "n_txns")
+        args = tuple(packed[k] for k in names)
+        kw = dict(n_keys=sh.n_keys, max_pos=sh.max_pos,
+                  n_txns=sh.n_txns, steps=K.closure_steps(sh.n_txns))
+        for classify in (False, True):
+            bf16 = np.asarray(K.check_batch_device(
+                *args, classify=classify, use_int8=False, **kw))
+            i8 = np.asarray(K.check_batch_device(
+                *args, classify=classify, use_int8=True, **kw))
+            assert bf16.tolist() == i8.tolist(), (trial, classify)
+
+
 def test_fuzz_wr_parity():
     rng = random.Random(77)
     for trial in range(TRIALS):

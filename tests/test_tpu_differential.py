@@ -104,11 +104,16 @@ def test_pallas_and_xla_formulations_agree_on_device():
     batch = synth.inject_g1c(batch, np.asarray([1]), 16)
     shape = batch["shape"]
     args = parallel.shard_batch(None, batch)
-    f_p = parallel.sharded_check_fn(None, shape, use_pallas=True)
-    f_x = parallel.sharded_check_fn(None, shape, use_pallas=False)
+    f_p = parallel.sharded_check_fn(None, shape, use_pallas=True,
+                                    use_int8=False)
+    f_x = parallel.sharded_check_fn(None, shape, use_pallas=False,
+                                    use_int8=False)
+    f_p8 = parallel.sharded_check_fn(None, shape, use_pallas=True,
+                                     use_int8=True)
     fp = np.asarray(jax.block_until_ready(f_p(*args)))
     fx = np.asarray(jax.block_until_ready(f_x(*args)))
-    assert fp.tolist() == fx.tolist()
+    fp8 = np.asarray(jax.block_until_ready(f_p8(*args)))
+    assert fp.tolist() == fx.tolist() == fp8.tolist()
     assert fx[1] & (1 << elle_kernels.G1C)
 
 
