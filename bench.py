@@ -112,8 +112,17 @@ def bench_elle(n_dev: int, devices, reps: int) -> dict:
                 use_pallas=True, use_int8=True)
         except Exception as e:
             out["pallas_int8_rate"] = {"error": repr(e)[:200]}
+        from jepsen_tpu.checker.elle import kernels as K_
         from jepsen_tpu.checker.elle import pallas_square
-        out["pallas_default"] = bool(pallas_square.pallas_available())
+        # which formulation the headline actually ran, plus each Pallas
+        # variant's lowering verdict (a variant can regress separately)
+        d_pallas, d_int8 = K_.resolve_formulation(single_device=True)
+        out["default_formulation"] = (
+            ("pallas" if d_pallas else "xla")
+            + ("-int8" if d_int8 else "-bf16"))
+        out["pallas_lowers"] = {
+            "bf16": bool(pallas_square.pallas_available()),
+            "int8": bool(pallas_square.pallas_available(int8=True))}
     return out
 
 

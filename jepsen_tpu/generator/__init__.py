@@ -33,6 +33,7 @@ from __future__ import annotations
 import inspect
 import logging
 import random
+import weakref
 from typing import Any, Callable, Iterable
 
 log = logging.getLogger(__name__)
@@ -148,14 +149,30 @@ class Generator:
         return self
 
 
+_fn_arity = weakref.WeakKeyDictionary()
+
+
 def _call_fn(f: Callable, test: dict, ctx: Context):
+    """Call an fn generator with (test, ctx) or no args, whichever its
+    signature wants. The arity is memoized per function object — this
+    sits in the interpreter's per-op hot loop (pure.clj:66-70's
+    >20k ops/sec figure), and inspect.signature costs more than the
+    whole rest of an op step."""
     try:
-        sig = inspect.signature(f)
-        nargs = len([p for p in sig.parameters.values()
-                     if p.default is p.empty and
-                     p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)])
-    except (TypeError, ValueError):
-        nargs = 0
+        nargs = _fn_arity[f]
+    except (KeyError, TypeError):   # TypeError: non-weakrefable callable
+        try:
+            sig = inspect.signature(f)
+            nargs = len([p for p in sig.parameters.values()
+                         if p.default is p.empty and
+                         p.kind in (p.POSITIONAL_ONLY,
+                                    p.POSITIONAL_OR_KEYWORD)])
+        except (TypeError, ValueError):
+            nargs = 0
+        try:
+            _fn_arity[f] = nargs
+        except TypeError:
+            pass
     return f(test, ctx) if nargs == 2 else f()
 
 
@@ -588,12 +605,27 @@ class Mix(Generator):
         self.gens = list(gens)
         self.i = random.randrange(len(gens)) if i is None and gens else (i or 0)
 
+    @classmethod
+    def _share(cls, gens: list) -> "Mix":
+        """A re-rolled Mix over an UNCHANGED gens list, skipping the
+        defensive copy — the single alternate construction path for
+        the per-op fast path below (keep in sync with __init__)."""
+        nxt = cls.__new__(cls)
+        nxt.gens = gens
+        nxt.i = random.randrange(len(gens))
+        return nxt
+
     def op(self, test, ctx):
         if not self.gens:
             return None
         res = op(self.gens[self.i], test, ctx)
         if res is not None:
             o, g2 = res
+            if g2 is self.gens[self.i]:
+                # unchanged sub-generator (Repeat/dict/Limit-PENDING):
+                # share the gens list and only re-roll the choice —
+                # the per-op hot path of every mix-of-repeats workload
+                return (o, Mix._share(self.gens))
             gens = list(self.gens)
             gens[self.i] = g2
             return (o, Mix(gens))
@@ -620,11 +652,14 @@ class Limit(Generator):
         if res is None:
             return None
         o, g2 = res
+        if o is PENDING and g2 is self.gen:
+            return (o, self)    # no-op step: nothing changed
         n = self.remaining if o is PENDING else self.remaining - 1
         return (o, Limit(n, g2))
 
     def update(self, test, ctx, event):
-        return Limit(self.remaining, update(self.gen, test, ctx, event))
+        g2 = update(self.gen, test, ctx, event)
+        return self if g2 is self.gen else Limit(self.remaining, g2)
 
 
 def limit(n, gen):
@@ -655,11 +690,13 @@ class Repeat(Generator):
         if res is None:
             return None
         o, _ = res
-        n = self.remaining if o is PENDING else self.remaining - 1
-        return (o, Repeat(n, self.gen))
+        if self.remaining < 0 or o is PENDING:
+            return (o, self)    # forever / no-op step: nothing changed
+        return (o, Repeat(self.remaining - 1, self.gen))
 
     def update(self, test, ctx, event):
-        return Repeat(self.remaining, update(self.gen, test, ctx, event))
+        g2 = update(self.gen, test, ctx, event)
+        return self if g2 is self.gen else Repeat(self.remaining, g2)
 
 
 def repeat_gen(gen, n: int = -1):
