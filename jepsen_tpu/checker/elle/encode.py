@@ -166,18 +166,20 @@ def encode_history(history: list[dict]) -> EncodedHistory:
     # --- graph txn rows: committed first, then indeterminate -------------
     rows: list[dict] = []   # row facts
     for inv, comp in committed:
-        rows.append({"txn": t.mops(comp), "status": OK, "inv": inv,
-                     "op": comp})
+        txn = t.mops(comp)
+        rows.append({"txn": txn, "status": OK, "inv": inv,
+                     "op": comp, "wbk": t.writes_by_key(txn)})
     for inv in indeterminate:
-        rows.append({"txn": t.mops(inv), "status": INFO, "inv": inv,
-                     "op": inv})
+        txn = t.mops(inv)
+        rows.append({"txn": txn, "status": INFO, "inv": inv,
+                     "op": inv, "wbk": t.writes_by_key(txn)})
     enc.n = len(rows)
 
     # --- writer index: (key, value) -> row --------------------------------
     writer_of: dict = {}
     multi_append: set = set()
     for r_i, row in enumerate(rows):
-        for k, vals in t.writes_by_key(row["txn"]).items():
+        for k, vals in row["wbk"].items():
             for v in vals:
                 if (k, v) in writer_of:
                     _note(anomalies, "duplicate-appends",
@@ -200,17 +202,19 @@ def encode_history(history: list[dict]) -> EncodedHistory:
         for mf, k, v in row["txn"]:
             if mf == "r" and v is not None:
                 reads_by_key.setdefault(k, []).append((row["op"], v))
-                # duplicate elements inside one read. Hash (type, v)
-                # pairs: Python's cross-type equality would conflate
-                # 1 == True == 1.0 into one element and flag a
-                # legitimate [1, True] read; repr stays the fallback
-                # for unhashables.
+                # duplicate elements inside one read. The C-speed
+                # set(vals) screen is exact for the non-dup case; a
+                # suspected dup re-checks with (type, v) pairs so
+                # Python's cross-type equality (1 == True == 1.0)
+                # can't flag a legitimate [1, True] read. repr stays
+                # the fallback for unhashables.
                 vals = list(v)
                 try:
-                    uniq = len({(type(x), x) for x in vals})
+                    dup = len(vals) != len(set(vals)) and \
+                        len(vals) != len({(type(x), x) for x in vals})
                 except TypeError:
-                    uniq = len(set(map(repr, vals)))
-                if len(vals) != uniq:
+                    dup = len(vals) != len(set(map(repr, vals)))
+                if dup:
                     _note(anomalies, "duplicate-elements",
                           {"key": k, "value": vals, "op": row["op"]})
 
@@ -246,7 +250,7 @@ def encode_history(history: list[dict]) -> EncodedHistory:
     # txn's read ending there observed a state that "never existed".
     intermediate: set = set()
     for row_i, row in enumerate(rows):
-        for k, vals in t.writes_by_key(row["txn"]).items():
+        for k, vals in row["wbk"].items():
             for v in vals[:-1]:
                 intermediate.add((k, v, row_i))
 
@@ -254,7 +258,7 @@ def encode_history(history: list[dict]) -> EncodedHistory:
     appends: list[tuple] = []
     reads: list[tuple] = []
     for r_i, row in enumerate(rows):
-        for k, vals in t.writes_by_key(row["txn"]).items():
+        for k, vals in row["wbk"].items():
             for v in vals:
                 pos = version_pos.get((k, v), -1)
                 if (k, v) in multi_append:

@@ -315,78 +315,117 @@ def analyze_store(store: Store, checker: str = "append",
     # back to their own stored checker host-side. Ingest shards run
     # dirs across a process pool (ingest.py, SURVEY.md §5.7).
     from . import ingest
-    encs, mapping, fallback = [], [], []
-    for d, enc in zip(run_dirs,
-                      ingest.parallel_encode(run_dirs, checker=checker)):
+
+    def encodable(d, enc, fallback: list) -> bool:
+        """Shared triage: exceptions and txn-less histories route to
+        the run's own stored checker."""
         if isinstance(enc, Exception):
             log.info("run %s not encodable as %s (%r); using stored "
                      "checker", d, checker, enc)
             fallback.append(d)
-        elif enc.n == 0:  # no txn ops at all: not a txn workload
+            return False
+        if enc.n == 0:   # no txn ops at all: not a txn workload
             fallback.append(d)
-        else:
-            encs.append(enc)
-            mapping.append(d)
+            return False
+        return True
 
-    if encs:
-        if checker == "append":
-            mesh = None
-            try:
-                mesh = parallel.make_mesh()
-            except Exception:
-                pass
-            # Histories too long for the dense [T,T] closure route
-            # through SCC condensation (the 100k-op path); the rest
-            # sweep the device in length buckets.
-            dense, dense_map, huge, huge_map = [], [], [], []
-            for d, enc in zip(mapping, encs):
+    if checker == "append":
+        if not host_only:
+            from . import devices as devmod
+            if devmod.accelerator_available():   # probe-bounded, jax-free
+                # overlap pays even on a single-core host when a real
+                # device runs the checks: the worker parses while the
+                # parent blocks on the accelerator
+                _os.environ.setdefault("JEPSEN_TPU_PIPELINE", "1")
+        # Mesh built lazily on the FIRST dense dispatch: an
+        # all-fallback store (non-txn workloads) must never pay — or
+        # hang in — device init it doesn't need.
+        mesh_box: list = []
+
+        def get_mesh():
+            if not mesh_box:
+                try:
+                    mesh_box.append(parallel.make_mesh())
+                except Exception:
+                    mesh_box.append(None)
+            return mesh_box[0]
+
+        # The checker class's own defaults, so batch verdicts match
+        # single-run verdicts for the same history.
+        prohibited = elle.AppendChecker().prohibited
+        cycles_by_dir: dict = {}
+        encs, mapping, fallback, huge, huge_map = [], [], [], [], []
+        # Streaming ingest/check pipeline: each chunk's device sweep
+        # overlaps the pool workers' parsing of the NEXT chunk, so
+        # device time hides under ingest on stores big enough to
+        # matter (SURVEY.md §5.7; the bench's north-star block uses
+        # the same loop).
+        for chunk in ingest.iter_encode_chunks(run_dirs,
+                                               checker=checker):
+            dense, dense_map = [], []
+            for d, enc in chunk:
+                if not encodable(d, enc, fallback):
+                    continue
+                encs.append(enc)
+                mapping.append(d)
                 if enc.n > parallel.DENSE_TXN_LIMIT:
+                    # too long for the dense [T,T] closure: SCC
+                    # condensation (the 100k-op path), after the sweep
                     huge.append(enc)
                     huge_map.append(d)
+                elif host_only:
+                    cycles_by_dir[d] = elle.cycle_anomalies_cpu(enc)
                 else:
                     dense.append(enc)
                     dense_map.append(d)
-            # The checker class's own defaults, so batch verdicts match
-            # single-run verdicts for the same history.
-            prohibited = elle.AppendChecker().prohibited
-            cycles_by_dir: dict = {}
-            if host_only:
-                for d, enc in zip(mapping, encs):
-                    cycles_by_dir[d] = elle.cycle_anomalies_cpu(enc)
-                dense = huge = []
             if dense:
                 for d, cycles in zip(dense_map,
-                                     parallel.check_bucketed(dense,
-                                                             mesh)):
+                                     parallel.check_bucketed(
+                                         dense, get_mesh())):
                     cycles_by_dir[d] = cycles
-            for d, enc in zip(huge_map, huge):
-                # mesh=None: these are all past the dense limit, so
-                # check_long_history goes host-condensation; None just
-                # lets the per-SCC classify stage use default_devices()
-                # (the dp batch mesh would be wrong for B=1 anyway)
-                cycles_by_dir[d] = parallel.check_long_history(
-                    enc, None, dense_limit=parallel.DENSE_TXN_LIMIT)
-            # one emit loop, in the original (sorted run-dir) order
-            for d, enc in zip(mapping, encs):
-                res = elle.render_verdict(enc, cycles_by_dir[d],
-                                          prohibited)
-                res["checker"] = "append"   # --resume marker
-                worst = max(worst, emit(d, res))
-        else:  # wr: edge lists host-built; bucketed device dispatches
+        for d, enc in zip(huge_map, huge):
             if host_only:
-                # wr encodings carry prebuilt edges; the wr module's
-                # own host analyzer consumes them (the append-side
-                # cycle_anomalies_cpu would look for .appends)
-                cycles_per_run = [elle_wr.cycle_anomalies_cpu(e)
-                                  for e in encs]
-            else:
-                cycles_per_run = elle_kernels.check_edge_batch_bucketed(
-                    [elle_wr.to_edge_dict(e) for e in encs])
-            prohibited = elle_wr.WrChecker().prohibited
-            for d, enc, cycles in zip(mapping, encs, cycles_per_run):
-                res = elle_wr.render_wr_verdict(enc, cycles, prohibited)
-                res["checker"] = "wr"       # --resume marker
-                worst = max(worst, emit(d, res))
+                cycles_by_dir[d] = elle.cycle_anomalies_cpu(enc)
+                continue
+            # mesh=None: these are all past the dense limit, so
+            # check_long_history goes host-condensation; None just
+            # lets the per-SCC classify stage use default_devices()
+            # (the dp batch mesh would be wrong for B=1 anyway)
+            cycles_by_dir[d] = parallel.check_long_history(
+                enc, None, dense_limit=parallel.DENSE_TXN_LIMIT)
+        # one emit loop, in the original (sorted run-dir) order
+        for d, enc in zip(mapping, encs):
+            res = elle.render_verdict(enc, cycles_by_dir[d],
+                                      prohibited)
+            res["checker"] = "append"   # --resume marker
+            worst = max(worst, emit(d, res))
+        for d in fallback:
+            worst = max(worst, _stored_fallback(d, stored_check,
+                                                checker))
+        return worst
+
+    encs, mapping, fallback = [], [], []
+    for d, enc in zip(run_dirs,
+                      ingest.parallel_encode(run_dirs, checker=checker)):
+        if encodable(d, enc, fallback):
+            encs.append(enc)
+            mapping.append(d)
+
+    if encs:  # wr: edge lists host-built; bucketed device dispatches
+        if host_only:
+            # wr encodings carry prebuilt edges; the wr module's
+            # own host analyzer consumes them (the append-side
+            # cycle_anomalies_cpu would look for .appends)
+            cycles_per_run = [elle_wr.cycle_anomalies_cpu(e)
+                              for e in encs]
+        else:
+            cycles_per_run = elle_kernels.check_edge_batch_bucketed(
+                [elle_wr.to_edge_dict(e) for e in encs])
+        prohibited = elle_wr.WrChecker().prohibited
+        for d, enc, cycles in zip(mapping, encs, cycles_per_run):
+            res = elle_wr.render_wr_verdict(enc, cycles, prohibited)
+            res["checker"] = "wr"       # --resume marker
+            worst = max(worst, emit(d, res))
 
     for d in fallback:
         worst = max(worst, _stored_fallback(d, stored_check, checker))

@@ -67,13 +67,28 @@ def _load_worker(run_dir):
         return e
 
 
+def _spawn_safe() -> bool:
+    """Can a spawn-context worker actually boot? spawn re-imports
+    __main__; when __main__ has no importable file (stdin scripts, a
+    REPL, embedded interpreters) every worker dies during bootstrap
+    and the pool respawns replacements forever — the parent then hangs
+    in imap instead of falling back. Detect that case up front."""
+    import sys
+    m = sys.modules.get("__main__")
+    f = getattr(m, "__file__", None)
+    if f is None:
+        # `python -m pkg.mod` has a spec instead of a file: fine
+        return getattr(m, "__spec__", None) is not None
+    return os.path.exists(f)
+
+
 def _pool_map(worker, items: list, processes: int | None) -> list:
     """Shared process-pool recipe: spawned workers (the parent usually
     holds live device runtimes), per-item exceptions returned not
     raised, serial fallback on pool failure."""
     if processes is None:
         processes = min(len(items), os.cpu_count() or 1)
-    if processes <= 1 or len(items) <= 1:
+    if processes <= 1 or len(items) <= 1 or not _spawn_safe():
         return [worker(it) for it in items]
     ctx = mp.get_context("spawn")
     try:
@@ -106,3 +121,61 @@ def parallel_encode(run_dirs: Sequence[str | os.PathLike],
     processes=0 forces the serial path."""
     return _pool_map(_worker, [(d, checker) for d in run_dirs],
                      processes)
+
+
+def iter_encode_chunks(run_dirs: Sequence[str | os.PathLike],
+                       checker: str = "append", chunk: int = 64,
+                       processes: int | None = None,
+                       info: dict | None = None):
+    """Yield (run_dir, encoding) pairs in chunks, IN ORDER, while later
+    run dirs keep encoding in background workers — so a caller that
+    dispatches each chunk to the accelerator overlaps device compute
+    with host parsing (the analyze-store sweep's ingest/check
+    pipeline). Encodings are EncodedHistory/WrEncoded or the per-run
+    Exception, exactly as parallel_encode.
+
+    On a single-core host a pool is still worth one worker when a REAL
+    accelerator runs the checks (the worker parses while the parent
+    blocks on the device); without one, pooling 1 core is pure
+    serialization overhead, so the serial path is used unless
+    JEPSEN_TPU_PIPELINE=1 forces it.
+
+    `info`, when given, gets info["pooled"] set to whether background
+    workers actually ran — callers reporting overlap numbers must not
+    claim pipelining for the strictly serial path."""
+    dirs = list(run_dirs)
+    if info is not None:
+        info["pooled"] = False
+    if not dirs:
+        return
+    if processes is None:
+        ncpu = os.cpu_count() or 1
+        force = os.environ.get("JEPSEN_TPU_PIPELINE") == "1"
+        processes = min(len(dirs), ncpu) if ncpu > 1 or force else 0
+    done = 0   # dirs fully yielded: a mid-stream pool failure resumes
+    #            serially from here instead of double-yielding
+    if processes and processes > 0 and len(dirs) > 1 and _spawn_safe():
+        ctx = mp.get_context("spawn")
+        try:
+            with ctx.Pool(processes=processes) as pool:
+                if info is not None:
+                    info["pooled"] = True
+                it = pool.imap(_worker, [(d, checker) for d in dirs],
+                               chunksize=max(1, min(chunk // 4, 16)))
+                buf = []
+                for d, enc in zip(dirs, it):
+                    buf.append((d, enc))
+                    if len(buf) >= chunk:
+                        yield buf
+                        done += len(buf)
+                        buf = []
+                if buf:
+                    yield buf
+                    done += len(buf)
+                return
+        except Exception:
+            log.warning("pipelined encode pool failed; falling back "
+                        "to serial", exc_info=True)
+    for i in range(done, len(dirs), chunk):
+        yield [(d, _worker((d, checker)))
+               for d in dirs[i:i + chunk]]

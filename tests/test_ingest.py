@@ -68,3 +68,31 @@ class TestParallelEncode:
         from jepsen_tpu.checker.elle.encode import encode_history
         assert out[0].n == encode_history(hist).n
         assert isinstance(out[1], Exception)
+
+
+class TestIterEncodeChunks:
+    def test_chunks_ordered_and_complete(self, tmp_path):
+        dirs = [write_run(tmp_path, f"r{i}",
+                          synth.synth_append_history(T=30, K=6, seed=i))
+                for i in range(7)]
+        got = []
+        for part in ingest.iter_encode_chunks(dirs, chunk=3,
+                                              processes=2):
+            assert len(part) <= 3
+            got.extend(part)
+        assert [d for d, _e in got] == dirs        # in order, no dups
+        serial = ingest.parallel_encode(dirs, processes=0)
+        for (d, e), s in zip(got, serial):
+            assert e.n == s.n and (e.appends == s.appends).all()
+
+    def test_exceptions_and_serial_path(self, tmp_path):
+        good = write_run(tmp_path, "good",
+                         synth.synth_append_history(T=20, K=4, seed=0))
+        bad = tmp_path / "bad"
+        bad.mkdir()
+        parts = list(ingest.iter_encode_chunks([good, bad], chunk=8,
+                                               processes=0))
+        assert len(parts) == 1
+        (d1, e1), (d2, e2) = parts[0]
+        assert d1 == good and e1.n > 0
+        assert d2 == bad and isinstance(e2, Exception)
