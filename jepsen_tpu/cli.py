@@ -329,14 +329,15 @@ def analyze_store(store: Store, checker: str = "append",
             return False
         return True
 
+    if not host_only:
+        from . import devices as devmod
+        if devmod.accelerator_available():   # probe-bounded, jax-free
+            # overlap pays even on a single-core host when a real
+            # device runs the checks: the worker parses while the
+            # parent blocks on the accelerator (append AND wr sweeps)
+            _os.environ.setdefault("JEPSEN_TPU_PIPELINE", "1")
+
     if checker == "append":
-        if not host_only:
-            from . import devices as devmod
-            if devmod.accelerator_available():   # probe-bounded, jax-free
-                # overlap pays even on a single-core host when a real
-                # device runs the checks: the worker parses while the
-                # parent blocks on the accelerator
-                _os.environ.setdefault("JEPSEN_TPU_PIPELINE", "1")
         # Mesh built lazily on the FIRST dense dispatch: an
         # all-fallback store (non-txn workloads) must never pay — or
         # hang in — device init it doesn't need.
@@ -404,25 +405,26 @@ def analyze_store(store: Store, checker: str = "append",
                                                 checker))
         return worst
 
-    encs, mapping, fallback = [], [], []
-    for d, enc in zip(run_dirs,
-                      ingest.parallel_encode(run_dirs, checker=checker)):
-        if encodable(d, enc, fallback):
-            encs.append(enc)
-            mapping.append(d)
-
-    if encs:  # wr: edge lists host-built; bucketed device dispatches
+    # wr: edge lists host-built; bucketed device dispatches — the same
+    # streaming pipeline as the append sweep (chunked device work
+    # overlaps pool parsing of the next chunk).
+    prohibited = elle_wr.WrChecker().prohibited
+    fallback = []
+    for chunk in ingest.iter_encode_chunks(run_dirs, checker=checker):
+        good = [(d, enc) for d, enc in chunk
+                if encodable(d, enc, fallback)]
+        if not good:
+            continue
         if host_only:
-            # wr encodings carry prebuilt edges; the wr module's
-            # own host analyzer consumes them (the append-side
-            # cycle_anomalies_cpu would look for .appends)
-            cycles_per_run = [elle_wr.cycle_anomalies_cpu(e)
-                              for e in encs]
+            cycles_per = [elle_wr.cycle_anomalies_cpu(e)
+                          for _d, e in good]
         else:
-            cycles_per_run = elle_kernels.check_edge_batch_bucketed(
-                [elle_wr.to_edge_dict(e) for e in encs])
-        prohibited = elle_wr.WrChecker().prohibited
-        for d, enc, cycles in zip(mapping, encs, cycles_per_run):
+            cycles_per = elle_kernels.check_edge_batch_bucketed(
+                [elle_wr.to_edge_dict(e) for _d, e in good])
+        # emit per chunk: verdicts persist incrementally (an
+        # interrupted sweep --resumes from the last chunk, not from
+        # zero) and encodings free as we go
+        for (d, enc), cycles in zip(good, cycles_per):
             res = elle_wr.render_wr_verdict(enc, cycles, prohibited)
             res["checker"] = "wr"       # --resume marker
             worst = max(worst, emit(d, res))
