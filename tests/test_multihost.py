@@ -15,3 +15,17 @@ def test_two_process_multihost_dryrun():
     summary = g.dryrun_multihost(2, 2)   # 2 procs x 2 devices = 4 global
     assert summary.count("MULTIHOST_WORKER_OK") == 2
     assert "pid=0/2" in summary and "pid=1/2" in summary
+
+
+def test_multihost_non_power_of_two_devices():
+    """factor2's squarest dp×mp split can straddle processes for
+    non-power-of-2 device counts (6 devices / 2 procs -> dp 3); the
+    worker must pick a process-aligned mesh instead of crashing on
+    non-contiguous host-local shards."""
+    import __graft_entry__ as g
+    summary = g.dryrun_multihost(2, 3)   # 6 global devices
+    assert summary.count("MULTIHOST_WORKER_OK") == 2
+    assert "devices=6" in summary
+    # the invariant itself: dp rows aligned to processes, (2, 3) not
+    # factor2's squarer-but-straddling (3, 2)
+    assert "mesh=(2, 3)" in summary
