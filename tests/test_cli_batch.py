@@ -449,6 +449,65 @@ def test_analyze_store_resume_skips_verdicted_runs(tmp_path, capsys):
     assert [ln["dir"] for ln in lines] == [str(d2)]
 
 
+def test_wr_sweep_interrupted_mid_stream_resumes_from_chunk(
+        tmp_path, capsys, monkeypatch):
+    """Streaming wr sweep persists verdicts PER CHUNK: a crash after
+    chunk 1 leaves its results on disk, and --resume re-checks only
+    the unfinished remainder."""
+    from jepsen_tpu import ingest
+    from jepsen_tpu.checker.elle import kernels as elle_kernels
+
+    def wr_hist(seed):
+        txns = [(0, [["w", "x", seed * 10 + 1]]),
+                (1, [["r", "x", seed * 10 + 1]])]
+        out = []
+        for p, txn in txns:
+            for ty in ("invoke", "ok"):
+                out.append({"type": ty, "process": p, "f": "txn",
+                            "value": txn, "index": len(out),
+                            "time": len(out) * 1000})
+        return out
+
+    store = Store(tmp_path / "store")
+    dirs = [make_run(store, "pg", f"2026073{i}T000000", wr_hist(i))
+            for i in range(4)]
+    # chunks of 2; the second chunk's device dispatch dies
+    def two_chunks(rd, checker="wr", **kw):
+        rd = list(rd)
+        for part in (rd[:2], rd[2:]):
+            yield list(zip(part, ingest.parallel_encode(
+                part, checker=checker)))
+
+    monkeypatch.setattr(ingest, "iter_encode_chunks", two_chunks)
+    calls = {"n": 0}
+    orig = elle_kernels.check_edge_batch_bucketed
+
+    def dying(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("interrupted mid-sweep")
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(elle_kernels, "check_edge_batch_bucketed",
+                        dying)
+    monkeypatch.delenv("JEPSEN_TPU_BACKEND", raising=False)
+    with pytest.raises(RuntimeError):
+        cli.analyze_store(store, checker="wr")
+    # chunk 1's verdicts survived the crash
+    assert (dirs[0] / ".sweep-wr").exists()
+    assert (dirs[1] / ".sweep-wr").exists()
+    assert not (dirs[2] / ".sweep-wr").exists()
+    capsys.readouterr()
+    # resume: only the unfinished half is re-checked
+    monkeypatch.setattr(elle_kernels, "check_edge_batch_bucketed", orig)
+    rc = cli.analyze_store(store, checker="wr", resume=True)
+    assert rc == 0
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()]
+    assert [ln["dir"] for ln in lines] == [str(dirs[2]), str(dirs[3])]
+    assert all((d / ".sweep-wr").exists() for d in dirs)
+
+
 def test_stored_fallback_sidecar_records_validity(tmp_path, capsys):
     """ADVICE r3: a stored-fallback run writes no results.json, so its
     `.sweep-<checker>` sidecar must carry the verdict's validity —
