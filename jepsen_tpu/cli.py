@@ -354,52 +354,52 @@ def analyze_store(store: Store, checker: str = "append",
         # The checker class's own defaults, so batch verdicts match
         # single-run verdicts for the same history.
         prohibited = elle.AppendChecker().prohibited
-        cycles_by_dir: dict = {}
-        encs, mapping, fallback, huge, huge_map = [], [], [], [], []
+
+        def emit_append(d, enc, cycles):
+            res = elle.render_verdict(enc, cycles, prohibited)
+            res["checker"] = "append"   # --resume marker
+            return emit(d, res)
+
+        fallback, huge, huge_map = [], [], []
         # Streaming ingest/check pipeline: each chunk's device sweep
         # overlaps the pool workers' parsing of the NEXT chunk, so
         # device time hides under ingest on stores big enough to
         # matter (SURVEY.md §5.7; the bench's north-star block uses
-        # the same loop).
+        # the same loop). Verdicts persist PER CHUNK: an interrupted
+        # sweep --resumes from the last chunk, not from zero (huge
+        # runs defer to their own host-condensation pass below).
         for chunk in ingest.iter_encode_chunks(run_dirs,
                                                checker=checker):
             dense, dense_map = [], []
             for d, enc in chunk:
                 if not encodable(d, enc, fallback):
                     continue
-                encs.append(enc)
-                mapping.append(d)
                 if enc.n > parallel.DENSE_TXN_LIMIT:
                     # too long for the dense [T,T] closure: SCC
                     # condensation (the 100k-op path), after the sweep
                     huge.append(enc)
                     huge_map.append(d)
                 elif host_only:
-                    cycles_by_dir[d] = elle.cycle_anomalies_cpu(enc)
+                    worst = max(worst, emit_append(
+                        d, enc, elle.cycle_anomalies_cpu(enc)))
                 else:
                     dense.append(enc)
                     dense_map.append(d)
             if dense:
-                for d, cycles in zip(dense_map,
-                                     parallel.check_bucketed(
-                                         dense, get_mesh())):
-                    cycles_by_dir[d] = cycles
+                cycles_per = parallel.check_bucketed(dense, get_mesh())
+                for d, enc, cycles in zip(dense_map, dense, cycles_per):
+                    worst = max(worst, emit_append(d, enc, cycles))
         for d, enc in zip(huge_map, huge):
             if host_only:
-                cycles_by_dir[d] = elle.cycle_anomalies_cpu(enc)
-                continue
-            # mesh=None: these are all past the dense limit, so
-            # check_long_history goes host-condensation; None just
-            # lets the per-SCC classify stage use default_devices()
-            # (the dp batch mesh would be wrong for B=1 anyway)
-            cycles_by_dir[d] = parallel.check_long_history(
-                enc, None, dense_limit=parallel.DENSE_TXN_LIMIT)
-        # one emit loop, in the original (sorted run-dir) order
-        for d, enc in zip(mapping, encs):
-            res = elle.render_verdict(enc, cycles_by_dir[d],
-                                      prohibited)
-            res["checker"] = "append"   # --resume marker
-            worst = max(worst, emit(d, res))
+                cycles = elle.cycle_anomalies_cpu(enc)
+            else:
+                # mesh=None: these are all past the dense limit, so
+                # check_long_history goes host-condensation; None just
+                # lets the per-SCC classify stage use default_devices()
+                # (the dp batch mesh would be wrong for B=1 anyway)
+                cycles = parallel.check_long_history(
+                    enc, None, dense_limit=parallel.DENSE_TXN_LIMIT)
+            worst = max(worst, emit_append(d, enc, cycles))
         for d in fallback:
             worst = max(worst, _stored_fallback(d, stored_check,
                                                 checker))
