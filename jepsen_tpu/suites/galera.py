@@ -12,6 +12,7 @@ from .. import cli as jcli
 from .. import control
 from .. import db as jdb
 from .. import nemesis as jnemesis, os_setup
+from ..workloads import dirty_reads
 from . import base_opts, sql, standard_workloads, suite_test
 
 LOGFILE = "/var/log/mysql/error.log"
@@ -56,14 +57,23 @@ class GaleraDB(jdb.DB, jdb.LogFiles):
 def workloads(opts: dict | None = None) -> dict:
     std = standard_workloads(opts)
     # galera.clj ships sets + bank; register/monotonic ride along from
-    # the shared matrix.
-    return {k: std[k] for k in ("set", "bank", "register", "monotonic")}
+    # the shared matrix. dirty-reads is the suite's signature check
+    # (galera/src/jepsen/galera/dirty_reads.clj:1-120).
+    out = {k: std[k] for k in ("set", "bank", "register", "monotonic")}
+    out["dirty-reads"] = dirty_reads.workload
+    return out
 
 
 def default_client(workload: str, opts: dict):
+    sql_opts = opts.get("sql-opts")
+    if workload == "dirty-reads":
+        # A healthy cluster rarely aborts on its own; deliberate
+        # rollbacks keep the checker's failed-write pool non-empty.
+        # Merge per-key so unrelated sql-opts don't void the default.
+        sql_opts = {"abort_prob": 0.05, **(sql_opts or {})}
     return sql.client_for(
         sql.MySQLDialect(port=3306, user="root", database="test"),
-        workload, opts)
+        workload, {**opts, "sql-opts": sql_opts})
 
 
 def galera_test(opts: dict | None = None) -> dict:

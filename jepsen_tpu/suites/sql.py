@@ -24,6 +24,8 @@ indeterminate => "info" (reads may safely "fail").
 
 from __future__ import annotations
 
+import random
+
 from .. import client as jclient
 from .. import independent
 from ..drivers import DBError, DriverError
@@ -62,6 +64,11 @@ class Dialect:
     def rollback(self) -> str:
         return "ROLLBACK"
 
+    def begin_serializable(self) -> list[str]:
+        """Statements opening a SERIALIZABLE txn (the isolation the
+        dirty-reads workload runs under, dirty_reads.clj:51-52)."""
+        return [self.begin()]
+
     def upsert(self, table: str, key: int, col: str, val: str) -> str:
         raise NotImplementedError
 
@@ -86,6 +93,8 @@ class Dialect:
             " (id BIGINT PRIMARY KEY, k BIGINT)",
             "CREATE TABLE IF NOT EXISTS g2b"
             " (id BIGINT PRIMARY KEY, k BIGINT)",
+            "CREATE TABLE IF NOT EXISTS dirty"
+            " (id BIGINT PRIMARY KEY, x BIGINT NOT NULL)",
         ]
 
 
@@ -107,6 +116,9 @@ class PGDialect(Dialect):
                               database=self.database,
                               password=self.password,
                               timeout=self.timeout)
+
+    def begin_serializable(self):
+        return ["BEGIN ISOLATION LEVEL SERIALIZABLE"]
 
     def upsert(self, table, key, col, val):
         return (f"INSERT INTO {table} (id, {col}) VALUES ({key}, {val}) "
@@ -140,6 +152,10 @@ class MySQLDialect(Dialect):
                                   database=self.database,
                                   password=self.password,
                                   timeout=self.timeout)
+
+    def begin_serializable(self):
+        return ["SET TRANSACTION ISOLATION LEVEL SERIALIZABLE",
+                self.begin()]
 
     def upsert(self, table, key, col, val):
         return (f"INSERT INTO {table} (id, {col}) VALUES ({key}, {val}) "
@@ -201,6 +217,16 @@ class SQLClient(jclient.Client):
         if not self._setup_done:
             for stmt in self.dialect.setup_stmts():
                 self.conn.query(stmt)
+            if self.mode == "dirty-reads":
+                # Seed every row to -1 exactly once, insert-if-absent
+                # (dirty_reads.clj:37-43's dotimes insert loop).
+                d = self.dialect
+                noop = ("ON CONFLICT (id) DO NOTHING" if d.name == "pg"
+                        else "ON DUPLICATE KEY UPDATE x = x")
+                for i in range(self._dirty_rows()):
+                    self.conn.query(
+                        f"INSERT INTO dirty (id, x) VALUES ({i}, -1) "
+                        f"{noop}")
             if self.mode == "bank":
                 # Atomic insert-if-absent seeding: account 0 holds the
                 # full total, the rest 0. Concurrent seeders can't reset
@@ -265,6 +291,8 @@ class SQLClient(jclient.Client):
             return self._bank(op)
         if mode == "set":
             return self._set(op)
+        if mode == "dirty-reads":
+            return self._dirty_reads(op)
         if mode == "monotonic":
             return self._monotonic(op)
         if mode in ("sequential", "causal-reverse"):
@@ -419,6 +447,49 @@ class SQLClient(jclient.Client):
                     "value": sorted(int(r[0]) for r in rows)}
         return {**op, "type": "fail", "error": f"unknown f {op['f']!r}"}
 
+    # -- dirty-reads ---------------------------------------------------
+
+    def _dirty_rows(self) -> int:
+        return int(self.sql_opts.get("dirty_rows", 8))
+
+    def _dirty_reads(self, op):
+        """galera/percona dirty_reads.clj:48-66: read = full-table scan
+        in one serializable txn; write = read every row then set every
+        row to the op's unique value, in shuffled order, so competing
+        writers deadlock/cert-fail often. `abort_prob` adds deliberate
+        rollbacks so a healthy cluster still produces the failed-txn
+        values the checker hunts for."""
+        c, d = self.conn, self.dialect
+        for stmt in d.begin_serializable():
+            c.query(stmt)
+        try:
+            if op["f"] == "read":
+                rows = _rows(c.query("SELECT x FROM dirty"))
+                c.query(d.commit())
+                return {**op, "type": "ok",
+                        "value": [int(r[0]) for r in rows]}
+            if op["f"] == "write":
+                x = int(op["value"])
+                order = random.sample(range(self._dirty_rows()),
+                                      self._dirty_rows())
+                for i in order:
+                    c.query(f"SELECT x FROM dirty WHERE id = {i}")
+                for i in order:
+                    c.query(f"UPDATE dirty SET x = {x} WHERE id = {i}")
+                if (random.random()
+                        < float(self.sql_opts.get("abort_prob", 0.0))):
+                    c.query(d.rollback())
+                    return {**op, "type": "fail",
+                            "error": "deliberate-abort"}
+                c.query(d.commit())
+                return {**op, "type": "ok"}
+            c.query(d.rollback())
+            return {**op, "type": "fail",
+                    "error": f"unknown f {op['f']!r}"}
+        except DBError:
+            self._try_rollback()
+            raise
+
     # -- monotonic -----------------------------------------------------
 
     def _monotonic(self, op):
@@ -497,6 +568,7 @@ MODES = {
     "register": "register", "append": "append", "wr": "wr",
     "bank": "bank", "set": "set", "monotonic": "monotonic",
     "sequential": "sequential", "long-fork": "wr", "g2": "g2",
+    "dirty-reads": "dirty-reads",
 }
 
 

@@ -13,6 +13,7 @@ end-to-end suite runs a linearizable SUT whose checks must pass.
 from __future__ import annotations
 
 import base64
+import copy
 import hashlib
 import hmac
 import os
@@ -64,7 +65,9 @@ class MiniDB:
         """-> (columns, rows, tag)."""
         sql = sql.strip().rstrip(";").strip()
         u = sql.upper()
-        if u in ("BEGIN", "START TRANSACTION"):
+        if u.startswith("BEGIN") or u == "START TRANSACTION":
+            # covers "BEGIN ISOLATION LEVEL SERIALIZABLE" (PGDialect's
+            # begin_serializable) — MiniDB is always serializable
             txn.begin()
             return [], [], "BEGIN"
         if u == "COMMIT":
@@ -146,8 +149,9 @@ class MiniDB:
                 old = t["rows"][pk]
                 if "||" in clause or "CONCAT" in cu:
                     old["val"] = f"{old['val']},{row['val']}"
-                elif re.search(r"balance\s*=\s*balance\b", clause):
-                    pass  # DO UPDATE SET balance = balance (no-op seed)
+                elif re.search(r"(\w+)\s*=\s*\1\b", clause):
+                    pass  # self-assignment = insert-if-absent seed
+                          # (balance = balance, x = x)
                 else:
                     sm = re.search(
                         r"(\w+)\s*=\s*(?:excluded\.\w+|VALUES\s*\()",
@@ -216,21 +220,30 @@ class Txn:
     def __init__(self, db: MiniDB):
         self.db = db
         self.active = False
+        self._snap = None
 
     def begin(self):
         if not self.active:
             self.db.lock.acquire()
             self.active = True
+            # Snapshot under the lock: ROLLBACK restores it, so the
+            # dirty-reads workload's deliberately-aborted writes really
+            # vanish (tables are tiny in tests; deepcopy is cheap).
+            self._snap = copy.deepcopy(self.db.tables)
 
     def commit(self):
         if self.active:
             self.active = False
+            self._snap = None
             self.db.lock.release()
 
-    rollback = commit  # single-version store: rollback == release
-    # (clients only roll back before any write, so this stays safe for
-    # the statement shapes suites.sql emits: cas/g2 roll back pre-write,
-    # bank rolls back pre-update)
+    def rollback(self):
+        if self.active:
+            self.active = False
+            self.db.tables.clear()
+            self.db.tables.update(self._snap)
+            self._snap = None
+            self.db.lock.release()
 
     def held(self):
         return self if self.active else self.db.lock

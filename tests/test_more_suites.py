@@ -170,6 +170,81 @@ def test_crate_workload_registry_has_reference_families():
 
 
 # ---------------------------------------------------------------------
+# galera / percona dirty-reads (galera/dirty_reads.clj:1-120 and its
+# percona twin)
+# ---------------------------------------------------------------------
+
+def test_dirty_reads_checker_verdicts():
+    from jepsen_tpu.workloads import dirty_reads
+    c = dirty_reads.DirtyReadsChecker()
+    hist = [
+        {"type": "ok", "f": "write", "value": 1},
+        {"type": "fail", "f": "write", "value": 2},
+        {"type": "ok", "f": "read", "value": [1, 1, 1]},
+    ]
+    good = c.check({}, hist, {})
+    assert good["valid?"] is True and good["failed-write-count"] == 1
+
+    # a reader observed failed txn 2's value: dirty read, must fail
+    bad = hist + [{"type": "ok", "f": "read", "value": [1, 2, 1]}]
+    res = c.check({}, bad, {})
+    assert res["valid?"] is False
+    assert res["dirty-count"] == 1
+    # that read is also internally inconsistent (fractured)
+    assert res["inconsistent-count"] == 1
+
+    # info writes are indeterminate — observing them is NOT dirty
+    maybe = hist + [{"type": "info", "f": "write", "value": 3},
+                    {"type": "ok", "f": "read", "value": [3, 3, 3]}]
+    assert c.check({}, maybe, {})["valid?"] is True
+
+
+def test_dirty_reads_client_ops():
+    from jepsen_tpu.suites import sql
+    with FakeMySQLServer() as srv:
+        test = {"db-hosts": hosts_for(srv)}
+        mk = lambda p: sql.client_for(
+            sql.MySQLDialect(port=3306, user="root", database="test"),
+            "dirty-reads", {"sql-opts": {"abort_prob": p}}
+        ).open(test, "n1")
+        c = mk(0.0)
+        w = c.invoke(test, {"type": "invoke", "f": "write", "value": 7})
+        assert w["type"] == "ok"
+        r = c.invoke(test, {"type": "invoke", "f": "read", "value": None})
+        assert r["type"] == "ok" and r["value"] == [7] * 8
+
+        # deliberate abort: the write must fail AND leave no trace
+        a = mk(1.0)
+        w2 = a.invoke(test, {"type": "invoke", "f": "write", "value": 9})
+        assert w2["type"] == "fail" and w2["error"] == "deliberate-abort"
+        r2 = c.invoke(test, {"type": "invoke", "f": "read", "value": None})
+        assert r2["type"] == "ok" and r2["value"] == [7] * 8
+        c.close(test)
+        a.close(test)
+
+
+@pytest.mark.parametrize("make_test", [
+    galera.galera_test, percona.percona_test,
+])
+def test_dirty_reads_end_to_end(tmp_path, make_test):
+    with FakeMySQLServer() as srv:
+        test = run_suite(tmp_path, make_test, srv,
+                         {"workload": "dirty-reads", "time-limit": 1.5,
+                          "sql-opts": {"abort_prob": 1.0}})
+    r = test["results"]["dirty-reads"]
+    # every write deliberately aborts; the serializable fake rolls them
+    # back, so readers only ever see the -1 seed — no dirty reads
+    assert r["valid?"] is True, r
+    assert r["failed-write-count"] > 0
+    assert r["read-count"] > 0
+
+
+def test_dirty_reads_in_both_registries():
+    assert "dirty-reads" in galera.workloads({})
+    assert "dirty-reads" in percona.workloads({})
+
+
+# ---------------------------------------------------------------------
 # elasticsearch dirty-read (dirty_read.clj)
 # ---------------------------------------------------------------------
 
