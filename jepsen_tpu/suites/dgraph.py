@@ -10,10 +10,12 @@ cluster.
 
 from __future__ import annotations
 
+from .. import checker as jchecker
 from .. import cli as jcli
 from .. import client as jclient
 from .. import control
 from .. import db as jdb
+from .. import generator as gen
 from .. import independent
 from .. import nemesis as jnemesis, os_setup
 from ..control import util as cutil
@@ -79,6 +81,7 @@ skey: int @index(int) .
 sval: int .
 gkey: int @index(int) .
 gside: string .
+dkey: int @index(int) @upsert .
 """
 
 
@@ -147,6 +150,8 @@ class DgraphClient(jclient.Client):
     def _dispatch(self, op):
         if self.mode == "bank":
             return self._bank(op)
+        if self.mode == "delete":
+            return self._delete_ops(op)
         if self.mode == "set":
             return self._set(op)
         if self.mode in ("sequential", "causal-reverse"):
@@ -288,6 +293,45 @@ class DgraphClient(jclient.Client):
                     if independent.is_tuple(v) else vals}
         return {**op, "type": "fail", "error": f"unknown f {op['f']!r}"}
 
+    def _delete_ops(self, op):
+        """delete.clj:32-60: per-key upsert/delete/read against an
+        indexed predicate; reads must see the index agree with the data
+        (zero records, or exactly one {uid, key} record)."""
+        v = op["value"]
+        k = v.key if independent.is_tuple(v) else 0
+        lift = (lambda x: independent.tuple_(k, x)) \
+            if independent.is_tuple(v) else (lambda x: x)
+        c = self.conn
+        if op["f"] == "read":
+            out = c.query(
+                f"{{ q(func: eq(dkey, {int(k)})) {{ uid dkey }} }}")
+            nodes = out.get("data", {}).get("q") or []
+            recs = [{"uid": n.get("uid"), "key": n.get("dkey")}
+                    for n in nodes]
+            return {**op, "type": "ok", "value": lift(recs)}
+        if op["f"] == "upsert":
+            txn = c.begin()
+            out = txn.query(
+                f"{{ q(func: eq(dkey, {int(k)})) {{ uid }} }}")
+            if out.get("data", {}).get("q"):
+                txn.discard()
+                return {**op, "type": "fail", "error": "present"}
+            txn.mutate(set_obj=[{"uid": "_:new", "dkey": int(k)}])
+            txn.commit()  # conflict -> DBError ErrorAborted -> fail
+            return {**op, "type": "ok"}
+        if op["f"] == "delete":
+            txn = c.begin()
+            out = txn.query(
+                f"{{ q(func: eq(dkey, {int(k)})) {{ uid }} }}")
+            nodes = out.get("data", {}).get("q") or []
+            if not nodes:
+                txn.discard()
+                return {**op, "type": "fail", "error": "not-found"}
+            txn.mutate(delete_obj=[{"uid": nodes[0]["uid"]}])
+            txn.commit()
+            return {**op, "type": "ok", "uid": nodes[0]["uid"]}
+        return {**op, "type": "fail", "error": f"unknown f {op['f']!r}"}
+
     def _upsert_g2(self, op):
         v = op["value"]
         k, pair = (v.key, v.value) if independent.is_tuple(v) else (0, v)
@@ -304,9 +348,61 @@ class DgraphClient(jclient.Client):
         return {**op, "type": "ok"}
 
 
+class DeleteChecker(jchecker.Checker):
+    """delete.clj:66-90: every ok read finds either nothing, or exactly
+    one record carrying both a uid and the key under test — anything
+    else (ghost records, index/data divergence, half-deleted nodes) is
+    a bad read."""
+
+    def check(self, test, history, opts):
+        k = (opts or {}).get("history-key")
+        bad = []
+        for op in history:
+            if op.get("type") != "ok" or op.get("f") != "read":
+                continue
+            recs = op.get("value")
+            if not isinstance(recs, (list, tuple)):
+                bad.append(op)
+                continue
+            if len(recs) == 0:
+                continue
+            r0 = recs[0] if isinstance(recs[0], dict) else {}
+            if (len(recs) == 1 and set(r0) == {"uid", "key"}
+                    and r0["uid"] and (k is None or r0["key"] == k)):
+                continue
+            bad.append(op)
+        return {"valid?": not bad, "bad-reads": bad[:16],
+                "bad-count": len(bad)}
+
+
+def delete_workload(opts: dict) -> dict:
+    """delete.clj:92-104: independent per-key concurrent generator over
+    a mix of read/upsert/delete, checked per key."""
+    nodes = opts.get("nodes") or ["n1", "n2", "n3", "n4", "n5"]
+
+    def r(test=None, ctx=None):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    def u(test=None, ctx=None):
+        return {"type": "invoke", "f": "upsert", "value": None}
+
+    def d(test=None, ctx=None):
+        return {"type": "invoke", "f": "delete", "value": None}
+
+    return {
+        "generator": independent.concurrent_generator(
+            2 * len(nodes), range(10_000),
+            lambda k: gen.stagger(
+                0.01, gen.limit(1000, gen.mix([r, u, d])))),
+        "checker": independent.checker(jchecker.compose({
+            "deletes": DeleteChecker()})),
+    }
+
+
 #: workload -> client mode
 MODES = {"register": "register", "bank": "bank", "set": "set",
-         "sequential": "sequential", "upsert": "g2", "long-fork": "wr"}
+         "sequential": "sequential", "upsert": "g2", "long-fork": "wr",
+         "delete": "delete"}
 
 
 def default_client(workload: str, opts: dict) -> DgraphClient:
@@ -324,6 +420,7 @@ def workloads(opts: dict | None = None) -> dict:
         "sequential": std["sequential"],
         "set": std["set"],
         "upsert": std["g2"],              # predicate-uniqueness races
+        "delete": lambda: delete_workload(opts or {}),
     }
 
 

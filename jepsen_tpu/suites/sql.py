@@ -293,6 +293,8 @@ class SQLClient(jclient.Client):
             return self._set(op)
         if mode == "dirty-reads":
             return self._dirty_reads(op)
+        if mode == "table":
+            return self._table(op)
         if mode == "monotonic":
             return self._monotonic(op)
         if mode in ("sequential", "causal-reverse"):
@@ -490,6 +492,39 @@ class SQLClient(jclient.Client):
             self._try_rollback()
             raise
 
+    # -- table (DDL visibility) ----------------------------------------
+
+    #: "relation/table does not exist": mysql 1146 (SQLSTATE 42S02),
+    #: pg 42P01 — the anomaly signal for the table workload.
+    NO_TABLE_SQL = {"1146", "42S02", "42P01"}
+    #: duplicate primary key: mysql 1062 (23000), pg 23505 — expected
+    #: noise (every insert targets id 0), not an anomaly.
+    DUP_KEY_SQL = {"1062", "23000", "23505"}
+
+    def _table(self, op):
+        """tidb/table.clj:23-47: create-table then insert; an insert
+        bounced with 'table doesn't exist' AFTER the create was acked
+        is the DDL-visibility anomaly the checker hunts."""
+        c = self.conn
+        if op["f"] == "create-table":
+            t = int(op["value"])
+            c.query(f"CREATE TABLE IF NOT EXISTS t{t}"
+                    " (id BIGINT PRIMARY KEY, val BIGINT)")
+            return {**op, "type": "ok"}
+        if op["f"] == "insert":
+            t, k = op["value"]
+            try:
+                c.query(f"INSERT INTO t{int(t)} (id) VALUES ({int(k)})")
+            except DBError as e:
+                code = str(e.code)
+                if code in self.NO_TABLE_SQL:
+                    return {**op, "type": "fail", "error": "doesnt-exist"}
+                if code in self.DUP_KEY_SQL:
+                    return {**op, "type": "fail", "error": "duplicate-key"}
+                raise
+            return {**op, "type": "ok"}
+        return {**op, "type": "fail", "error": f"unknown f {op['f']!r}"}
+
     # -- monotonic -----------------------------------------------------
 
     def _monotonic(self, op):
@@ -568,7 +603,7 @@ MODES = {
     "register": "register", "append": "append", "wr": "wr",
     "bank": "bank", "set": "set", "monotonic": "monotonic",
     "sequential": "sequential", "long-fork": "wr", "g2": "g2",
-    "dirty-reads": "dirty-reads",
+    "dirty-reads": "dirty-reads", "table": "table",
 }
 
 

@@ -9,9 +9,13 @@ SQL access is driver-pluggable as in the cockroach suite.
 
 from __future__ import annotations
 
+import random
+
+from .. import checker as jchecker
 from .. import cli as jcli
 from .. import control
 from .. import db as jdb
+from .. import generator as gen
 from .. import nemesis as jnemesis, os_setup
 from ..control import util as cutil
 from . import base_opts, sql, standard_workloads, suite_test
@@ -77,11 +81,55 @@ class TiDB(jdb.DB, jdb.SignalProcess, jdb.LogFiles):
                 f"{LOGDIR}/tidb.log"]
 
 
+class TableChecker(jchecker.Checker):
+    """tidb/table.clj:69-77: an insert that bounced off a 'missing'
+    table is the anomaly — the generator only ever inserts into tables
+    whose creation was already acknowledged."""
+
+    def check(self, test, history, opts):
+        bad = [op for op in history
+               if op.get("type") == "fail"
+               and op.get("error") == "doesnt-exist"]
+        return {"valid?": not bad, "errors": bad[:16],
+                "error-count": len(bad)}
+
+
+def table_workload(opts: dict | None = None) -> dict:
+    """tidb/table.clj:54-67,79-85: repeatedly create fresh tables;
+    80% of the time insert into the last table whose create-table op
+    completed ok. DDL that isn't visible to subsequent inserts shows
+    up as `doesnt-exist` failures."""
+    state = {"last": None, "next": 0}
+
+    def emit(test=None, ctx=None):
+        if state["last"] is not None and random.random() < 0.8:
+            return {"type": "invoke", "f": "insert",
+                    "value": [state["last"], 0]}
+        state["next"] += 1
+        return {"type": "invoke", "f": "create-table",
+                "value": state["next"]}
+
+    def watch(this, test, ctx, event):
+        # the reference bumps last-created-table as each create COMMITS
+        # (table.clj:28-32's swap! in invoke!)
+        if (event.get("type") == "ok"
+                and event.get("f") == "create-table"):
+            v = int(event["value"])
+            state["last"] = v if state["last"] is None \
+                else max(state["last"], v)
+        return this
+
+    return {"generator": gen.on_update(watch, emit),
+            "checker": jchecker.compose({"table": TableChecker()})}
+
+
 def workloads(opts: dict | None = None) -> dict:
     std = standard_workloads(opts)
-    return {k: std[k] for k in
-            ("bank", "long-fork", "append", "wr", "register", "set",
-             "sequential", "monotonic")}
+    out = {k: std[k] for k in
+           ("bank", "long-fork", "append", "wr", "register", "set",
+            "sequential", "monotonic")}
+    out["table"] = lambda: table_workload(opts)
+    return out
 
 
 #: Per-workload option sweeps (tidb/core.clj:47-79 workload-options):
@@ -108,6 +156,7 @@ WORKLOAD_OPTIONS: dict[str, dict[str, list]] = {
                    "auto-retry-limit": [10, 0]},
     "sequential": {"auto-retry": [True, False],
                    "auto-retry-limit": [10, 0]},
+    "table":      {},   # table.clj has no option knobs
 }
 
 

@@ -101,6 +101,36 @@ class DgraphStore:
                              else str(val)))
         return keys
 
+    def apply_delete(self, del_objs: list, var_uids: dict) -> list:
+        """JSON delete mutations: {"uid": u} alone wipes the node (the
+        S * * form); {"uid": u, "pred": ...} drops those predicates.
+        Returns write keys for conflict detection."""
+        keys = []
+        for obj in del_objs:
+            uid = obj.get("uid")
+            if uid and uid.startswith("uid("):
+                uids = var_uids.get(uid[4:-1], [])
+                if not uids:
+                    continue
+                uid = uids[0]
+            node = self.nodes.get(uid)
+            if node is None:
+                continue
+            keys.append(uid)
+            preds = [p for p in obj if p != "uid"]
+            if preds:
+                for p in preds:
+                    if p in node:
+                        keys.append((p, node[p]))
+                        del node[p]
+                if not node:
+                    del self.nodes[uid]
+            else:
+                keys += [(p, v) for p, v in node.items()
+                         if not isinstance(v, dict)]
+                del self.nodes[uid]
+        return keys
+
     @staticmethod
     def _cond_ok(cond: str | None, var_uids: dict) -> bool:
         if not cond:
@@ -115,12 +145,14 @@ class DgraphStore:
 
     @staticmethod
     def _blocks(body: dict) -> list[tuple]:
-        """-> [(cond, set_objs)] covering both the single-mutation and
-        the multi-block `mutations` upsert forms."""
+        """-> [(cond, set_objs, del_objs)] covering both the
+        single-mutation and the multi-block `mutations` upsert forms."""
         if body.get("mutations") is not None:
-            return [(mu.get("cond"), mu.get("set") or [])
+            return [(mu.get("cond"), mu.get("set") or [],
+                     mu.get("delete") or [])
                     for mu in body["mutations"]]
-        return [(body.get("cond"), body.get("set") or [])]
+        return [(body.get("cond"), body.get("set") or [],
+                 body.get("delete") or [])]
 
     def mutate_commit_now(self, body: dict) -> None:
         with self.lock:
@@ -130,9 +162,10 @@ class DgraphStore:
                 var_uids = {k[5:]: v for k, v in q.items()
                             if k.startswith("_var_")}
             keys = []
-            for cond, set_objs in self._blocks(body):
+            for cond, set_objs, del_objs in self._blocks(body):
                 if self._cond_ok(cond, var_uids):
                     keys += self.apply_set(set_objs, var_uids)
+                    keys += self.apply_delete(del_objs, var_uids)
             ts = self.new_ts()
             for k in keys:
                 self.commit_log[k] = ts
@@ -152,8 +185,8 @@ class DgraphStore:
             # predict write keys without applying, to check conflicts
             pending_keys = []
             for body in st["muts"]:
-                for _cond, set_objs in self._blocks(body):
-                    for obj in set_objs:
+                for _cond, set_objs, del_objs in self._blocks(body):
+                    for obj in set_objs + del_objs:
                         uid = obj.get("uid")
                         if uid and not uid.startswith("_:") and \
                                 not uid.startswith("uid("):
@@ -171,9 +204,10 @@ class DgraphStore:
                     var_uids = {k[5:]: v for k, v in q.items()
                                 if k.startswith("_var_")}
                 keys = []
-                for cond, set_objs in self._blocks(body):
+                for cond, set_objs, del_objs in self._blocks(body):
                     if self._cond_ok(cond, var_uids):
                         keys += self.apply_set(set_objs, var_uids)
+                        keys += self.apply_delete(del_objs, var_uids)
                 ts = self.new_ts()
                 for k in keys:
                     self.commit_log[k] = ts

@@ -504,10 +504,13 @@ class _MyHandler(socketserver.BaseRequestHandler):
                         send_packet(_my_ok(affected))
                 except SQLFail as e:
                     txn.rollback()
-                    send_packet(_my_err(
-                        1062 if e.code == "23505" else 1064,
-                        "40001" if e.code == "23505" else "42000",
-                        e.message))
+                    # translate MiniDB's SQLSTATE-ish codes to the
+                    # errnos a real mysqld sends
+                    errno, state = {
+                        "23505": (1062, "23000"),   # duplicate key
+                        "42P01": (1146, "42S02"),   # table doesn't exist
+                    }.get(e.code, (1064, "42000"))
+                    send_packet(_my_err(errno, state, e.message))
         except ConnectionError:
             pass
         finally:

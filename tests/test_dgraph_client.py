@@ -112,3 +112,91 @@ def test_dgraph_suite_end_to_end(tmp_path):
         test = core.run(test)
     r = test["results"]
     assert r["valid?"] is True, r
+
+
+# ---------------------------------------------------------------------
+# delete workload (dgraph/delete.clj:1-104)
+# ---------------------------------------------------------------------
+
+def test_delete_checker_verdicts():
+    c = dgraph.DeleteChecker()
+
+    def rd(v):
+        return {"type": "ok", "f": "read", "value": v}
+
+    good = [rd([]), rd([{"uid": "0x1", "key": 3}])]
+    assert c.check({}, good, {"history-key": 3})["valid?"] is True
+
+    # two records for one key: index/data divergence
+    dup = [rd([{"uid": "0x1", "key": 3}, {"uid": "0x2", "key": 3}])]
+    res = c.check({}, dup, {"history-key": 3})
+    assert res["valid?"] is False and res["bad-count"] == 1
+
+    # half-deleted node: record lost its uid or key predicate
+    ghost = [rd([{"uid": "0x1"}])]
+    assert c.check({}, ghost, {"history-key": 3})["valid?"] is False
+
+    # record for the WRONG key leaking through the index
+    wrong = [rd([{"uid": "0x1", "key": 9}])]
+    assert c.check({}, wrong, {"history-key": 3})["valid?"] is False
+
+
+def test_client_delete_lifecycle():
+    with FakeDgraphServer() as srv:
+        test = {"db-hosts": hosts_for(srv)}
+        c = dgraph.DgraphClient("delete").open(test, "n1")
+        k = lambda f: {"type": "invoke", "f": f,
+                       "value": independent.tuple_(4, None), "process": 0}
+        assert c.invoke(test, k("delete"))["error"] == "not-found"
+        assert c.invoke(test, k("upsert"))["type"] == "ok"
+        assert c.invoke(test, k("upsert"))["error"] == "present"
+        r = c.invoke(test, k("read"))
+        assert r["type"] == "ok"
+        assert [x["key"] for x in r["value"].value] == [4]
+        d = c.invoke(test, k("delete"))
+        assert d["type"] == "ok" and d["uid"]
+        r2 = c.invoke(test, k("read"))
+        assert r2["type"] == "ok" and r2["value"].value == []
+
+
+def test_fake_delete_txn_conflicts():
+    """Two txns deleting the same node: one wins, one aborts — the
+    write-write conflict the delete workload leans on."""
+    with FakeDgraphServer() as srv:
+        c = dgraph_http.connect("127.0.0.1", srv.port)
+        c.mutate(set_obj=[{"dkey": 1}])
+        t1, t2 = c.begin(), c.begin()
+        u1 = t1.query("{ q(func: eq(dkey, 1)) { uid } }")["data"]["q"][0]
+        u2 = t2.query("{ q(func: eq(dkey, 1)) { uid } }")["data"]["q"][0]
+        t1.mutate(delete_obj=[{"uid": u1["uid"]}])
+        t2.mutate(delete_obj=[{"uid": u2["uid"]}])
+        t1.commit()
+        with pytest.raises(DBError):
+            t2.commit()
+        assert c.query("{ q(func: eq(dkey, 1)) { uid } }")["data"]["q"] \
+            == []
+
+
+def test_dgraph_delete_end_to_end(tmp_path):
+    with FakeDgraphServer() as srv:
+        opts = {
+            "workload": "delete",
+            "ssh": {"dummy": True}, "time-limit": 1.5,
+            "concurrency": 10,
+            "ssh-concurrency": 10,
+            "extra": {"net": jnet.noop(),
+                      "store": Store(tmp_path / "store")},
+            "db-hosts": hosts_for(srv),
+        }
+        test = dgraph.dgraph_test(opts)
+        for k in ("db", "os", "nemesis"):
+            test.pop(k, None)
+        test = core.run(test)
+    r = test["results"]
+    assert r["valid?"] is True, r
+    # at least one key ran the full upsert/delete/read mix
+    assert len(r["results"]) >= 1
+
+
+def test_dgraph_registry_has_delete():
+    assert "delete" in dgraph.workloads({})

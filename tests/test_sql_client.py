@@ -283,3 +283,80 @@ def test_bank_update_in_place_off(tmp_path):
     r = test["results"]
     assert r["valid?"] is True, r
     assert r["bank"]["read-count"] > 0
+
+
+# ---------------------------------------------------------------------
+# tidb table workload (tidb/table.clj:1-84): DDL visibility
+# ---------------------------------------------------------------------
+
+def test_table_checker_verdicts():
+    c = tidb.TableChecker()
+    ok_hist = [
+        {"type": "ok", "f": "create-table", "value": 1},
+        {"type": "ok", "f": "insert", "value": [1, 0]},
+        {"type": "fail", "f": "insert", "value": [1, 0],
+         "error": "duplicate-key"},     # expected noise, not an anomaly
+    ]
+    assert c.check({}, ok_hist, {})["valid?"] is True
+
+    bad_hist = ok_hist + [{"type": "fail", "f": "insert",
+                           "value": [1, 0], "error": "doesnt-exist"}]
+    res = c.check({}, bad_hist, {})
+    assert res["valid?"] is False and res["error-count"] == 1
+
+
+def test_table_client_ops():
+    with FakeMySQLServer() as srv:
+        c, test = my_client(srv, "table")
+        mk = lambda f, v: {"type": "invoke", "f": f, "value": v,
+                           "process": 0}
+        # inserting before the table exists: doesnt-exist, NOT a crash
+        r = c.invoke(test, mk("insert", [7, 0]))
+        assert r["type"] == "fail" and r["error"] == "doesnt-exist"
+        assert c.invoke(test, mk("create-table", 7))["type"] == "ok"
+        assert c.invoke(test, mk("insert", [7, 0]))["type"] == "ok"
+        dup = c.invoke(test, mk("insert", [7, 0]))
+        assert dup["type"] == "fail" and dup["error"] == "duplicate-key"
+        c.close(test)
+
+
+def test_table_generator_tracks_acked_creates():
+    wl = tidb.table_workload({})
+    g = wl["generator"]
+    test = {"concurrency": 2, "nodes": ["n1"]}
+    ctx = gen.Context.for_test(test)
+    # first op must be a create (no table acked yet; ids may skip —
+    # the stateful fn is probed like the reference's swap! counter)
+    op1, g = gen.op(g, test, ctx)
+    assert op1["f"] == "create-table"
+    v1 = op1["value"]
+    # ...and inserts only start flowing once a create completes ok
+    g = gen.update(g, test, ctx, {**op1, "type": "ok"})
+    fs = set()
+    last_acked = v1
+    for _ in range(40):
+        o, g = gen.op(g, test, ctx)
+        fs.add(o["f"])
+        if o["f"] == "insert":
+            # inserts target the LAST acked create only
+            assert o["value"] == [last_acked, 0]
+        else:
+            g = gen.update(g, test, ctx, {**o, "type": "ok"})
+            last_acked = max(last_acked, o["value"])
+    assert "insert" in fs
+
+
+def test_tidb_table_end_to_end(tmp_path):
+    with FakeMySQLServer() as srv:
+        test = run_suite(tmp_path, tidb.tidb_test, srv, "table")
+    r = test["results"]
+    assert r["table"]["valid?"] is True, r
+    ok_creates = [o for o in test["history"]
+                  if o.get("type") == "ok" and o.get("f") == "create-table"]
+    ok_inserts = [o for o in test["history"]
+                  if o.get("type") == "ok" and o.get("f") == "insert"]
+    assert ok_creates and ok_inserts
+
+
+def test_tidb_registry_has_table():
+    assert "table" in tidb.workloads({})
