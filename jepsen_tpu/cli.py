@@ -329,13 +329,17 @@ def analyze_store(store: Store, checker: str = "append",
             return False
         return True
 
+    # Pipelining decision passed DOWN to iter_encode_chunks, not via
+    # process-global env (a later sweep or embedded caller must not
+    # inherit a stale accelerator probe). None = let ingest decide.
+    sweep_procs = None
     if not host_only:
         from . import devices as devmod
         if devmod.accelerator_available():   # probe-bounded, jax-free
             # overlap pays even on a single-core host when a real
             # device runs the checks: the worker parses while the
             # parent blocks on the accelerator (append AND wr sweeps)
-            _os.environ.setdefault("JEPSEN_TPU_PIPELINE", "1")
+            sweep_procs = max(1, _os.cpu_count() or 1)
 
     if checker == "append":
         # Mesh built lazily on the FIRST dense dispatch: an
@@ -369,7 +373,8 @@ def analyze_store(store: Store, checker: str = "append",
         # sweep --resumes from the last chunk, not from zero (huge
         # runs defer to their own host-condensation pass below).
         for chunk in ingest.iter_encode_chunks(run_dirs,
-                                               checker=checker):
+                                               checker=checker,
+                                               processes=sweep_procs):
             dense, dense_map = [], []
             for d, enc in chunk:
                 if not encodable(d, enc, fallback):
@@ -410,7 +415,8 @@ def analyze_store(store: Store, checker: str = "append",
     # overlaps pool parsing of the next chunk).
     prohibited = elle_wr.WrChecker().prohibited
     fallback = []
-    for chunk in ingest.iter_encode_chunks(run_dirs, checker=checker):
+    for chunk in ingest.iter_encode_chunks(run_dirs, checker=checker,
+                                           processes=sweep_procs):
         good = [(d, enc) for d, enc in chunk
                 if encodable(d, enc, fallback)]
         if not good:

@@ -157,9 +157,14 @@ def _call_fn(f: Callable, test: dict, ctx: Context):
     signature wants. The arity is memoized per function object — this
     sits in the interpreter's per-op hot loop (pure.clj:66-70's
     >20k ops/sec figure), and inspect.signature costs more than the
-    whole rest of an op step."""
+    whole rest of an op step. Bound methods are keyed on their
+    underlying __func__ (a fresh method object is created per
+    attribute access, so keying on the method itself would never hit);
+    the cache stores the arity of the CALL — `self` already bound —
+    which is the same for every binding of one function."""
+    key = getattr(f, "__func__", f)
     try:
-        nargs = _fn_arity[f]
+        nargs = _fn_arity[key]
     except (KeyError, TypeError):   # TypeError: non-weakrefable callable
         try:
             sig = inspect.signature(f)
@@ -170,7 +175,7 @@ def _call_fn(f: Callable, test: dict, ctx: Context):
         except (TypeError, ValueError):
             nargs = 0
         try:
-            _fn_arity[f] = nargs
+            _fn_arity[key] = nargs
         except TypeError:
             pass
     return f(test, ctx) if nargs == 2 else f()

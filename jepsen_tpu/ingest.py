@@ -19,6 +19,7 @@ from __future__ import annotations
 import logging
 import multiprocessing as mp
 import os
+import time
 from pathlib import Path
 from typing import Sequence
 
@@ -58,6 +59,44 @@ def _worker(args):
         return encode_run_dir(run_dir, checker)
     except Exception as e:
         return e
+
+
+def _timed_worker(args):
+    """_worker plus the clock span the parse occupied, so the
+    pipelined sweep can MEASURE host/device overlap (span intersection)
+    instead of inferring it from noisy end-to-end subtraction.
+    time.monotonic: CLOCK_MONOTONIC is system-wide on Linux, so spans
+    compare across processes and an NTP step can't corrupt them."""
+    t0 = time.monotonic()
+    out = _worker(args)
+    return out, t0, time.monotonic()
+
+
+def overlap_seconds(spans_a: list, spans_b: list) -> float:
+    """Total seconds where some span in `a` intersects some span in
+    `b` (both lists of (start, end) wall-clock pairs). Used to report
+    honest pipeline overlap: worker parse spans x caller device spans."""
+    if not spans_a or not spans_b:
+        return 0.0
+    # merge each side first so double-counting can't inflate the number
+    def merge(spans):
+        out = []
+        for s, e in sorted(spans):
+            if out and s <= out[-1][1]:
+                out[-1] = (out[-1][0], max(out[-1][1], e))
+            else:
+                out.append((s, e))
+        return out
+    total, bi = 0.0, 0
+    b = merge(spans_b)
+    for s, e in merge(spans_a):
+        while bi < len(b) and b[bi][1] <= s:
+            bi += 1
+        j = bi
+        while j < len(b) and b[j][0] < e:
+            total += max(0.0, min(e, b[j][1]) - max(s, b[j][0]))
+            j += 1
+    return total
 
 
 def _load_worker(run_dir):
@@ -141,17 +180,24 @@ def iter_encode_chunks(run_dirs: Sequence[str | os.PathLike],
     JEPSEN_TPU_PIPELINE=1 forces it.
 
     `info`, when given, gets info["pooled"] set to whether background
-    workers actually ran — callers reporting overlap numbers must not
-    claim pipelining for the strictly serial path."""
+    workers actually ran, and info["parse_spans"] filled with each
+    worker parse's (start, end) wall-clock pair — intersect those with
+    the caller's own device-dispatch spans (`overlap_seconds`) for a
+    measured, not inferred, pipeline-overlap number. Callers reporting
+    overlap must not claim pipelining for the strictly serial path."""
     dirs = list(run_dirs)
     if info is not None:
         info["pooled"] = False
+        info["parse_spans"] = []
     if not dirs:
         return
     if processes is None:
         ncpu = os.cpu_count() or 1
         force = os.environ.get("JEPSEN_TPU_PIPELINE") == "1"
         processes = min(len(dirs), ncpu) if ncpu > 1 or force else 0
+    else:
+        # never spawn more workers than there are run dirs to parse
+        processes = min(int(processes), len(dirs))
     done = 0   # dirs fully yielded: a mid-stream pool failure resumes
     #            serially from here instead of double-yielding
     if processes and processes > 0 and len(dirs) > 1 and _spawn_safe():
@@ -160,10 +206,13 @@ def iter_encode_chunks(run_dirs: Sequence[str | os.PathLike],
             with ctx.Pool(processes=processes) as pool:
                 if info is not None:
                     info["pooled"] = True
-                it = pool.imap(_worker, [(d, checker) for d in dirs],
+                it = pool.imap(_timed_worker,
+                               [(d, checker) for d in dirs],
                                chunksize=max(1, min(chunk // 4, 16)))
                 buf = []
-                for d, enc in zip(dirs, it):
+                for d, (enc, t0, t1) in zip(dirs, it):
+                    if info is not None:
+                        info["parse_spans"].append((t0, t1))
                     buf.append((d, enc))
                     if len(buf) >= chunk:
                         yield buf

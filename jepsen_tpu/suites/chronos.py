@@ -10,6 +10,7 @@ run-marker files off the nodes. The HTTP scheduling client is real
 from __future__ import annotations
 
 import json
+import re as _re
 import urllib.error
 import urllib.request
 
@@ -86,10 +87,27 @@ def parse_run_file(node: str, text: str) -> dict:
     """name / start / end lines -> a run map (chronos.clj:152-159);
     a file with no end line is an incomplete run."""
     lines = text.strip().split("\n")
+    try:
+        name = int(lines[0]) if lines and lines[0].strip() else None
+    except ValueError:
+        # Partial write / stray file: a run with name None can't match
+        # any job, so the checker surfaces it under "unparseable"
+        # instead of the until-ok final read raise-retrying forever.
+        name = None
     return {"node": node,
-            "name": int(lines[0]) if lines and lines[0].strip() else None,
-            "start": lines[1].strip() if len(lines) > 1 else None,
-            "end": lines[2].strip() if len(lines) > 2 else None}
+            "name": name,
+            "start": _ts(lines[1]) if len(lines) > 1 else None,
+            "end": _ts(lines[2]) if len(lines) > 2 else None}
+
+
+_TS_RE = _re.compile(r"^\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}")
+
+
+def _ts(line: str) -> str | None:
+    """A truncated `date -u -Ins` line (partial write) is no timestamp:
+    return None so the run counts as incomplete, not a checker crash."""
+    s = line.strip()
+    return s if _TS_RE.match(s) else None
 
 
 class ChronosClient(jclient.Client):

@@ -134,9 +134,16 @@ def solution(read_time: float, jobs: list[dict],
              runs: list[dict]) -> dict:
     """All jobs (checker.clj:191-213): group runs by job name, solve
     each, valid? iff every job is."""
+    # Runs whose file couldn't be parsed to a job name OR a start
+    # timestamp (partial writes, stray files): can't match/classify —
+    # surface them rather than silently dropping corruption evidence.
     by_name: dict = {}
+    unparseable = []
     for r in runs:
-        by_name.setdefault(r.get("name"), []).append(r)
+        if r.get("name") is None or r.get("start") is None:
+            unparseable.append(r)
+        else:
+            by_name.setdefault(r["name"], []).append(r)
     solns = {j["name"]: job_solution(read_time, j,
                                      by_name.get(j["name"]))
              for j in jobs}
@@ -146,6 +153,7 @@ def solution(read_time: float, jobs: list[dict],
         "extra": [r for s in solns.values() for r in s["extra"]],
         "incomplete": [r for s in solns.values()
                        for r in s["incomplete"]],
+        "unparseable": unparseable,
         "read-time": read_time,
     }
 

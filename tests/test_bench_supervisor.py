@@ -47,6 +47,24 @@ def test_supervisor_happy_path():
         assert "error" not in out[block], out[block]
     assert out["north_star"]["invalid_found"] >= 1
     assert out["generator"]["value"] > 0
+    # shape-honest ratios: scaled-down shapes (T < 5000) must NOT be
+    # divided by the full-shape target — report null + the real shape
+    # (round 4's 12.86x-vs-baseline was pure shape artifact)
+    assert out["vs_baseline"] is None
+    assert out["shape"] == {"B": 2, "T": 128, "K": 8}
+    assert out["north_star"]["vs_baseline"] is None
+    assert out["north_star"]["shape"]["T"] == 128
+
+
+def test_vs_baseline_only_at_target_shape():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("bench_mod", BENCH)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    assert bench._vs_baseline(300.0, 166.7, 5000) == 1.8
+    assert bench._vs_baseline(300.0, 166.7, 8000) == 1.8
+    assert bench._vs_baseline(2000.0, 166.7, 512) is None
+    assert bench._vs_baseline(2000.0, 166.7, 128) is None
 
 
 def test_supervisor_child_timeout_falls_back_to_cpu():

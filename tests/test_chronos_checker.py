@@ -215,3 +215,36 @@ def test_chronos_test_default_workload_is_schedule():
     legacy = chronos.chronos_test({"ssh": {"dummy": True},
                                    "workload": "jobs"})
     assert legacy["workload"] == "jobs"
+
+
+def test_parse_run_file_garbage_name_is_unmatchable_not_fatal():
+    """A corrupt/partial first line must parse to name None (the run
+    then surfaces as extra/unparseable) instead of raising out of the
+    until-ok final read forever."""
+    r = chronos.parse_run_file("n1", "garbage\n2026-01-01T00:00:10Z")
+    assert r["name"] is None and r["start"] == "2026-01-01T00:00:10Z"
+
+
+def test_truncated_timestamps_parse_to_none_not_crash():
+    """A partially-written run file most plausibly truncates a `date`
+    line; the parse layer must return None (run -> dropped/incomplete)
+    rather than handing the checker an unparseable timestamp."""
+    r = chronos.parse_run_file("n1", "12\n2026-01-01T00:0")
+    assert r["name"] == 12 and r["start"] is None
+    r2 = chronos.parse_run_file(
+        "n1", "12\n2026-01-01T00:00:10,5+00:00\n2026-01-")
+    assert r2["start"] is not None and r2["end"] is None
+    # and such runs flow through job_solution without raising
+    s = cc.job_solution(400.0, JOB, [dict(r, name=1)])
+    assert s["valid?"] is False   # no usable runs: targets missed
+
+
+def test_solution_surfaces_unparseable_runs():
+    runs = [run(1, 100.0), run(1, 160.0), run(1, 220.0),
+            {"name": None, "node": "n1", "start": 100.0, "end": 102.0},
+            # corrupt START line (name intact): equally unclassifiable
+            {"name": 1, "node": "n2", "start": None, "end": 163.0}]
+    soln = cc.solution(400.0, [JOB], runs)
+    assert soln["valid?"] is True            # corrupt file != missed job
+    assert len(soln["unparseable"]) == 2
+    assert {r["node"] for r in soln["unparseable"]} == {"n1", "n2"}

@@ -586,3 +586,30 @@ def test_analyze_store_stored_resume(tmp_path, capsys):
     rc = cli.analyze_store(store, checker="stored", resume=True)
     assert rc == 0
     assert "nothing to resume" in capsys.readouterr().err
+
+
+def test_analyze_store_leaves_environ_alone(tmp_path, monkeypatch):
+    """The accelerator-probe pipelining decision flows to
+    iter_encode_chunks via its `processes` parameter, NOT by mutating
+    os.environ for the rest of the process."""
+    import os
+    from jepsen_tpu import devices as devmod, ingest
+
+    monkeypatch.delenv("JEPSEN_TPU_PIPELINE", raising=False)
+    monkeypatch.setattr(devmod, "accelerator_available", lambda: True)
+    seen = {}
+    real = ingest.iter_encode_chunks
+
+    def spy(run_dirs, checker="append", chunk=64, processes=None,
+            info=None):
+        seen["processes"] = processes
+        return real(run_dirs, checker=checker, chunk=chunk,
+                    processes=0, info=info)   # serial: keep test fast
+
+    monkeypatch.setattr(ingest, "iter_encode_chunks", spy)
+    store = Store(tmp_path / "store")
+    make_run(store, "e", "20200101T000000",
+             synth_append_history(T=30, K=4, seed=3))
+    assert cli.analyze_store(store, checker="append") == 0
+    assert seen["processes"] == max(1, os.cpu_count() or 1)
+    assert "JEPSEN_TPU_PIPELINE" not in os.environ

@@ -276,3 +276,25 @@ def test_cycle_with_sleeps_emits_later_elements():
     assert "start" in fs and "stop" in fs
     # and it keeps cycling: several laps fit in the time limit
     assert fs.count("start") >= 2
+
+
+def test_fn_arity_cache_hits_bound_methods():
+    """Bound methods produce a fresh object per attribute access; the
+    arity cache must key on __func__ so they still hit."""
+    from jepsen_tpu.generator import _call_fn, _fn_arity
+
+    class Emitter:
+        def emit(self, test, ctx):
+            return {"type": "invoke", "f": "x", "value": None}
+
+        def emit0(self):
+            return {"type": "invoke", "f": "y", "value": None}
+
+    e = Emitter()
+    assert _call_fn(e.emit, {}, None)["f"] == "x"
+    assert Emitter.emit in _fn_arity          # cached on the function
+    assert _fn_arity[Emitter.emit] == 2       # call arity: self bound
+    assert _call_fn(e.emit0, {}, None)["f"] == "y"
+    assert _fn_arity[Emitter.emit0] == 0
+    # a second binding (fresh method object) is a cache hit path
+    assert _call_fn(Emitter().emit, {}, None)["f"] == "x"

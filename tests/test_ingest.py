@@ -96,3 +96,38 @@ class TestIterEncodeChunks:
         (d1, e1), (d2, e2) = parts[0]
         assert d1 == good and e1.n > 0
         assert d2 == bad and isinstance(e2, Exception)
+
+
+class TestPipelineOverlap:
+    def test_overlap_seconds_intersection(self):
+        ov = ingest.overlap_seconds
+        assert ov([], [(0, 1)]) == 0.0
+        assert ov([(0, 1)], [(2, 3)]) == 0.0
+        assert ov([(0, 2)], [(1, 3)]) == pytest.approx(1.0)
+        # overlapping input spans must not double-count
+        assert ov([(0, 2), (1, 3)], [(0, 10)]) == pytest.approx(3.0)
+        assert ov([(0, 1), (2, 3)], [(0.5, 2.5)]) == pytest.approx(1.0)
+
+    def test_pipelined_sweep_measures_real_overlap(self, tmp_path):
+        """The round-4 flagship claim, proven without a multicore
+        host: a slow fake device sweep (sleep per chunk) over
+        iter_encode_chunks with 2 spawn workers must show worker
+        parse spans intersecting device windows — measured overlap,
+        not inferred from end-to-end subtraction."""
+        import time as _t
+        dirs = [write_run(tmp_path, f"r{i}",
+                          synth.synth_append_history(T=600, K=12,
+                                                     seed=i))
+                for i in range(6)]
+        info: dict = {}
+        dev_spans = []
+        for part in ingest.iter_encode_chunks(dirs, chunk=1,
+                                              processes=2, info=info):
+            assert len(part) == 1
+            t0 = _t.monotonic()     # same clock as parse_spans
+            _t.sleep(0.4)           # the fake accelerator dispatch
+            dev_spans.append((t0, _t.monotonic()))
+        assert info["pooled"] is True
+        assert len(info["parse_spans"]) == 6
+        overlap = ingest.overlap_seconds(info["parse_spans"], dev_spans)
+        assert overlap > 0.0, (info["parse_spans"], dev_spans)
