@@ -531,10 +531,16 @@ class Linearizable(Checker):
     verdicts only ever degrade to the oracle, never diverge from it."""
 
     def __init__(self, m: model.Model | None = None,
-                 algorithm: str = "competition", backend: str = "auto"):
+                 algorithm: str = "competition", backend: str = "auto",
+                 frontier: int | None = None):
         self.model = m if m is not None else model.cas_register()
         self.algorithm = algorithm
         self.backend = backend
+        # bounded-frontier arena size; None = JEPSEN_TPU_FRONTIER or 512
+        if frontier is None:
+            import os
+            frontier = int(os.environ.get("JEPSEN_TPU_FRONTIER", 512))
+        self.frontier = frontier
 
     def _cpu(self, history: list) -> dict:
         from . import knossos
@@ -599,8 +605,27 @@ class Linearizable(Checker):
                 dense_idx.append(i)
             except kenc.EncodingError:
                 try:
-                    front_encs.append(kenc.encode_register_history(hs))
-                    front_idx.append(i)
+                    enc = kenc.encode_register_history(hs)
+                    # Feasibility gate: every simultaneously-open
+                    # write or unknown-value read doubles the frontier
+                    # (they apply in any order); open cas ops and
+                    # known-value reads prune on state mismatch —
+                    # empirically contributing about half a doubling
+                    # each. If the estimated closure can't fit the
+                    # arena, the kernel would burn a full device pass
+                    # only to report overflow (round 4's
+                    # tiers={"wgl": 8}); predictably-infeasible
+                    # histories go straight to the oracle. The
+                    # kernel's own overflow fallback still catches the
+                    # ones the estimate admits.
+                    half_doublings = (2 * enc.uncond_peak
+                                      + (enc.n_slots - enc.uncond_peak))
+                    budget = 2 * (max(self.frontier, 1).bit_length() - 1)
+                    if half_doublings > budget:
+                        cpu_idx.append(i)
+                    else:
+                        front_encs.append(enc)
+                        front_idx.append(i)
                 except kenc.EncodingError:
                     cpu_idx.append(i)
         results: list[dict | None] = [None] * len(histories)
@@ -610,7 +635,8 @@ class Linearizable(Checker):
                 results[i] = r
         if front_encs:
             for i, r in zip(front_idx,
-                            kernels.check_encoded_batch(front_encs)):
+                            kernels.check_encoded_batch(
+                                front_encs, frontier=self.frontier)):
                 if r.get("valid?") == "unknown":
                     cpu_idx.append(i)  # overflow: exact answer from CPU
                 else:

@@ -49,6 +49,12 @@ class EncodedRegisterHistory:
     n_slots: int            # max concurrently-pending ops
     n_values: int           # interned values incl. nil
     values: list            # intern table, index -> original value
+    #: max simultaneously-open UNCONDITIONAL ops — writes, plus reads
+    #: whose return value is unknown: those apply in any order, so each
+    #: open one roughly doubles the frontier. Open cas ops and
+    #: known-value reads instead PRUNE on state mismatch.
+    #: The tiered router's feasibility signal: ~2^uncond_peak configs.
+    uncond_peak: int = 0
 
 
 def encode_register_history(raw_history: list[dict],
@@ -70,9 +76,12 @@ def encode_register_history(raw_history: list[dict],
 
     events: list[tuple[int, int, int, int, int, int]] = []
     slot_of: dict[Any, int] = {}       # process -> slot
+    kind_of: dict[int, bool] = {}      # slot -> counts as unconditional
     free: list[int] = []
     next_slot = 0
     peak = 0
+    open_uncond = 0
+    uncond_peak = 0
 
     for o in hist:
         p = o.get("process")
@@ -101,17 +110,29 @@ def encode_register_history(raw_history: list[dict],
                 known = 0 if v is None else 1
                 a1, a2 = (vid(v) if known else 0), 0
             events.append((INVOKE_EV, slot, f, a1, a2, known))
+            # writes always apply; unknown-value reads apply anywhere;
+            # cas and known-value reads prune on state mismatch
+            uncond = f == WRITE or (f == READ and not known)
+            kind_of[slot] = uncond
+            if uncond:
+                open_uncond += 1
+                uncond_peak = max(uncond_peak, open_uncond)
         elif p in slot_of:
             slot = slot_of.pop(p)
             if h.is_info(o):
-                # Return at infinity: slot stays occupied, no event.
+                # Return at infinity: slot stays occupied, no event
+                # (and, if unconditional, keeps inflating the frontier
+                # forever — uncond_peak already counts it).
                 continue
             events.append((COMPLETE_EV, slot, 0, 0, 0, 0))
+            if kind_of.pop(slot, False):
+                open_uncond -= 1
             free.append(slot)
     arr = np.asarray(events, np.int32).reshape(-1, 6)
     return EncodedRegisterHistory(
         events=arr, n_events=len(events), n_slots=max(peak, 1),
-        n_values=len(values), values=values)
+        n_values=len(values), values=values,
+        uncond_peak=uncond_peak)
 
 
 @dataclass(frozen=True)

@@ -22,23 +22,37 @@ def _op(type_: str, process: int, f: str, value=None) -> dict:
 
 def synth_register_history(n_ops: int = 100, n_procs: int = 10,
                            n_values: int = 5, info_prob: float = 0.02,
-                           seed: int = 0) -> list[dict]:
+                           seed: int = 0,
+                           max_pending: int | None = None) -> list[dict]:
     """One linearizable register history: `n_ops` read/write/cas ops
-    from `n_procs` concurrent processes."""
+    from `n_procs` concurrent processes.
+
+    `max_pending` bounds how many invocations are simultaneously open
+    (crashed `info` ops count — they stay open forever). The uniform
+    walk otherwise keeps ~all procs saturated, which is the worst case
+    for windowed checkers: real staggered workloads at high nominal
+    concurrency have much lower instantaneous overlap."""
     rng = random.Random(f"knossos-synth:{seed}")
     hist: list[dict] = []
     value = None
     free = list(range(n_procs))
     pending: list[list] = []  # [process, op, applied?, result]
+    crashed = 0               # info ops: open slots for the checker
     ops_left = n_ops
     while ops_left > 0 or pending:
         choices = []
-        if free and ops_left > 0:
+        if free and ops_left > 0 and (
+                max_pending is None
+                or len(pending) + crashed < max_pending):
             choices.append("invoke")
         if any(not p[2] for p in pending):
             choices.append("apply")
         if any(p[2] for p in pending):
             choices.append("complete")
+        if not choices:
+            # every slot crashed away under a tight max_pending: let
+            # the invoke through rather than deadlock
+            choices.append("invoke")
         action = rng.choice(choices)
         if action == "invoke":
             p = free.pop(rng.randrange(len(free)))
@@ -75,6 +89,7 @@ def synth_register_history(n_ops: int = 100, n_procs: int = 10,
             p, o = ent[0], ent[1]
             if rng.random() < info_prob:
                 hist.append(_op("info", p, o["f"], o["value"]))
+                crashed += 1
             else:
                 t, rv = ent[3]
                 hist.append(_op(t, p, o["f"], rv))
@@ -96,9 +111,12 @@ def corrupt(hist: list[dict], seed: int = 0) -> list[dict]:
 def synth_register_batch(B: int = 100, n_ops: int = 500,
                          n_procs: int = 10, n_values: int = 5,
                          info_prob: float = 0.02,
-                         seed: int = 0) -> list[list[dict]]:
+                         seed: int = 0,
+                         max_pending: int | None = None
+                         ) -> list[list[dict]]:
     """B independent per-key subhistories, etcd-shaped."""
     return [synth_register_history(n_ops=n_ops, n_procs=n_procs,
                                    n_values=n_values, info_prob=info_prob,
-                                   seed=seed * 10_000 + i)
+                                   seed=seed * 10_000 + i,
+                                   max_pending=max_pending)
             for i in range(B)]

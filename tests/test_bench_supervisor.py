@@ -54,6 +54,12 @@ def test_supervisor_happy_path():
     assert out["shape"] == {"B": 2, "T": 128, "K": 8}
     assert out["north_star"]["vs_baseline"] is None
     assert out["north_star"]["shape"]["T"] == 128
+    # the tiered knossos path must actually take device tiers: round 4
+    # recorded tiers={"wgl": 8} — 100% CPU fallback — from a synth
+    # shape no arena could ever fit
+    tiers = out["knossos"]["conc20"]["tiers"]
+    assert sum(v for k, v in tiers.items()
+               if k.startswith("tpu")) > 0, tiers
 
 
 def test_vs_baseline_only_at_target_shape():

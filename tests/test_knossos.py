@@ -410,3 +410,68 @@ class TestDenseKernel:
         r = c.check_batch({}, [good], {})[0]
         assert r["valid?"] is True
         assert r["analyzer"] == "tpu-dense"
+
+
+class TestFeasibilityGate:
+    def test_uncond_peak_counts_writes_reads_not_cas(self):
+        h = [op("invoke", p, "write", p) for p in range(3)]
+        h += [op("invoke", 10 + p, "cas", [p, p + 1]) for p in range(2)]
+        h += [op("ok", p, "write", p) for p in range(3)]
+        h += [op("ok", 10 + p, "cas", [p, p + 1]) for p in range(2)]
+        e = kenc.encode_register_history(h)
+        assert e.n_slots == 5
+        assert e.uncond_peak == 3     # the cas pair prunes, not doubles
+
+    def test_crashed_unconditional_ops_count_forever(self):
+        h = [op("invoke", p, "write", p) for p in range(4)]
+        h += [op("info", 0, "write", 0)]          # crashed: open forever
+        h += [op("ok", p, "write", p) for p in range(1, 4)]
+        h += [op("invoke", 9, "write", 9), op("ok", 9, "write", 9)]
+        e = kenc.encode_register_history(h)
+        assert e.uncond_peak == 4
+
+    def test_predictably_infeasible_skips_device_pass(self, monkeypatch):
+        """15 open writes: past the dense grid AND past any sane arena
+        (closure ~2^15) — the router must go straight to the oracle
+        instead of burning a device pass to discover overflow."""
+        h = [op("invoke", p, "write", p) for p in range(15)]
+        h += [op("ok", p, "write", p) for p in range(15)]
+        def boom(*a, **kw):
+            raise AssertionError("frontier kernel dispatched for a "
+                                 "predictably-infeasible history")
+        monkeypatch.setattr(kker, "check_encoded_batch", boom)
+        c = linearizable(CASR, backend="tpu")
+        [r] = c.check_batch({}, [h], {})
+        assert r["valid?"] is True and r["analyzer"] == "wgl"
+
+    def test_structured_chain_still_takes_frontier(self):
+        """A 16-slot cas chain has a tiny real frontier (uncond_peak 1)
+        and must keep riding the device kernel despite its slot count."""
+        h = [op("invoke", 50, "write", 0), op("ok", 50, "write", 0)]
+        h += [op("invoke", p, "cas", [p, p + 1]) for p in range(16)]
+        h += [op("ok", p, "cas", [p, p + 1]) for p in range(16)]
+        c = linearizable(CASR, backend="tpu")
+        [r] = c.check_batch({}, [h], {})
+        assert r["valid?"] is True and r["analyzer"] == "tpu-jit"
+
+    def test_frontier_budget_env_and_param(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_FRONTIER", "2048")
+        assert linearizable(CASR).frontier == 2048
+        assert linearizable(CASR, frontier=64).frontier == 64
+
+
+    def test_known_reads_count_half_not_full(self):
+        """Known-value reads prune like cas — a read-heavy batch must
+        still reach the device kernel (they cost ~half a doubling, not
+        a full one)."""
+        # 12 concurrently-open determinate reads + 1 write
+        h = [op("invoke", 99, "write", 1), op("ok", 99, "write", 1)]
+        h += [op("invoke", p, "read") for p in range(12)]
+        h += [op("invoke", 80, "write", 1), op("ok", 80, "write", 1)]
+        h += [op("ok", p, "read", 1) for p in range(12)]
+        e = kenc.encode_register_history(h)
+        assert e.uncond_peak <= 2      # reads back-filled => known
+        c = linearizable(CASR, backend="tpu")
+        [r] = c.check_batch({}, [h], {})
+        assert r["analyzer"] in ("tpu-dense", "tpu-jit")
+        assert r["valid?"] is True
