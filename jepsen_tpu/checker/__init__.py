@@ -618,10 +618,8 @@ class Linearizable(Checker):
                     # histories go straight to the oracle. The
                     # kernel's own overflow fallback still catches the
                     # ones the estimate admits.
-                    half_doublings = (2 * enc.uncond_peak
-                                      + (enc.n_slots - enc.uncond_peak))
                     budget = 2 * (max(self.frontier, 1).bit_length() - 1)
-                    if half_doublings > budget:
+                    if enc.half_doublings_peak > budget:
                         cpu_idx.append(i)
                     else:
                         front_encs.append(enc)

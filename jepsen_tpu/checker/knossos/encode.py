@@ -52,9 +52,15 @@ class EncodedRegisterHistory:
     #: max simultaneously-open UNCONDITIONAL ops — writes, plus reads
     #: whose return value is unknown: those apply in any order, so each
     #: open one roughly doubles the frontier. Open cas ops and
-    #: known-value reads instead PRUNE on state mismatch.
-    #: The tiered router's feasibility signal: ~2^uncond_peak configs.
+    #: known-value reads instead PRUNE on state mismatch (about half a
+    #: doubling each, empirically).
     uncond_peak: int = 0
+    #: max over time of (2*open_unconditional + open_conditional) —
+    #: the JOINT per-moment load in half-doublings. Summing the two
+    #: independently-attained maxima would overstate histories whose
+    #: conditional and unconditional phases don't coincide.
+    #: The tiered router's feasibility signal: ~2^(peak/2) configs.
+    half_doublings_peak: int = 0
 
 
 def encode_register_history(raw_history: list[dict],
@@ -80,8 +86,10 @@ def encode_register_history(raw_history: list[dict],
     free: list[int] = []
     next_slot = 0
     peak = 0
+    open_now = 0
     open_uncond = 0
     uncond_peak = 0
+    half_peak = 0
 
     for o in hist:
         p = o.get("process")
@@ -114,9 +122,11 @@ def encode_register_history(raw_history: list[dict],
             # cas and known-value reads prune on state mismatch
             uncond = f == WRITE or (f == READ and not known)
             kind_of[slot] = uncond
+            open_now += 1
             if uncond:
                 open_uncond += 1
                 uncond_peak = max(uncond_peak, open_uncond)
+            half_peak = max(half_peak, open_now + open_uncond)
         elif p in slot_of:
             slot = slot_of.pop(p)
             if h.is_info(o):
@@ -125,6 +135,7 @@ def encode_register_history(raw_history: list[dict],
                 # forever — uncond_peak already counts it).
                 continue
             events.append((COMPLETE_EV, slot, 0, 0, 0, 0))
+            open_now -= 1
             if kind_of.pop(slot, False):
                 open_uncond -= 1
             free.append(slot)
@@ -132,7 +143,7 @@ def encode_register_history(raw_history: list[dict],
     return EncodedRegisterHistory(
         events=arr, n_events=len(events), n_slots=max(peak, 1),
         n_values=len(values), values=values,
-        uncond_peak=uncond_peak)
+        uncond_peak=uncond_peak, half_doublings_peak=half_peak)
 
 
 @dataclass(frozen=True)

@@ -38,6 +38,9 @@ from typing import Any, Callable, Iterable
 
 log = logging.getLogger(__name__)
 
+#: module-local alias: attribute lookups cost in the per-op hot path
+_rand = random.random
+
 
 class _Pending:
     __slots__ = ()
@@ -141,6 +144,10 @@ def fill_in_op(op: dict, ctx: Context):
 class Generator:
     """Base class for combinators. Plain values need not subclass this —
     the `op`/`update` module functions lift them."""
+
+    # empty slots so hot subclasses' __slots__ actually elide __dict__
+    # (subclasses that don't declare slots still get one implicitly)
+    __slots__ = ()
 
     def op(self, test: dict, ctx: Context):
         raise NotImplementedError
@@ -606,9 +613,11 @@ def reserve(*args):
 class Mix(Generator):
     """Uniform random mixture; ignores updates (pure.clj:1020-1046)."""
 
+    __slots__ = ("gens", "i")
+
     def __init__(self, gens: list, i: int | None = None):
         self.gens = list(gens)
-        self.i = random.randrange(len(gens)) if i is None and gens else (i or 0)
+        self.i = int(_rand() * len(gens)) if i is None and gens else (i or 0)
 
     @classmethod
     def _share(cls, gens: list) -> "Mix":
@@ -617,7 +626,7 @@ class Mix(Generator):
         the per-op fast path below (keep in sync with __init__)."""
         nxt = cls.__new__(cls)
         nxt.gens = gens
-        nxt.i = random.randrange(len(gens))
+        nxt.i = int(_rand() * len(gens))
         return nxt
 
     def op(self, test, ctx):
@@ -646,6 +655,8 @@ def mix(gens):
 
 
 class Limit(Generator):
+
+    __slots__ = ("remaining", "gen")
     def __init__(self, remaining: int, gen):
         self.remaining = remaining
         self.gen = gen
@@ -683,6 +694,8 @@ def log_gen(msg):
 class Repeat(Generator):
     """Re-yield the underlying generator's op without consuming it
     (pure.clj:1075-1102). remaining < 0 means forever."""
+
+    __slots__ = ("remaining", "gen")
 
     def __init__(self, remaining: int, gen):
         self.remaining = remaining

@@ -122,3 +122,14 @@ def test_supervisor_double_failure_still_emits_json():
     assert out["value"] == 0.0
     assert "error" in out
     assert "tpu attempt" in out["error"]
+
+
+def test_profile_hook_captures_xplane_trace(tmp_path):
+    """BENCH_PROFILE_DIR must produce an actual xplane trace of the
+    north-star sweep (works on any backend — the ground-truth source
+    for measured MFU once hardware is reachable)."""
+    out = run_bench({"BENCH_PROFILE_DIR": str(tmp_path / "prof")})
+    assert out["north_star"]["invalid_found"] >= 1
+    traces = list((tmp_path / "prof").rglob("*.xplane.pb"))
+    assert traces, list((tmp_path / "prof").rglob("*"))
+    assert traces[0].stat().st_size > 0

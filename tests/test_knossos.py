@@ -475,3 +475,25 @@ class TestFeasibilityGate:
         [r] = c.check_batch({}, [h], {})
         assert r["analyzer"] in ("tpu-dense", "tpu-jit")
         assert r["valid?"] is True
+
+
+    def test_joint_peak_not_sum_of_phase_maxima(self):
+        """Disjoint phases — a 15-op cas chain, THEN 5 open writes —
+        must gate on the worst single moment (load 15), not
+        n_slots + uncond_peak = 20, which would over-route feasible
+        histories to the oracle."""
+        h = [op("invoke", 50, "write", 0), op("ok", 50, "write", 0)]
+        h += [op("invoke", p, "cas", [p, p + 1]) for p in range(15)]
+        h += [op("ok", p, "cas", [p, p + 1]) for p in range(15)]
+        h += [op("invoke", 20 + p, "write", 9) for p in range(5)]
+        h += [op("ok", 20 + p, "write", 9) for p in range(5)]
+        e = kenc.encode_register_history(h)
+        assert e.n_slots == 15               # past the dense grid
+        assert e.half_doublings_peak == 15   # phase A: 15 cond ops
+        assert e.uncond_peak == 5            # phase B writes
+        # frontier=256 -> budget 16: the joint peak (15) admits; the
+        # old sum-of-maxima (15 + 5 = 20) would have gone to the oracle
+        c = linearizable(CASR, backend="tpu", frontier=256)
+        [r] = c.check_batch({}, [h], {})
+        assert r["analyzer"] == "tpu-jit", r
+        assert r["valid?"] is True
