@@ -137,20 +137,9 @@ def encode_history(history: list[dict]) -> EncodedHistory:
     anomalies = enc.anomalies
 
     # --- pair invocations with completions; bucket txns by fate ----------
-    committed: list[tuple[dict, dict]] = []    # (invoke, ok-completion)
-    indeterminate: list[dict] = []             # invocations (no results)
-    failed: list[dict] = []
-    for inv, comp in h.pairs(history):
-        if not h.is_invoke(inv) or not h.is_client_op(inv):
-            continue
-        if not t.is_txn_op(inv):
-            continue
-        if comp is None or h.is_info(comp):
-            indeterminate.append(inv)
-        elif h.is_ok(comp):
-            committed.append((inv, comp))
-        elif h.is_fail(comp):
-            failed.append(inv)
+    # (fused single-pass pairing + filtering, shared with the wr
+    # encoder — t.bucket_txn_pairs)
+    committed, indeterminate, failed = t.bucket_txn_pairs(history)
 
     # --- key interning ----------------------------------------------------
     key_ids: dict = {}

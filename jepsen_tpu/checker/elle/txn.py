@@ -28,6 +28,50 @@ def is_txn_op(op: dict) -> bool:
     return all(isinstance(m, (list, tuple)) and len(m) == 3 for m in v)
 
 
+def bucket_txn_pairs(history: Iterable[dict]
+                     ) -> tuple[list, list, list]:
+    """Pair txn invocations with their completions in ONE pass and
+    bucket them by fate: -> (committed [(inv, ok-comp)...],
+    indeterminate [inv...], failed [inv...]), each in invocation
+    order. The fused equivalent of h.pairs() + is_invoke/is_client_op/
+    is_txn_op filtering — this touches every op of a history and sits
+    on the analyze-store/north-star ingest critical path, so both elle
+    encoders share it. Expects an indexed history (h.index) so the
+    order-restoring sorts have keys."""
+    committed: list = []
+    indeterminate: list = []
+    failed: list = []
+    pending: dict = {}                          # process -> txn invoke
+    for o in history:
+        ty = o.get("type")
+        p = o.get("process")
+        if ty == "invoke":
+            # a new invoke by p supersedes a still-open one (malformed
+            # histories only) — the old invoke never completed, so it
+            # stays visible as indeterminate, as h.pairs() has it
+            stale = pending.pop(p, None)
+            if stale is not None:
+                indeterminate.append(stale)
+            if isinstance(p, int) and is_txn_op(o):
+                pending[p] = o
+            continue
+        inv = pending.pop(p, None)
+        if inv is None:
+            continue
+        if ty == "ok":
+            committed.append((inv, o))
+        elif ty == "fail":
+            failed.append(inv)
+        else:                                   # info: crashed
+            indeterminate.append(inv)
+    indeterminate.extend(pending.values())      # open at history end
+    _inv_idx = lambda o: o.get("index", 0)
+    committed.sort(key=lambda pair: pair[0].get("index", 0))
+    indeterminate.sort(key=_inv_idx)
+    failed.sort(key=_inv_idx)
+    return committed, indeterminate, failed
+
+
 def reduce_mops(f: Callable, init: Any, history: Iterable[dict]) -> Any:
     """Fold f(state, op, [mf, k, v]) over every micro-op of every op
     (txn.clj:5-17)."""

@@ -172,20 +172,9 @@ def encode_wr_history(history: list[dict], *, sequential_keys: bool = False,
     enc = WrEncoded()
     anomalies = enc.anomalies
 
-    committed: list[tuple[dict, dict]] = []
-    indeterminate: list[dict] = []
-    failed: list[dict] = []
-    for inv, comp in h.pairs(history):
-        if not h.is_invoke(inv) or not h.is_client_op(inv):
-            continue
-        if not t.is_txn_op(inv):
-            continue
-        if comp is None or h.is_info(comp):
-            indeterminate.append(inv)
-        elif h.is_ok(comp):
-            committed.append((inv, comp))
-        elif h.is_fail(comp):
-            failed.append(inv)
+    # fused single-pass pairing + filtering (t.bucket_txn_pairs, the
+    # same ingest-critical-path hot loop the append encoder uses)
+    committed, indeterminate, failed = t.bucket_txn_pairs(history)
 
     rows: list[dict] = []
     for inv, comp in committed:
