@@ -62,11 +62,16 @@ def bucket_txn_pairs(history: Iterable[dict]
             committed.append((inv, o))
         elif ty == "fail":
             failed.append(inv)
-        else:                                   # info: crashed
+        elif ty == "info":                      # crashed
             indeterminate.append(inv)
+        # any other completion type: malformed — the invocation is
+        # consumed but bucketed nowhere, exactly as the h.pairs()
+        # formulation had it
     indeterminate.extend(pending.values())      # open at history end
-    _inv_idx = lambda o: o.get("index", 0)
-    committed.sort(key=lambda pair: pair[0].get("index", 0))
+    # strict ["index"]: an unindexed history would otherwise sort into
+    # silent completion-order row numbering — fail loudly instead
+    _inv_idx = lambda o: o["index"]
+    committed.sort(key=lambda pair: _inv_idx(pair[0]))
     indeterminate.sort(key=_inv_idx)
     failed.sort(key=_inv_idx)
     return committed, indeterminate, failed

@@ -486,3 +486,60 @@ def test_independent_wr_batched_dispatch():
     assert res["results"]["k1"]["valid?"] is True
     assert res["results"]["k2"]["valid?"] is False
     assert "internal" in res["results"]["k2"]["anomaly-types"]
+
+
+def test_bucket_txn_pairs_matches_pairs_formulation():
+    """Differential: the fused single-pass pairing must bucket exactly
+    like the h.pairs() + filter formulation it replaced, including on
+    malformed histories (orphan completions, double invokes, nemesis
+    ops, crashes, open ops at history end)."""
+    import random as _r
+
+    from jepsen_tpu import history as h
+    from jepsen_tpu.checker.elle import txn as t
+
+    def reference(history):
+        committed, indeterminate, failed = [], [], []
+        for inv, comp in h.pairs(history):
+            if not h.is_invoke(inv) or not h.is_client_op(inv):
+                continue
+            if not t.is_txn_op(inv):
+                continue
+            if comp is None or h.is_info(comp):
+                indeterminate.append(inv)
+            elif h.is_ok(comp):
+                committed.append((inv, comp))
+            elif h.is_fail(comp):
+                failed.append(inv)
+        return committed, indeterminate, failed
+
+    rng = _r.Random("bucket-pairs-differential")
+    for case in range(60):
+        hist = []
+        open_by_p: dict = {}
+        for _ in range(rng.randrange(5, 60)):
+            roll = rng.random()
+            p = rng.choice([0, 1, 2, 3, "nemesis"])
+            if roll < 0.45:
+                val = ([["append", rng.randrange(3), rng.randrange(9)]]
+                       if rng.random() < 0.8 else rng.randrange(9))
+                hist.append({"type": "invoke", "process": p,
+                             "f": "txn", "value": val})
+                open_by_p[p] = val
+            elif roll < 0.85 and p in open_by_p:
+                # includes malformed completion types (and a missing
+                # type), which must consume the invoke but bucket it
+                # nowhere — exactly like the h.pairs() formulation
+                ty = rng.choice(["ok", "fail", "info", "bogus", None])
+                o = {"type": ty, "process": p, "f": "txn",
+                     "value": open_by_p.pop(p)}
+                if ty is None:
+                    del o["type"]
+                hist.append(o)
+            else:   # orphan completion / nemesis noise
+                hist.append({"type": rng.choice(["ok", "info"]),
+                             "process": p, "f": "start", "value": None})
+        hist = h.index(hist)
+        got = t.bucket_txn_pairs(hist)
+        want = reference(hist)
+        assert got == want, (case, hist)
