@@ -493,8 +493,6 @@ def test_bucket_txn_pairs_matches_pairs_formulation():
     like the h.pairs() + filter formulation it replaced, including on
     malformed histories (orphan completions, double invokes, nemesis
     ops, crashes, open ops at history end)."""
-    import random as _r
-
     from jepsen_tpu import history as h
     from jepsen_tpu.checker.elle import txn as t
 
@@ -513,7 +511,7 @@ def test_bucket_txn_pairs_matches_pairs_formulation():
                 failed.append(inv)
         return committed, indeterminate, failed
 
-    rng = _r.Random("bucket-pairs-differential")
+    rng = random.Random("bucket-pairs-differential")
     for case in range(60):
         hist = []
         open_by_p: dict = {}

@@ -497,3 +497,28 @@ class TestFeasibilityGate:
         [r] = c.check_batch({}, [h], {})
         assert r["analyzer"] == "tpu-jit", r
         assert r["valid?"] is True
+
+
+    def test_frontier_band_differential_with_crashes(self):
+        """Shapes engineered toward the frontier band — enough
+        COMMITTED writes from a 300-value pool to bust the dense
+        grid's 64-value intern budget (cas rarely commits and failed
+        ops are stripped, so this needs ~240 ops) while max_pending
+        keeps the closure arena-sized — must agree with the WGL
+        oracle, and the frontier kernel itself (tpu-jit) must
+        actually be the tier taking them."""
+        from jepsen_tpu.checker.knossos import analysis, synth
+
+        tiers = set()
+        for case in range(6):
+            h = synth.synth_register_history(
+                n_ops=240, n_procs=20, n_values=300,
+                info_prob=0.05, seed=7000 + case, max_pending=8)
+            if case % 2:
+                h = synth.corrupt(h, seed=case)
+            c = linearizable(CASR, backend="tpu", frontier=512)
+            [dev] = c.check_batch({}, [h], {})
+            cpu = analysis(CASR, h)
+            assert dev["valid?"] == cpu["valid?"], (case, dev)
+            tiers.add(dev.get("analyzer"))
+        assert "tpu-jit" in tiers, tiers
