@@ -82,11 +82,15 @@ class CockroachDB(jdb.DB, jdb.SignalProcess, jdb.LogFiles):
 
 
 def workloads(opts: dict | None = None) -> dict:
+    from ..workloads import comments as comments_wl
     std = standard_workloads(opts)
     # cockroach's matrix: register, bank, monotonic, sequential, sets,
-    # comments (a G2 variant) — all from the shared library.
-    return {k: std[k] for k in
-            ("register", "bank", "monotonic", "sequential", "set", "g2")}
+    # g2 from the shared library, plus the suite's signature comments
+    # strict-serializability check (cockroach/comments.clj:1-160).
+    out = {k: std[k] for k in
+           ("register", "bank", "monotonic", "sequential", "set", "g2")}
+    out["comments"] = lambda: comments_wl.workload(opts)
+    return out
 
 
 def default_client(workload: str, opts: dict):

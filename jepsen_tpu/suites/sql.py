@@ -227,6 +227,14 @@ class SQLClient(jclient.Client):
                     self.conn.query(
                         f"INSERT INTO dirty (id, x) VALUES ({i}, -1) "
                         f"{noop}")
+            if self.mode == "comments":
+                # ids shard across several tables so rows land in
+                # different ranges (comments.clj:30-40)
+                from ..workloads.comments import TABLE_COUNT
+                for i in range(TABLE_COUNT):
+                    self.conn.query(
+                        f"CREATE TABLE IF NOT EXISTS comment_{i}"
+                        " (id BIGINT PRIMARY KEY, k BIGINT)")
             if self.mode == "bank":
                 # Atomic insert-if-absent seeding: account 0 holds the
                 # full total, the rest 0. Concurrent seeders can't reset
@@ -295,6 +303,8 @@ class SQLClient(jclient.Client):
             return self._dirty_reads(op)
         if mode == "table":
             return self._table(op)
+        if mode == "comments":
+            return self._comments(op)
         if mode == "monotonic":
             return self._monotonic(op)
         if mode in ("sequential", "causal-reverse"):
@@ -525,6 +535,39 @@ class SQLClient(jclient.Client):
             return {**op, "type": "ok"}
         return {**op, "type": "fail", "error": f"unknown f {op['f']!r}"}
 
+    # -- comments (strict-serializability visibility) ------------------
+
+    def _comments(self, op):
+        """comments.clj:60-81: write = blind insert of a unique id
+        into the table its id hashes to; read = one txn scanning every
+        table for the key, returning the sorted visible ids."""
+        from ..workloads.comments import TABLE_COUNT
+        v = op["value"]
+        k, val = (v.key, v.value) if independent.is_tuple(v) else (0, v)
+        lift = (lambda x: independent.tuple_(k, x)) \
+            if independent.is_tuple(v) else (lambda x: x)
+        c, d = self.conn, self.dialect
+        if op["f"] == "write":
+            id_ = int(val)
+            c.query(f"INSERT INTO comment_{id_ % TABLE_COUNT} "
+                    f"(id, k) VALUES ({id_}, {int(k)})")
+            return {**op, "type": "ok"}
+        if op["f"] == "read":
+            c.query(d.begin())
+            try:
+                ids = []
+                for i in range(TABLE_COUNT):
+                    rows = _rows(c.query(
+                        f"SELECT id FROM comment_{i} "
+                        f"WHERE k = {int(k)}"))
+                    ids += [int(r[0]) for r in rows]
+                c.query(d.commit())
+            except DBError:
+                self._try_rollback()
+                raise
+            return {**op, "type": "ok", "value": lift(sorted(ids))}
+        return {**op, "type": "fail", "error": f"unknown f {op['f']!r}"}
+
     # -- monotonic -----------------------------------------------------
 
     def _monotonic(self, op):
@@ -604,6 +647,7 @@ MODES = {
     "bank": "bank", "set": "set", "monotonic": "monotonic",
     "sequential": "sequential", "long-fork": "wr", "g2": "g2",
     "dirty-reads": "dirty-reads", "table": "table",
+    "comments": "comments",
 }
 
 

@@ -1,0 +1,85 @@
+"""Comments workload: strict-serializability write-visibility order.
+
+Counterpart of cockroachdb/src/jepsen/cockroach/comments.clj:1-160 —
+the signature check for the anomaly where T1 completes before T2
+begins, yet a reader sees T2's insert without T1's (serializable but
+not strictly serializable; the "comments appear out of order" story).
+Writers blind-insert unique ids for a key across several tables (so
+rows land in different shard ranges); readers scan all tables in one
+transaction. Replaying the history, every write that COMPLETED before
+a visible write was INVOKED must also be visible.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+from .. import generator as gen
+from .. import independent
+from ..checker import Checker
+
+#: tables the ids are sharded over (comments.clj:30-40's table-count)
+TABLE_COUNT = 10
+
+
+class CommentsChecker(Checker):
+    """comments.clj:88-141: expected[w] = writes completed before w's
+    invocation; an ok read seeing w but missing some of expected[w]
+    is a strict-serializability violation."""
+
+    def check(self, test, history, opts):
+        completed: set = set()
+        expected: dict = {}
+        for op in history:
+            if op.get("f") != "write":
+                continue
+            ty = op.get("type")
+            if ty == "invoke":
+                expected[op.get("value")] = frozenset(completed)
+            elif ty == "ok":
+                completed.add(op.get("value"))
+        errors = []
+        for op in history:
+            if op.get("type") != "ok" or op.get("f") != "read":
+                continue
+            seen = set(op.get("value") or [])
+            want: set = set()
+            for id_ in seen:
+                want |= expected.get(id_, frozenset())
+            missing = want - seen
+            if missing:
+                errors.append(
+                    {**{k: v for k, v in op.items() if k != "value"},
+                     "missing": sorted(missing),
+                     "expected-count": len(want)})
+        return {"valid?": not errors, "errors": errors[:16],
+                "error-count": len(errors)}
+
+
+def checker() -> Checker:
+    return CommentsChecker()
+
+
+def workload(opts: dict | None = None) -> dict:
+    """comments.clj:144-160: independent per-key concurrent generator,
+    blind writes drawing globally-unique ids (the id picks the table)
+    mixed with full-scan reads."""
+    opts = opts or {}
+    nodes = opts.get("nodes") or ["n1", "n2", "n3", "n4", "n5"]
+    counter = itertools.count()
+
+    def w(test=None, ctx=None):
+        return {"type": "invoke", "f": "write", "value": next(counter)}
+
+    def r(test=None, ctx=None):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    from ..checker import compose
+    return {
+        "generator": independent.concurrent_generator(
+            len(nodes), range(10_000),
+            lambda k: gen.stagger(
+                0.01, gen.limit(200, gen.mix([r, w])))),
+        "checker": independent.checker(compose({
+            "comments": CommentsChecker()})),
+    }

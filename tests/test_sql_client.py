@@ -360,3 +360,66 @@ def test_tidb_table_end_to_end(tmp_path):
 
 def test_tidb_registry_has_table():
     assert "table" in tidb.workloads({})
+
+
+# ---------------------------------------------------------------------
+# cockroach comments workload (cockroach/comments.clj:1-160):
+# strict-serializability write visibility
+# ---------------------------------------------------------------------
+
+def test_comments_checker_verdicts():
+    from jepsen_tpu.workloads.comments import CommentsChecker
+    c = CommentsChecker()
+
+    def w(id_, ty):
+        return {"type": ty, "f": "write", "value": id_, "process": 0}
+
+    def rd(seen):
+        return {"type": "ok", "f": "read", "value": seen, "process": 1}
+
+    # w0 completes BEFORE w1 is invoked; a read seeing w1 must see w0
+    hist = [w(0, "invoke"), w(0, "ok"), w(1, "invoke"), w(1, "ok")]
+    good = c.check({}, hist + [rd([0, 1]), rd([0]), rd([])], {})
+    assert good["valid?"] is True
+
+    bad = c.check({}, hist + [rd([1])], {})    # sees w1, missing w0
+    assert bad["valid?"] is False
+    assert bad["errors"][0]["missing"] == [0]
+
+    # CONCURRENT writes (w1 invoked before w0 completed): seeing only
+    # w1 is fine — no precedence established
+    conc = [w(0, "invoke"), w(1, "invoke"), w(0, "ok"), w(1, "ok")]
+    assert c.check({}, conc + [rd([1])], {})["valid?"] is True
+
+
+def test_comments_client_ops():
+    from jepsen_tpu import independent
+    with FakePGServer() as srv:
+        c, test = pg_client(srv, "comments")
+        kv = lambda f, v: {"type": "invoke", "f": f, "process": 0,
+                           "value": independent.tuple_(3, v)}
+        # ids 4 and 17 land in different comment_<i % 10> tables
+        assert c.invoke(test, kv("write", 4))["type"] == "ok"
+        assert c.invoke(test, kv("write", 17))["type"] == "ok"
+        r = c.invoke(test, kv("read", None))
+        assert r["type"] == "ok" and r["value"].value == [4, 17]
+        # another key sees nothing
+        r2 = c.invoke(test, {"type": "invoke", "f": "read", "process": 0,
+                             "value": independent.tuple_(9, None)})
+        assert r2["type"] == "ok" and r2["value"].value == []
+        c.close(test)
+
+
+def test_cockroach_comments_end_to_end(tmp_path):
+    with FakePGServer() as srv:
+        test = run_suite(tmp_path, cockroach.cockroach_test, srv,
+                         "comments", {"time-limit": 2.0})
+    r = test["results"]
+    assert r["valid?"] is True, r
+    # at least one key's comments check really ran
+    assert any(v.get("comments", {}).get("valid?") is True
+               for v in r["results"].values())
+
+
+def test_cockroach_registry_has_comments():
+    assert "comments" in cockroach.workloads({})
