@@ -50,9 +50,11 @@ def synth_register_history(n_ops: int = 100, n_procs: int = 10,
         if any(p[2] for p in pending):
             choices.append("complete")
         if not choices:
-            # every slot crashed away under a tight max_pending: let
-            # the invoke through rather than deadlock
-            choices.append("invoke")
+            # every slot crashed away under a tight max_pending: end
+            # the walk early — the cap is a hard encodability contract
+            # (crashed ops hold checker slots forever, so letting an
+            # invoke through would silently exceed it)
+            break
         action = rng.choice(choices)
         if action == "invoke":
             p = free.pop(rng.randrange(len(free)))

@@ -29,6 +29,7 @@ import random
 from .. import client as jclient
 from .. import independent
 from ..drivers import DBError, DriverError
+from ..workloads.comments import TABLE_COUNT
 
 #: Error codes whose outcome is UNKNOWN: the txn may have committed.
 #: pg 40003 = statement_completion_unknown (cockroach's "result is
@@ -230,7 +231,6 @@ class SQLClient(jclient.Client):
             if self.mode == "comments":
                 # ids shard across several tables so rows land in
                 # different ranges (comments.clj:30-40)
-                from ..workloads.comments import TABLE_COUNT
                 for i in range(TABLE_COUNT):
                     self.conn.query(
                         f"CREATE TABLE IF NOT EXISTS comment_{i}"
@@ -541,7 +541,6 @@ class SQLClient(jclient.Client):
         """comments.clj:60-81: write = blind insert of a unique id
         into the table its id hashes to; read = one txn scanning every
         table for the key, returning the sorted visible ids."""
-        from ..workloads.comments import TABLE_COUNT
         v = op["value"]
         k, val = (v.key, v.value) if independent.is_tuple(v) else (0, v)
         lift = (lambda x: independent.tuple_(k, x)) \

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import itertools
 
+from . import causal_reverse
 from .. import generator as gen
 from .. import independent
 from ..checker import Checker
@@ -25,33 +26,14 @@ TABLE_COUNT = 10
 class CommentsChecker(Checker):
     """comments.clj:88-141: expected[w] = writes completed before w's
     invocation; an ok read seeing w but missing some of expected[w]
-    is a strict-serializability violation."""
+    is a strict-serializability violation. Same precedence algebra as
+    causal-reverse (causal_reverse.clj shares it too), so the graph
+    and error scan come from that module; only the truncated error
+    rendering is comments-specific."""
 
     def check(self, test, history, opts):
-        completed: set = set()
-        expected: dict = {}
-        for op in history:
-            if op.get("f") != "write":
-                continue
-            ty = op.get("type")
-            if ty == "invoke":
-                expected[op.get("value")] = frozenset(completed)
-            elif ty == "ok":
-                completed.add(op.get("value"))
-        errors = []
-        for op in history:
-            if op.get("type") != "ok" or op.get("f") != "read":
-                continue
-            seen = set(op.get("value") or [])
-            want: set = set()
-            for id_ in seen:
-                want |= expected.get(id_, frozenset())
-            missing = want - seen
-            if missing:
-                errors.append(
-                    {**{k: v for k, v in op.items() if k != "value"},
-                     "missing": sorted(missing),
-                     "expected-count": len(want)})
+        expected = causal_reverse.precedence_graph(history)
+        errors = causal_reverse.errors(history, expected)
         return {"valid?": not errors, "errors": errors[:16],
                 "error-count": len(errors)}
 

@@ -45,8 +45,13 @@ def test_elle_batched_sweep_parity_on_device():
 
 
 def test_knossos_dense_parity_on_device():
+    # max_pending keeps every history inside the dense encoder's
+    # 14-slot budget (crashed info ops hold slots forever, so 200 ops
+    # at 5% info can exceed it otherwise); overflow ROUTING is the next
+    # test's job, this one is pure dense-kernel parity
     hists = ksynth.synth_register_batch(B=12, n_ops=200, n_procs=8,
-                                        info_prob=0.05, seed=3)
+                                        info_prob=0.05, seed=3,
+                                        max_pending=12)
     encs = [kdense.encode_dense_history(h) for h in hists]
     device = kdense.check_encoded_dense_batch(encs)
     for h, d in zip(hists, device):
