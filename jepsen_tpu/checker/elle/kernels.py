@@ -113,10 +113,12 @@ def resolve_formulation(use_pallas: bool | None = None,
     so JEPSEN_TPU_CLOSURE reaches the production analyze-store paths,
     not just the bench. Explicit arguments win; the env picks the
     default: "bf16" / "int8" pin the XLA formulations, "pallas" /
-    "pallas-int8" the fused ones. Pallas needs a single-device
-    dispatch (sharded closures stay XLA for the collectives) and a
-    per-VARIANT lowering probe — an int8-specific Mosaic regression
-    degrades to the XLA matmul instead of breaking production."""
+    "pallas-int8" opt into the fused ones. The auto default is the
+    XLA matmul pipeline — measured fastest on real v5e hardware (see
+    below). Pallas needs a single-device dispatch (sharded closures
+    stay XLA for the collectives) and a per-VARIANT lowering probe —
+    an int8-specific Mosaic regression degrades to the XLA matmul
+    instead of breaking production."""
     import os
 
     from . import pallas_square
@@ -133,10 +135,17 @@ def resolve_formulation(use_pallas: bool | None = None,
     if use_int8 is None:
         use_int8 = env in ("int8", "pallas-int8")
     if use_pallas is None:
-        if env in ("bf16", "int8") or not single_device:
-            use_pallas = False
-        else:   # "", "pallas", "pallas-int8": fuse when it lowers
+        if env in ("pallas", "pallas-int8") and single_device:
+            # explicit opt-in only: fuse when it lowers
             use_pallas = pallas_square.pallas_available(int8=use_int8)
+        else:
+            # auto default is the XLA matmul pipeline: on a real v5e
+            # the fused Pallas squaring measured 23 hist/s vs XLA's
+            # 65-74 at the 5000-txn headline shape (and lost at 1000,
+            # tied at 300) — XLA's own tiling beats the hand kernel
+            # at every production shape, so fusion stays an explicit
+            # JEPSEN_TPU_CLOSURE=pallas[-int8] experiment
+            use_pallas = False
     return bool(use_pallas), bool(use_int8)
 
 

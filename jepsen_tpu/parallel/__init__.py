@@ -90,20 +90,20 @@ def sharded_check_fn(mesh: Mesh | None, shape: K.BatchShape, *,
                      use_int8: bool | None = None):
     """Build a jitted batched checker around kernels.check_batched_impl.
     With a mesh, inputs are expected sharded over 'dp' and the closure
-    matrices are constrained to P('dp', None, 'mp'); without one, it's a
-    plain single-device jit whose closure squaring runs as the fused
-    Pallas kernel on TPU hardware (use_pallas=None resolves that
-    automatically; benchmarks pass explicit bools to compare the
-    formulations). use_int8 switches the squaring dots to
-    int8×int8→int32 — exact for the boolean closure, ~2× MXU
-    throughput on v5e — and composes with use_pallas (the VMEM fusion
-    and the arithmetic are orthogonal levers). The production default
-    flips via JEPSEN_TPU_CLOSURE once benched on hardware: "bf16" /
-    "int8" pin the XLA formulations, "pallas" / "pallas-int8" the
-    fused ones (mesh dispatches always stay XLA so the compiler can
-    insert collectives). Explicit arguments win over the env. Memoized
-    per (mesh, shape, flags) so repeated same-shape dispatches
-    (bucketed sweeps, per-key loops) compile once."""
+    matrices are constrained to P('dp', None, 'mp'); without one, it's
+    a plain single-device jit. The closure squaring defaults to the
+    XLA matmul pipeline on every backend — the formulation the v5e
+    hardware race picked (the fused Pallas kernel measured ~2.7×
+    slower at the 5000-txn headline shape; `JEPSEN_TPU_CLOSURE=
+    pallas[-int8]` re-enables it as an experiment, and benchmarks
+    pass explicit bools to race the formulations). use_int8 switches
+    the squaring dots to int8×int8→int32 — exact for the boolean
+    closure — and composes with use_pallas (the VMEM fusion and the
+    arithmetic are orthogonal levers). Mesh dispatches always stay
+    XLA so the compiler can insert collectives. Explicit arguments
+    win over the env. Memoized per (mesh, shape, flags) so repeated
+    same-shape dispatches (bucketed sweeps, per-key loops) compile
+    once."""
     if use_pallas and mesh is not None:
         # the Pallas squaring path bypasses the P('dp',None,'mp')
         # sharding constraint and would silently degrade sharded

@@ -34,6 +34,10 @@ class CommentsChecker(Checker):
     def check(self, test, history, opts):
         expected = causal_reverse.precedence_graph(history)
         errors = causal_reverse.errors(history, expected)
+        for e in errors:
+            # comments ids are ints; the shared helper repr-sorts to
+            # tolerate mixed types, which misorders e.g. [10, 2]
+            e["missing"] = sorted(e["missing"])
         return {"valid?": not errors, "errors": errors[:16],
                 "error-count": len(errors)}
 

@@ -503,22 +503,31 @@ class TestFeasibilityGate:
         """Shapes engineered toward the frontier band — enough
         COMMITTED writes from a 300-value pool to bust the dense
         grid's 64-value intern budget (cas rarely commits and failed
-        ops are stripped, so this needs ~240 ops) while max_pending
+        ops are stripped, so this needs ~260 ops) while max_pending
         keeps the closure arena-sized — must agree with the WGL
         oracle, and the frontier kernel itself (tpu-jit) must
-        actually be the tier taking them."""
+        actually be the tier taking them. info_prob is low enough
+        that the hard max_pending cap doesn't end the walk early
+        (crashed ops hold slots forever), and the self-checks below
+        pin that the band shape actually materialized: a parameter
+        or synth change that silently sends cases back to the dense
+        tier, or strips their crashes, fails loudly."""
         from jepsen_tpu.checker.knossos import analysis, synth
 
-        tiers = set()
+        tiers = []
         for case in range(6):
             h = synth.synth_register_history(
-                n_ops=240, n_procs=20, n_values=300,
-                info_prob=0.05, seed=7000 + case, max_pending=8)
+                n_ops=260, n_procs=20, n_values=300,
+                info_prob=0.01, seed=7000 + case, max_pending=8)
+            assert sum(1 for o in h if o["type"] == "invoke") == 260, \
+                "walk ended early: max_pending cap hit"
+            assert any(o["type"] == "info" for o in h), \
+                "no crashed ops: the case lost its crash coverage"
             if case % 2:
                 h = synth.corrupt(h, seed=case)
             c = linearizable(CASR, backend="tpu", frontier=512)
             [dev] = c.check_batch({}, [h], {})
             cpu = analysis(CASR, h)
             assert dev["valid?"] == cpu["valid?"], (case, dev)
-            tiers.add(dev.get("analyzer"))
-        assert "tpu-jit" in tiers, tiers
+            tiers.append(dev.get("analyzer"))
+        assert tiers.count("tpu-jit") >= 4, tiers
