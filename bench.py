@@ -419,6 +419,12 @@ def _write_synth_store(root: Path, B: int, T: int, K: int,
     return dirs
 
 
+def _native_ingest_active() -> bool:
+    """Is the C++ ingest fast path in play for append sweeps?"""
+    from jepsen_tpu import ingest, native_lib
+    return ingest.native_ingest_enabled() and native_lib.hist_lib() is not None
+
+
 def bench_north_star(n_dev: int, devices) -> dict:
     """BASELINE.json's target shape, end to end through analyze-store
     semantics: a store of 10k-op (5k-txn) list-append histories (1%
@@ -573,6 +579,9 @@ def bench_north_star(n_dev: int, devices) -> dict:
             "pipeline_overlap_measured": round(ingest.overlap_seconds(
                 pipe_info.get("parse_spans", []), dev_spans), 3),
             "pipelined": bool(pipe_info.get("pooled")),
+            # whether the C++ jsonl->tensor path (native/hist_encode.cc)
+            # carried the ingest, vs the Python encoder
+            "native_ingest": _native_ingest_active(),
             "render_secs": round(t_render, 3),
             "invalid_found": n_bad,
             "closure_rounds": rounds,

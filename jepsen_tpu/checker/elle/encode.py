@@ -83,6 +83,48 @@ def _note(anomalies: dict, name: str, witness: dict) -> None:
     anomalies.setdefault(name, []).append(witness)
 
 
+def lean_anomalies(enc: EncodedHistory) -> dict:
+    """Witnesses reduced to the environment-independent lean shape the
+    native ingest (native/hist_encode.cc) emits — ints and key names
+    only, no op dicts. Same anomaly names, counts, and order either
+    way, so persisted batch-sweep artifacts don't depend on which
+    encoder ran (the Python path's full witnesses embed op dicts the
+    native path never materializes). Call BEFORE dropping txn_ops:
+    rows are recovered from witness-op identity."""
+    if not enc.anomalies:       # clean history: skip the row-map build
+        return {}
+    row_of = {id(op): r for r, op in enumerate(enc.txn_ops)}
+
+    def row(w, k="op"):
+        return row_of.get(id(w.get(k)), -1)
+
+    out: dict = {}
+    for name, wits in enc.anomalies.items():
+        lw = []
+        for w in wits:
+            if name == "duplicate-appends":
+                lw.append({"key": w["key"], "value": w["value"],
+                           "row": row(w)})
+            elif name == "internal":
+                lw.append({"row": row(w), "key": w["mop"][1]})
+            elif name == "duplicate-elements":
+                lw.append({"key": w["key"], "row": row(w)})
+            elif name == "incompatible-order":
+                lw.append({"key": w["key"], "row": row(w, "b-op")})
+            elif name in ("G1a", "dirty-update"):
+                writer = w.get("writer") or {}
+                lw.append({"key": w["key"], "value": w["value"],
+                           "writer-index": writer.get("index", -1)})
+            elif name == "G1b":
+                lw.append({"key": w["key"], "row": row(w)})
+            elif name == "phantom-read":
+                lw.append({"key": w["key"], "value": w["value"]})
+            else:  # unknown anomaly class: pass through untouched
+                lw.append(w)
+        out[name] = lw
+    return out
+
+
 def _check_internal(txn: list, op: dict, anomalies: dict) -> None:
     """Within-txn consistency: a read must reflect the txn's own prior
     reads and appends on that key (Elle's :internal anomaly)."""

@@ -1,0 +1,107 @@
+"""ctypes bridge to the native list-append ingest (native/hist_encode.cc).
+
+`encode_history_file` parses + encodes a history.jsonl straight to an
+EncodedHistory in C++, skipping json.loads and the Python dict walk —
+the analyze-store sweep's dominant host cost (SURVEY.md §5.7). The
+native side enforces a strict parity contract (see hist_encode.cc's
+header): anything it can't reproduce byte-identically returns None and
+the caller falls back to `store.load_history_dir` + `encode_history`.
+
+Witnesses on this path are LEAN — plain-int dicts (key/value/row), no
+op dicts — matching the batch sweep's lean=True contract where
+`txn_ops` is dropped anyway. Anomaly names, counts, and note order are
+identical to the Python encoder's (differentially fuzzed in
+tests/test_native_encode.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+from pathlib import Path
+
+import numpy as np
+
+from ... import native_lib
+from .encode import EncodedHistory
+
+# anomaly row codes, per hist_encode.cc's ABI comment
+_CODES = {
+    1: "duplicate-appends",
+    2: "internal",
+    3: "duplicate-elements",
+    4: "incompatible-order",
+    5: "G1a",
+    6: "dirty-update",
+    7: "phantom-read",
+    8: "G1b",
+}
+
+
+def _np(ptr, n, dtype):
+    """Copy n elements out of a ctypes pointer into a fresh array (the
+    handle is freed right after, so views would dangle)."""
+    if n == 0:
+        return np.zeros(0, dtype)
+    return np.ctypeslib.as_array(ptr, shape=(n,)).astype(dtype, copy=True)
+
+
+def _witness(code: int, f0: int, f1: int, f2: int, pre_names: list) -> dict:
+    key = pre_names[f0] if 0 <= f0 < len(pre_names) else f0
+    if code == 1:                       # duplicate-appends
+        return {"key": key, "value": f1, "row": f2}
+    if code == 2:                       # internal (f0=row, f1=pre_key)
+        k2 = pre_names[f1] if 0 <= f1 < len(pre_names) else f1
+        return {"row": f0, "key": k2}
+    if code in (3, 4, 8):               # dup-elements / incompat / G1b
+        return {"key": key, "row": f1}
+    if code in (5, 6):                  # G1a / dirty-update
+        return {"key": key, "value": f1, "writer-index": f2}
+    return {"key": key, "value": f1}    # phantom-read
+
+
+def encode_history_file(path: str | os.PathLike) -> EncodedHistory | None:
+    """Encode one history.jsonl natively; None means "use the Python
+    path" (lib unavailable, file absent, or unrepresentable content)."""
+    L = native_lib.hist_lib()
+    if L is None:
+        return None
+    p = Path(path)
+    if not p.is_file():
+        return None
+    h = L.jt_ha_encode_file(str(p).encode())
+    if not h:
+        return None
+    try:
+        dims = (ctypes.c_int64 * 8)()
+        L.jt_ha_dims(h, dims)
+        n, n_keys, max_pos, n_app, n_rd, n_anom, _json_len, n_pre = dims
+        enc = EncodedHistory()
+        enc.n = int(n)
+        enc.n_keys = int(n_keys)
+        enc.max_pos = int(max_pos)
+        enc.appends = _np(L.jt_ha_appends(h), n_app * 3,
+                          np.int32).reshape(-1, 3)
+        enc.reads = _np(L.jt_ha_reads(h), n_rd * 3,
+                        np.int32).reshape(-1, 3)
+        enc.status = _np(L.jt_ha_status(h), n, np.int32)
+        enc.process = _np(L.jt_ha_process(h), n, np.int32)
+        enc.invoke_index = _np(L.jt_ha_invoke_index(h), n, np.int64)
+        enc.complete_index = _np(L.jt_ha_complete_index(h), n, np.int64)
+        enc.op_index = enc.complete_index
+        pre_names = json.loads(
+            L.jt_ha_pre_key_names_json(h).decode("utf-8")) if n_pre else []
+        kid_to_pre = _np(L.jt_ha_kid_to_pre(h), n_keys, np.int32)
+        enc.key_names = [pre_names[i] for i in kid_to_pre]
+        anom = _np(L.jt_ha_anomalies(h), n_anom * 4, np.int64).reshape(-1, 4)
+        for code, f0, f1, f2 in anom.tolist():
+            name = _CODES.get(code)
+            if name is None:            # ABI drift: don't guess
+                return None
+            enc.anomalies.setdefault(name, []).append(
+                _witness(code, f0, f1, f2, pre_names))
+        enc.txn_ops = []
+        return enc
+    finally:
+        L.jt_ha_free(h)

@@ -33,16 +33,38 @@ def load_history_dir(run_dir: str | os.PathLike) -> list[dict]:
     return _load(run_dir)
 
 
+def native_ingest_enabled() -> bool:
+    """One home for the JEPSEN_TPU_NATIVE_INGEST gate (default on) so
+    the sweep and the bench's reporting can't drift apart."""
+    return os.environ.get("JEPSEN_TPU_NATIVE_INGEST", "1") != "0"
+
+
 def encode_run_dir(run_dir: str | os.PathLike, checker: str = "append",
                    lean: bool = True):
     """Load + encode one run dir. With lean=True the per-row completion
     ops are dropped so only arrays cross process boundaries (witness
     rendering then reports txn row numbers instead of full ops — the
     batch sweep's flags don't carry witnesses anyway)."""
+    if checker == "append" and lean and native_ingest_enabled():
+        # C++ fast path: history.jsonl -> tensors with no Python dicts
+        # (native/hist_encode.cc). None -> fall through to the Python
+        # encoder; the native side only accepts inputs it can encode
+        # byte-identically. Lean only: this path's witnesses are the
+        # lean int shape, which the Python branch below canonicalizes
+        # to as well (encode.lean_anomalies) so persisted artifacts
+        # don't depend on which encoder ran.
+        jl = Path(run_dir) / "history.jsonl"
+        if jl.is_file():
+            from .checker.elle.native_encode import encode_history_file
+            enc = encode_history_file(jl)
+            if enc is not None:
+                return enc
     hist = load_history_dir(run_dir)
     if checker == "append":
-        from .checker.elle.encode import encode_history
+        from .checker.elle.encode import encode_history, lean_anomalies
         enc = encode_history(hist)
+        if lean:
+            enc.anomalies = lean_anomalies(enc)
     elif checker == "wr":
         from .checker.elle.wr import encode_wr_history
         enc = encode_wr_history(hist)

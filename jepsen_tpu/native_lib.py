@@ -29,20 +29,43 @@ _lib: ctypes.CDLL | None = None
 _tried = False
 
 
-def _build() -> bool:
-    src = _NATIVE_DIR / "graph_algo.cc"
-    if not src.exists():
-        return False
+def _compile_so(src: Path, so: Path) -> bool:
+    """g++ -> temp file -> atomic rename, so concurrent builders (e.g.
+    spawn-pool ingest workers all finding the lib missing) can never
+    leave a torn .so for another process to dlopen."""
+    tmp = so.with_name(f".{so.name}.{os.getpid()}.tmp")
     try:
-        _SO.parent.mkdir(parents=True, exist_ok=True)
+        so.parent.mkdir(parents=True, exist_ok=True)
         subprocess.run(
             ["g++", "-O2", "-fPIC", "-std=c++17", "-shared",
-             "-o", str(_SO), str(src)],
+             "-o", str(tmp), str(src)],
             check=True, capture_output=True, timeout=120)
+        os.replace(tmp, so)
         return True
     except (OSError, subprocess.SubprocessError) as e:
-        log.debug("native graph lib build failed: %s", e)
+        log.debug("native lib build failed (%s): %s", src.name, e)
+        tmp.unlink(missing_ok=True)
         return False
+
+
+def _load_so(src: Path, so: Path) -> ctypes.CDLL | None:
+    """Shared build-or-rebuild-then-dlopen recipe: honor the
+    JEPSEN_TPU_NO_NATIVE kill switch, rebuild when the source is newer
+    than the lib, tolerate a failed rebuild if a stale lib still loads,
+    and degrade to None on any failure."""
+    if os.environ.get("JEPSEN_TPU_NO_NATIVE"):
+        return None
+    stale = (so.exists() and src.exists()
+             and src.stat().st_mtime > so.stat().st_mtime)
+    if (not so.exists() or stale) and not (src.exists()
+                                           and _compile_so(src, so)):
+        if not so.exists():
+            return None  # a stale lib still loads; no lib doesn't
+    try:
+        return ctypes.CDLL(str(so))
+    except OSError as e:
+        log.debug("native lib load failed (%s): %s", so.name, e)
+        return None
 
 
 def lib() -> ctypes.CDLL | None:
@@ -55,18 +78,8 @@ def lib() -> ctypes.CDLL | None:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if os.environ.get("JEPSEN_TPU_NO_NATIVE"):
-            return None
-        src = _NATIVE_DIR / "graph_algo.cc"
-        stale = (_SO.exists() and src.exists()
-                 and src.stat().st_mtime > _SO.stat().st_mtime)
-        if (not _SO.exists() or stale) and not _build():
-            if not _SO.exists():
-                return None  # a stale lib still loads; no lib doesn't
-        try:
-            L = ctypes.CDLL(str(_SO))
-        except OSError as e:
-            log.debug("native graph lib load failed: %s", e)
+        L = _load_so(_NATIVE_DIR / "graph_algo.cc", _SO)
+        if L is None:
             return None
         i64p = ctypes.POINTER(ctypes.c_int64)
         u8p = ctypes.POINTER(ctypes.c_uint8)
@@ -81,6 +94,51 @@ def lib() -> ctypes.CDLL | None:
 
 def available() -> bool:
     return lib() is not None
+
+
+# -- history-ingest encoder (native/hist_encode.cc) ----------------------
+
+_HIST_SO = _NATIVE_DIR / "build" / "libjepsen_histenc.so"
+_hist_lib: ctypes.CDLL | None = None
+_hist_tried = False
+
+
+def hist_lib() -> ctypes.CDLL | None:
+    """The native history-ingest encoder (jt_ha_* ABI), built on first
+    call; None when unavailable. Same degrade-to-Python contract as
+    lib()."""
+    global _hist_lib, _hist_tried
+    if _hist_lib is not None or _hist_tried:
+        return _hist_lib
+    with _lock:
+        if _hist_lib is not None or _hist_tried:
+            return _hist_lib
+        _hist_tried = True
+        L = _load_so(_NATIVE_DIR / "hist_encode.cc", _HIST_SO)
+        if L is None:
+            return None
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        L.jt_ha_encode_file.restype = ctypes.c_void_p
+        L.jt_ha_encode_file.argtypes = [ctypes.c_char_p]
+        L.jt_ha_dims.restype = None
+        L.jt_ha_dims.argtypes = [ctypes.c_void_p, i64p]
+        for name in ("jt_ha_appends", "jt_ha_reads", "jt_ha_status",
+                     "jt_ha_process", "jt_ha_kid_to_pre"):
+            fn = getattr(L, name)
+            fn.restype = i32p
+            fn.argtypes = [ctypes.c_void_p]
+        for name in ("jt_ha_invoke_index", "jt_ha_complete_index",
+                     "jt_ha_anomalies"):
+            fn = getattr(L, name)
+            fn.restype = i64p
+            fn.argtypes = [ctypes.c_void_p]
+        L.jt_ha_pre_key_names_json.restype = ctypes.c_char_p
+        L.jt_ha_pre_key_names_json.argtypes = [ctypes.c_void_p]
+        L.jt_ha_free.restype = None
+        L.jt_ha_free.argtypes = [ctypes.c_void_p]
+        _hist_lib = L
+        return _hist_lib
 
 
 def _csr(n: int, adj: list[list[int]]) -> tuple[np.ndarray, np.ndarray] | None:

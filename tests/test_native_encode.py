@@ -1,0 +1,292 @@
+"""Differential tests: native C++ ingest vs the Python encoder.
+
+The native path (native/hist_encode.cc via checker.elle.native_encode)
+promises byte-identical tensors and identical anomaly name sequences
+for every history it accepts, and None (-> Python fallback) for
+everything else. These tests enforce both halves of that contract on
+targeted anomaly constructions, the property-fuzz generator, and the
+bench's synthetic store shape.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import native_lib
+from jepsen_tpu.checker.elle.encode import encode_history
+from jepsen_tpu.checker.elle.native_encode import encode_history_file
+from jepsen_tpu.checker.elle import synth
+
+from test_fuzz_differential import rand_append_history
+
+pytestmark = pytest.mark.skipif(
+    native_lib.hist_lib() is None,
+    reason="native hist encoder unavailable (no g++?)")
+
+
+def write_run(tmp_path, ops, name="run"):
+    d = tmp_path / name
+    d.mkdir()
+    (d / "history.jsonl").write_text(
+        "\n".join(json.dumps(o) for o in ops) + "\n")
+    return d
+
+
+def assert_parity(tmp_path, ops, name="run"):
+    """Native result must match the Python encoder exactly (tensors,
+    scalars, key interning, anomaly names/counts/order)."""
+    d = write_run(tmp_path, ops, name)
+    nat = encode_history_file(d / "history.jsonl")
+    assert nat is not None, "native path unexpectedly fell back"
+    py = encode_history(ops)
+    assert nat.n == py.n
+    assert nat.n_keys == py.n_keys
+    assert nat.max_pos == py.max_pos
+    np.testing.assert_array_equal(nat.appends, py.appends)
+    np.testing.assert_array_equal(nat.reads, py.reads)
+    np.testing.assert_array_equal(nat.status, py.status)
+    np.testing.assert_array_equal(nat.process, py.process)
+    np.testing.assert_array_equal(nat.invoke_index, py.invoke_index)
+    np.testing.assert_array_equal(nat.complete_index, py.complete_index)
+    assert nat.key_names == py.key_names
+    assert list(nat.anomalies) == list(py.anomalies)
+    for a in py.anomalies:
+        assert len(nat.anomalies[a]) == len(py.anomalies[a]), a
+    return nat, py
+
+
+def txn(i, p, mops, ty="ok", mops_inv=None):
+    inv_val = (mops_inv if mops_inv is not None
+               else [[m[0], m[1], None if m[0] == "r" else m[2]]
+                     for m in mops])
+    return [
+        {"type": "invoke", "process": p, "f": "txn", "value": inv_val,
+         "time": i * 1000, "index": 2 * i},
+        {"type": ty, "process": p, "f": "txn", "value": mops,
+         "time": i * 1000 + 500, "index": 2 * i + 1},
+    ]
+
+
+def test_empty_history(tmp_path):
+    nat, py = assert_parity(tmp_path, [])
+    assert nat.n == 0
+
+
+def test_serial_clean(tmp_path):
+    assert_parity(tmp_path, synth.synth_append_history(T=200, K=8, seed=3))
+
+
+def test_g1c_cycle(tmp_path):
+    assert_parity(tmp_path,
+                  synth.synth_append_history(T=60, K=4, seed=5, g1c=True))
+
+
+def test_g1a_and_dirty_update(tmp_path):
+    # failed append observed by a later read, with a committed append on
+    # top -> G1a + dirty-update + phantom-read for the committed one
+    ops = []
+    ops += txn(0, 0, [["append", 1, 10]], ty="fail")
+    ops += txn(1, 1, [["append", 1, 20]])
+    ops += txn(2, 2, [["r", 1, [10, 20]]])
+    nat, py = assert_parity(tmp_path, ops)
+    assert "G1a" in nat.anomalies
+    assert "dirty-update" in nat.anomalies
+
+
+def test_duplicate_appends_and_elements(tmp_path):
+    ops = []
+    ops += txn(0, 0, [["append", 1, 7]])
+    ops += txn(1, 1, [["append", 1, 7]])            # duplicate append
+    ops += txn(2, 2, [["r", 1, [7, 7]]])            # duplicate elements
+    nat, py = assert_parity(tmp_path, ops)
+    assert "duplicate-appends" in nat.anomalies
+    assert "duplicate-elements" in nat.anomalies
+
+
+def test_incompatible_order(tmp_path):
+    ops = []
+    ops += txn(0, 0, [["append", 5, 1]])
+    ops += txn(1, 1, [["append", 5, 2]])
+    ops += txn(2, 2, [["r", 5, [1, 2]]])
+    ops += txn(3, 3, [["r", 5, [2]]])               # not a prefix
+    nat, py = assert_parity(tmp_path, ops)
+    assert "incompatible-order" in nat.anomalies
+
+
+def test_internal(tmp_path):
+    # read contradicts the txn's own earlier read
+    ops = txn(0, 0, [["r", 2, [1]], ["r", 2, [1, 9]]])
+    ops = txn(1, 1, [["append", 2, 1]]) + ops
+    nat, py = assert_parity(tmp_path, ops)
+    assert "internal" in nat.anomalies
+
+
+def test_internal_suffix_form(tmp_path):
+    # txn appends then reads its own key: read must end with its append
+    ops = txn(0, 0, [["append", 3, 5], ["r", 3, [9]]])
+    nat, py = assert_parity(tmp_path, ops)
+    assert "internal" in nat.anomalies
+
+
+def test_g1b_intermediate_read(tmp_path):
+    # txn 0 appends twice (1 is intermediate); txn 1's read stops at 1
+    ops = []
+    ops += txn(0, 0, [["append", 4, 1], ["append", 4, 2]])
+    ops += txn(1, 1, [["r", 4, [1]]])
+    ops += txn(2, 2, [["r", 4, [1, 2]]])
+    nat, py = assert_parity(tmp_path, ops)
+    assert "G1b" in nat.anomalies
+
+
+def test_crashed_and_stale_invokes(tmp_path):
+    ops = []
+    ops += txn(0, 0, [["append", 1, 1]])
+    # crashed txn: invoke with info completion
+    ops += txn(1, 1, [["append", 1, 2]], ty="info",
+               mops_inv=[["append", 1, 2]])
+    # stale invoke: a second invoke by process 2 before any completion
+    ops.append({"type": "invoke", "process": 2, "f": "txn",
+                "value": [["append", 1, 3]], "index": 90})
+    ops.append({"type": "invoke", "process": 2, "f": "txn",
+                "value": [["append", 1, 4]], "index": 91})
+    # and one open invoke at history end (process 3)
+    ops.append({"type": "invoke", "process": 3, "f": "txn",
+                "value": [["r", 1, None]], "index": 92})
+    ops += txn(50, 4, [["r", 1, [1, 2]]])
+    nat, py = assert_parity(tmp_path, ops)
+    assert (nat.status == 1).sum() == 4   # info + stale + open + open
+
+
+def test_string_keys_and_nemesis_ops(tmp_path):
+    ops = []
+    ops.append({"type": "info", "process": "nemesis", "f": "start-partition",
+                "value": "all-split", "index": 0})
+    ops += txn(1, 0, [["append", "kéy", 1], ["r", "other", []]])
+    ops += txn(2, 1, [["r", "kéy", [1]]])
+    ops.append({"type": "info", "process": "nemesis", "f": "stop-partition",
+                "value": None, "index": 99})
+    nat, py = assert_parity(tmp_path, ops)
+    assert "kéy" in nat.key_names
+
+
+def test_non_txn_client_values(tmp_path):
+    # non-txn invoke values never pend (matches is_txn_op gating)
+    ops = [{"type": "invoke", "process": 0, "f": "read", "value": 42,
+            "index": 0},
+           {"type": "ok", "process": 0, "f": "read", "value": 42,
+            "index": 1}]
+    ops += txn(1, 1, [["append", 0, 1]])
+    assert_parity(tmp_path, ops)
+
+
+def test_fallback_on_float_key(tmp_path):
+    ops = txn(0, 0, [["append", 1.5, 1]])
+    d = write_run(tmp_path, ops)
+    assert encode_history_file(d / "history.jsonl") is None
+
+
+def test_fallback_on_bool_value(tmp_path):
+    ops = txn(0, 0, [["append", 1, True]])
+    d = write_run(tmp_path, ops)
+    assert encode_history_file(d / "history.jsonl") is None
+
+
+def test_fallback_on_string_read_value(tmp_path):
+    ops = txn(0, 0, [["r", 1, "abc"]])
+    d = write_run(tmp_path, ops)
+    assert encode_history_file(d / "history.jsonl") is None
+
+
+def test_fallback_on_big_int(tmp_path):
+    ops = txn(0, 0, [["append", 1, 2 ** 70]])
+    d = write_run(tmp_path, ops)
+    assert encode_history_file(d / "history.jsonl") is None
+
+
+def test_fallback_on_malformed_json(tmp_path):
+    d = tmp_path / "run"
+    d.mkdir()
+    (d / "history.jsonl").write_text('{"type": "invoke", "proc\n')
+    assert encode_history_file(d / "history.jsonl") is None
+
+
+def test_fallback_on_malformed_float_tail(tmp_path):
+    # "1.5e" parses as nothing in json.loads (raises); the native
+    # number scanner must hard-fail rather than consume it as a float
+    d = tmp_path / "run"
+    d.mkdir()
+    (d / "history.jsonl").write_text(
+        '{"type":"ok","process":0,"f":"x","value":null,"time":1.5e}\n')
+    assert encode_history_file(d / "history.jsonl") is None
+    (d / "history.jsonl").write_text(
+        '{"type":"ok","process":0,"f":"x","value":null,"time":1.}\n')
+    assert encode_history_file(d / "history.jsonl") is None
+    # well-formed floats in skipped fields stay acceptable
+    (d / "history.jsonl").write_text(
+        '{"type":"ok","process":0,"f":"x","value":null,"time":1.5e3}\n')
+    assert encode_history_file(d / "history.jsonl") is not None
+
+
+def test_fallback_on_invalid_utf8(tmp_path):
+    # Python's read_text() raises UnicodeDecodeError; native must not
+    # produce a verdict the Python path can't
+    d = tmp_path / "run"
+    d.mkdir()
+    (d / "history.jsonl").write_bytes(
+        b'{"type":"ok","process":0,"f":"x","value":"\xff"}\n')
+    assert encode_history_file(d / "history.jsonl") is None
+
+
+def test_fallback_on_exotic_line_separators(tmp_path):
+    # splitlines() splits on U+2028 even INSIDE a JSON string (then the
+    # ','-rejoin corrupts it); the native path must defer wholesale
+    d = tmp_path / "run"
+    d.mkdir()
+    (d / "history.jsonl").write_text(
+        '{"type":"invoke","process":0,"f":"txn",'
+        '"value":[["append","a b",1]]}\n')
+    assert encode_history_file(d / "history.jsonl") is None
+    (d / "history.jsonl").write_text(
+        '{"type":"ok","process":0,"f":"x","value":null}\x0c'
+        '{"type":"ok","process":1,"f":"x","value":null}\n')
+    assert encode_history_file(d / "history.jsonl") is None
+
+
+def test_fuzz_differential(tmp_path):
+    rng = random.Random(2027)
+    for trial in range(60):
+        ops = rand_append_history(
+            rng, T=rng.randrange(5, 60), K=rng.randrange(1, 6),
+            conc=rng.randrange(1, 8),
+            info_p=rng.choice([0.0, 0.05, 0.3]),
+            corrupt_p=rng.choice([0.0, 0.15, 0.5]))
+        assert_parity(tmp_path, ops, name=f"run-{trial}")
+
+
+def test_encode_run_dir_uses_native(tmp_path, monkeypatch):
+    """The ingest seam takes the native path by default, the Python
+    path under JEPSEN_TPU_NATIVE_INGEST=0 — same tensors AND the same
+    lean witness dicts either way (encode.lean_anomalies canonicalizes
+    the Python side), so persisted sweep artifacts are
+    environment-independent."""
+    from jepsen_tpu import ingest
+    rng = random.Random(404)
+    histories = [synth.synth_append_history(T=50, K=4, seed=11, g1c=True)]
+    # fuzzed histories carry G1a/phantom/incompatible-order witnesses
+    for t2 in range(6):
+        histories.append(rand_append_history(
+            rng, T=40, K=3, conc=4, info_p=0.1, corrupt_p=0.5))
+    for i, ops in enumerate(histories):
+        d = write_run(tmp_path, ops, name=f"run-{i}")
+        enc_nat = ingest.encode_run_dir(d)
+        monkeypatch.setenv("JEPSEN_TPU_NATIVE_INGEST", "0")
+        enc_py = ingest.encode_run_dir(d)
+        monkeypatch.delenv("JEPSEN_TPU_NATIVE_INGEST")
+        np.testing.assert_array_equal(enc_nat.appends, enc_py.appends)
+        np.testing.assert_array_equal(enc_nat.reads, enc_py.reads)
+        assert enc_nat.anomalies == enc_py.anomalies
+        assert enc_nat.txn_ops == [] == enc_py.txn_ops
