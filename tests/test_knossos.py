@@ -154,9 +154,10 @@ class TestRandomHistories:
 # TPU kernel parity (differential: kernel verdict == WGL verdict)
 # ---------------------------------------------------------------------------
 
-def kernel_verdict(h, frontier=256):
+def kernel_verdict(h, frontier=256, packed=None):
     enc = kenc.encode_register_history(h)
-    return kker.check_encoded_batch([enc], frontier=frontier)[0]
+    return kker.check_encoded_batch([enc], frontier=frontier,
+                                    packed=packed)[0]
 
 
 class TestKernelParity:
@@ -181,26 +182,31 @@ class TestKernelParity:
           op("invoke", 1, "read"), op("ok", 1, "read", 9)], False),
     ]
 
-    def test_golden_verdicts_on_device(self):
+    # packed=False keeps the unpacked kernel under the WGL oracle even
+    # though auto-routing sends every packable batch to the packed one
+    @pytest.mark.parametrize("packed", [False, None])
+    def test_golden_verdicts_on_device(self, packed):
         encs = [kenc.encode_register_history(h) for h, _ in self.GOLDENS]
-        results = kker.check_encoded_batch(encs)
+        results = kker.check_encoded_batch(encs, packed=packed)
         for (h, expect), r in zip(self.GOLDENS, results):
             assert r["valid?"] is expect, (h, r)
 
-    def test_differential_random(self):
+    @pytest.mark.parametrize("packed", [False, None])
+    def test_differential_random(self, packed):
         rng = random.Random(99)
         hists = [random_register_history(rng, n_ops=15, n_procs=3)
                  for _ in range(8)]
         hists += [corrupt(rng, random_register_history(
             rng, n_ops=15, n_procs=3, info_prob=0.0)) for _ in range(8)]
         cpu = [knossos.wgl(CASR, h)["valid?"] for h in hists]
-        tpu = [kernel_verdict(h)["valid?"] for h in hists]
+        tpu = [kernel_verdict(h, packed=packed)["valid?"] for h in hists]
         assert cpu == tpu
 
-    def test_overflow_degrades_to_unknown(self):
+    @pytest.mark.parametrize("packed", [False, None])
+    def test_overflow_degrades_to_unknown(self, packed):
         h = [op("invoke", p, "write", p) for p in range(8)] + \
             [op("ok", p, "write", p) for p in range(8)]
-        r = kernel_verdict(h, frontier=4)
+        r = kernel_verdict(h, frontier=4, packed=packed)
         assert r["valid?"] == "unknown"
 
     def test_unencodable_raises(self):
@@ -531,3 +537,75 @@ class TestFeasibilityGate:
             assert dev["valid?"] == cpu["valid?"], (case, dev)
             tiers.append(dev.get("analyzer"))
         assert tiers.count("tpu-jit") >= 4, tiers
+
+
+# ---------------------------------------------------------------------------
+# Packed-kernel parity (packed int32 configs vs unpacked vs WGL)
+# ---------------------------------------------------------------------------
+
+class TestPackedKernelParity:
+    def _verdicts(self, hists, frontier=256):
+        import jax.numpy as jnp
+        from jepsen_tpu.checker.knossos import packed as kpk
+        encs = [kenc.encode_register_history(h) for h in hists]
+        batch = kenc.pack_register_batch(encs)
+        shape = batch["shape"]
+        assert all(kpk.packable(e.n_values, shape.n_slots) for e in encs)
+        valid, ovf = kpk.check_batch_device_packed(
+            jnp.asarray(batch["events"]), frontier=frontier,
+            n_slots=shape.n_slots)
+        return [("unknown" if o else bool(v))
+                for v, o in zip(list(valid), list(ovf))]
+
+    def test_goldens_packed(self):
+        hists = [h for h, _ in TestKernelParity.GOLDENS]
+        got = self._verdicts(hists)
+        for (h, expect), v in zip(TestKernelParity.GOLDENS, got):
+            assert v is expect, (h, v)
+
+    def test_differential_random_packed(self):
+        rng = random.Random(1234)
+        hists = [random_register_history(rng, n_ops=20, n_procs=4)
+                 for _ in range(10)]
+        hists += [corrupt(rng, random_register_history(
+            rng, n_ops=20, n_procs=4, info_prob=0.0)) for _ in range(10)]
+        cpu = [knossos.wgl(CASR, h)["valid?"] for h in hists]
+        assert self._verdicts(hists) == cpu
+
+    def test_packed_matches_unpacked_including_overflow(self):
+        # a tiny frontier forces overflow on busy histories: both
+        # kernels must degrade to "unknown" on the SAME histories
+        import jax.numpy as jnp
+        rng = random.Random(555)
+        hists = [random_register_history(rng, n_ops=30, n_procs=6)
+                 for _ in range(6)]
+        encs = [kenc.encode_register_history(h) for h in hists]
+        batch = kenc.pack_register_batch(encs)
+        shape = batch["shape"]
+        ev = jnp.asarray(batch["events"])
+        from jepsen_tpu.checker.knossos import packed as kpk
+        pv, po = kpk.check_batch_device_packed(
+            ev, frontier=8, n_slots=shape.n_slots)
+        uv, uo = kker.check_batch_device(
+            ev, frontier=8, n_slots=shape.n_slots)
+        assert list(po) == list(uo)
+        for p, u, o in zip(list(pv), list(uv), list(po)):
+            if not o:
+                assert bool(p) == bool(u)
+
+    def test_packable_gate(self):
+        from jepsen_tpu.checker.knossos import packed as kpk
+        assert kpk.packable(2047, 20)
+        assert not kpk.packable(2**12, 20)
+        assert kpk.packable(2**20, 10)
+        assert not kpk.packable(2, 31)
+
+    def test_explicit_packed_downgrades_when_unpackable(self):
+        # packed=True on an unfittable batch must not alias configs:
+        # the router silently takes the unpacked kernel instead
+        rng = random.Random(31)
+        h = random_register_history(rng, n_ops=12, n_procs=2)
+        enc = kenc.encode_register_history(h)
+        enc.n_values = 2**30          # force the gate shut
+        [r] = kker.check_encoded_batch([enc], packed=True)
+        assert r["valid?"] == knossos.wgl(CASR, h)["valid?"]
