@@ -36,6 +36,7 @@ _CODES = {
     6: "dirty-update",
     7: "phantom-read",
     8: "G1b",
+    9: "duplicate-writes",
 }
 
 
@@ -47,13 +48,22 @@ def _np(ptr, n, dtype):
     return np.ctypeslib.as_array(ptr, shape=(n,)).astype(dtype, copy=True)
 
 
-def _witness(code: int, f0: int, f1: int, f2: int, pre_names: list) -> dict:
+def _witness(code: int, f0: int, f1: int, f2: int, f3: int,
+             pre_names: list, wr: bool) -> dict:
     key = pre_names[f0] if 0 <= f0 < len(pre_names) else f0
-    if code == 1:                       # duplicate-appends
-        return {"key": key, "value": f1, "row": f2}
     if code == 2:                       # internal (f0=row, f1=pre_key)
         k2 = pre_names[f1] if 0 <= f1 < len(pre_names) else f1
         return {"row": f0, "key": k2}
+    if wr:
+        if code == 5:                   # G1a: reader row + failed writer
+            return {"key": key, "value": f1, "writer-index": f2,
+                    "row": f3}
+        if code == 8:                   # G1b (f1=row, f2=value)
+            return {"key": key, "value": f2, "row": f1}
+        # duplicate-writes / phantom-read: (key, value, row)
+        return {"key": key, "value": f1, "row": f2}
+    if code == 1:                       # duplicate-appends
+        return {"key": key, "value": f1, "row": f2}
     if code in (3, 4, 8):               # dup-elements / incompat / G1b
         return {"key": key, "row": f1}
     if code in (5, 6):                  # G1a / dirty-update
@@ -76,7 +86,7 @@ def encode_history_file(path: str | os.PathLike) -> EncodedHistory | None:
     try:
         dims = (ctypes.c_int64 * 8)()
         L.jt_ha_dims(h, dims)
-        n, n_keys, max_pos, n_app, n_rd, n_anom, _json_len, n_pre = dims
+        n, n_keys, max_pos, n_app, n_rd, n_anom, json_len, _n_pre = dims
         enc = EncodedHistory()
         enc.n = int(n)
         enc.n_keys = int(n_keys)
@@ -91,16 +101,60 @@ def encode_history_file(path: str | os.PathLike) -> EncodedHistory | None:
         enc.complete_index = _np(L.jt_ha_complete_index(h), n, np.int64)
         enc.op_index = enc.complete_index
         pre_names = json.loads(
-            L.jt_ha_pre_key_names_json(h).decode("utf-8")) if n_pre else []
+            L.jt_ha_pre_key_names_json(h).decode("utf-8")) if json_len \
+            else []
         kid_to_pre = _np(L.jt_ha_kid_to_pre(h), n_keys, np.int32)
         enc.key_names = [pre_names[i] for i in kid_to_pre]
-        anom = _np(L.jt_ha_anomalies(h), n_anom * 4, np.int64).reshape(-1, 4)
-        for code, f0, f1, f2 in anom.tolist():
+        anom = _np(L.jt_ha_anomalies(h), n_anom * 5, np.int64).reshape(-1, 5)
+        for code, f0, f1, f2, f3 in anom.tolist():
             name = _CODES.get(code)
             if name is None:            # ABI drift: don't guess
                 return None
             enc.anomalies.setdefault(name, []).append(
-                _witness(code, f0, f1, f2, pre_names))
+                _witness(code, f0, f1, f2, f3, pre_names, wr=False))
+        enc.txn_ops = []
+        return enc
+    finally:
+        L.jt_ha_free(h)
+
+
+def encode_wr_history_file(path: str | os.PathLike):
+    """Native sibling of wr.encode_wr_history with DEFAULT version-order
+    flags (the analyze-store wr sweep's configuration); None means "use
+    the Python path"."""
+    from .wr import WrEncoded
+    L = native_lib.hist_lib()
+    if L is None:
+        return None
+    p = Path(path)
+    if not p.is_file():
+        return None
+    h = L.jt_wr_encode_file(str(p).encode())
+    if not h:
+        return None
+    try:
+        dims = (ctypes.c_int64 * 8)()
+        L.jt_ha_dims(h, dims)
+        n, key_count, _mp, n_edges, _nr, n_anom, json_len, _n_pre = dims
+        enc = WrEncoded()
+        enc.n = int(n)
+        enc.key_count = int(key_count)
+        edges = _np(L.jt_ha_edges(h), n_edges * 3, np.int32).reshape(-1, 3)
+        enc.edges = [(int(a), int(b), int(c)) for a, b, c in edges]
+        enc.status = _np(L.jt_ha_status(h), n, np.int32)
+        enc.process = _np(L.jt_ha_process(h), n, np.int32)
+        enc.invoke_index = _np(L.jt_ha_invoke_index(h), n, np.int64)
+        enc.complete_index = _np(L.jt_ha_complete_index(h), n, np.int64)
+        pre_names = json.loads(
+            L.jt_ha_pre_key_names_json(h).decode("utf-8")) if json_len \
+            else []
+        anom = _np(L.jt_ha_anomalies(h), n_anom * 5, np.int64).reshape(-1, 5)
+        for code, f0, f1, f2, f3 in anom.tolist():
+            name = _CODES.get(code)
+            if name is None:
+                return None
+            enc.anomalies.setdefault(name, []).append(
+                _witness(code, f0, f1, f2, f3, pre_names, wr=True))
         enc.txn_ops = []
         return enc
     finally:

@@ -164,6 +164,41 @@ def _transitive_reduction(nodes: list, adj: dict) -> dict:
     return out
 
 
+def lean_wr_anomalies(enc: WrEncoded) -> dict:
+    """Witnesses reduced to the environment-independent lean shape the
+    native wr ingest (native/hist_encode.cc) emits — the rw-register
+    sibling of encode.lean_anomalies, same contract: same names,
+    counts, and order, no op dicts, so persisted wr-sweep artifacts
+    don't depend on which encoder ran. Call BEFORE dropping txn_ops."""
+    if not enc.anomalies:
+        return {}
+    row_of = {id(op): r for r, op in enumerate(enc.txn_ops)}
+
+    def row(w):
+        return row_of.get(id(w.get("op")), -1)
+
+    out: dict = {}
+    for name, wits in enc.anomalies.items():
+        lw = []
+        for w in wits:
+            if name == "internal":
+                lw.append({"row": row(w), "key": w["mop"][1]})
+            elif name == "G1a":
+                writer = w.get("writer") or {}
+                lw.append({"key": w["key"], "value": w["value"],
+                           "writer-index": writer.get("index", -1),
+                           "row": row(w)})
+            elif name in ("duplicate-writes", "phantom-read", "G1b"):
+                lw.append({"key": w["key"], "value": w["value"],
+                           "row": row(w)})
+            elif name == "cyclic-versions":
+                lw.append({"key": w["key"]})
+            else:  # unknown anomaly class: pass through untouched
+                lw.append(w)
+        out[name] = lw
+    return out
+
+
 def encode_wr_history(history: list[dict], *, sequential_keys: bool = False,
                       linearizable_keys: bool = False,
                       wfr_keys: bool = False) -> WrEncoded:

@@ -45,18 +45,20 @@ def encode_run_dir(run_dir: str | os.PathLike, checker: str = "append",
     ops are dropped so only arrays cross process boundaries (witness
     rendering then reports txn row numbers instead of full ops — the
     batch sweep's flags don't carry witnesses anyway)."""
-    if checker == "append" and lean and native_ingest_enabled():
-        # C++ fast path: history.jsonl -> tensors with no Python dicts
-        # (native/hist_encode.cc). None -> fall through to the Python
-        # encoder; the native side only accepts inputs it can encode
-        # byte-identically. Lean only: this path's witnesses are the
-        # lean int shape, which the Python branch below canonicalizes
-        # to as well (encode.lean_anomalies) so persisted artifacts
-        # don't depend on which encoder ran.
+    if checker in ("append", "wr") and lean and native_ingest_enabled():
+        # C++ fast path: history.jsonl -> tensors/edges with no Python
+        # dicts (native/hist_encode.cc). None -> fall through to the
+        # Python encoder; the native side only accepts inputs it can
+        # encode byte-identically. Lean only: this path's witnesses are
+        # the lean int shape, which the Python branches below
+        # canonicalize to as well (encode.lean_anomalies /
+        # wr.lean_wr_anomalies) so persisted artifacts don't depend on
+        # which encoder ran.
         jl = Path(run_dir) / "history.jsonl"
         if jl.is_file():
-            from .checker.elle.native_encode import encode_history_file
-            enc = encode_history_file(jl)
+            from .checker.elle import native_encode as ne
+            enc = (ne.encode_history_file(jl) if checker == "append"
+                   else ne.encode_wr_history_file(jl))
             if enc is not None:
                 return enc
     hist = load_history_dir(run_dir)
@@ -66,8 +68,10 @@ def encode_run_dir(run_dir: str | os.PathLike, checker: str = "append",
         if lean:
             enc.anomalies = lean_anomalies(enc)
     elif checker == "wr":
-        from .checker.elle.wr import encode_wr_history
+        from .checker.elle.wr import encode_wr_history, lean_wr_anomalies
         enc = encode_wr_history(hist)
+        if lean:
+            enc.anomalies = lean_wr_anomalies(enc)
     else:
         raise ValueError(f"unknown checker {checker!r}")
     if lean:

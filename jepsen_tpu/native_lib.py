@@ -117,14 +117,26 @@ def hist_lib() -> ctypes.CDLL | None:
         L = _load_so(_NATIVE_DIR / "hist_encode.cc", _HIST_SO)
         if L is None:
             return None
+        # A stale .so that predates the current ABI must degrade to the
+        # Python encoder, not crash: _load_so tolerates rebuild failure
+        # when an old lib still loads, so gate on the exported ABI
+        # version (missing symbol == version 1) before binding.
+        try:
+            L.jt_ha_abi_version.restype = ctypes.c_int64
+            if L.jt_ha_abi_version() != 2:
+                return None
+        except AttributeError:
+            return None
         i32p = ctypes.POINTER(ctypes.c_int32)
         i64p = ctypes.POINTER(ctypes.c_int64)
         L.jt_ha_encode_file.restype = ctypes.c_void_p
         L.jt_ha_encode_file.argtypes = [ctypes.c_char_p]
+        L.jt_wr_encode_file.restype = ctypes.c_void_p
+        L.jt_wr_encode_file.argtypes = [ctypes.c_char_p]
         L.jt_ha_dims.restype = None
         L.jt_ha_dims.argtypes = [ctypes.c_void_p, i64p]
-        for name in ("jt_ha_appends", "jt_ha_reads", "jt_ha_status",
-                     "jt_ha_process", "jt_ha_kid_to_pre"):
+        for name in ("jt_ha_appends", "jt_ha_reads", "jt_ha_edges",
+                     "jt_ha_status", "jt_ha_process", "jt_ha_kid_to_pre"):
             fn = getattr(L, name)
             fn.restype = i32p
             fn.argtypes = [ctypes.c_void_p]

@@ -47,24 +47,32 @@
 //
 // ABI (ctypes, loaded by jepsen_tpu/native_lib.py):
 //   void*  jt_ha_encode_file(path)       NULL -> fall back to Python
+//   void*  jt_wr_encode_file(path)       rw-register sibling (default
+//                                        version-order flags only):
+//                                        emits dependency-edge triples
+//                                        (jt_ha_edges) instead of
+//                                        append/read tensors
 //   void   jt_ha_dims(h, int64 out[8])   n, n_keys, max_pos, n_app,
 //                                        n_rd, n_anom, pre_json_len,
 //                                        n_pre_keys
-//   const int32_t*  jt_ha_appends/reads/status/process/kid_to_pre(h)
+//   const int32_t*  jt_ha_appends/reads/edges/status/process/kid_to_pre(h)
 //   const int64_t*  jt_ha_invoke_index/complete_index(h)
-//   const int64_t*  jt_ha_anomalies(h)   rows of (code, f0, f1, f2)
+//   const int64_t*  jt_ha_anomalies(h)   rows of (code, f0, f1, f2, f3)
 //   const char*     jt_ha_pre_key_names_json(h)
 //   void   jt_ha_free(h)
 //
-// Anomaly rows (code, f0, f1, f2):
-//   1 duplicate-appends   (pre_key, value, row)
-//   2 internal            (row, pre_key, 0)
-//   3 duplicate-elements  (pre_key, row, 0)
-//   4 incompatible-order  (pre_key, b_row, 0)
-//   5 G1a                 (pre_key, value, failed_invoke_pos)
-//   6 dirty-update        (pre_key, value, failed_invoke_pos)
-//   7 phantom-read        (pre_key, value, 0)
-//   8 G1b                 (pre_key, row, 0)
+// Anomaly rows (code, f0, f1, f2, f3):
+//   1 duplicate-appends   (pre_key, value, row, 0)
+//   2 internal            (row, pre_key, 0, 0)
+//   3 duplicate-elements  (pre_key, row, 0, 0)
+//   4 incompatible-order  (pre_key, b_row, 0, 0)
+//   5 G1a                 (pre_key, value, failed_invoke_pos, row)
+//      (row = reader row in wr mode; -1 in append mode, where the
+//       reader is a version chain, not a row)
+//   6 dirty-update        (pre_key, value, failed_invoke_pos, 0)
+//   7 phantom-read        (pre_key, value, row, 0)  (row -1 in append)
+//   8 G1b                 (pre_key, row, value, 0)  (value 0 in append)
+//   9 duplicate-writes    (pre_key, value, row, 0)  (wr mode)
 
 #include <cstdint>
 #include <cstdio>
@@ -76,6 +84,7 @@
 #include <vector>
 #include <algorithm>
 #include <memory>
+#include <array>
 
 namespace {
 
@@ -93,7 +102,8 @@ struct TVal {
 };
 
 struct Mop {
-  bool is_read = false;
+  bool is_read = false;   // mf == "r"     (append: anything else writes)
+  bool is_w = false;      // mf == "w"     (wr: anything else reads)
   TVal key, val;
 };
 
@@ -383,12 +393,13 @@ struct Parser {
 
 struct Handle {
   std::vector<int32_t> appends;        // (row, kid, pos) flattened
+  std::vector<int32_t> edges;          // wr: (src, dst, type) flattened
   std::vector<int32_t> reads;
   std::vector<int32_t> status;
   std::vector<int32_t> process;
   std::vector<int64_t> invoke_index;
   std::vector<int64_t> complete_index;
-  std::vector<int64_t> anomalies;      // (code, f0, f1, f2) flattened
+  std::vector<int64_t> anomalies;      // (code, f0..f3) flattened
   std::vector<int32_t> kid_to_pre;
   std::string pre_names_json;
   int64_t n = 0, n_keys = 0, max_pos = 0;
@@ -412,6 +423,7 @@ struct Encoder {
   int32_t next_proc_id = 0;
   std::string scratch;                      // reused string decode buffers
   std::string scratch2;
+  bool wr_mode = false;   // rw-register semantics (encode_wr) vs append
 
   bool bail = false;
 
@@ -441,7 +453,7 @@ struct Encoder {
   //      semantics we don't replicate: bool/float equality, None keys,
   //      string iteration, unhashable raises)
   //  -1  hard JSON error — whole parse fails (Python json raises too)
-  int slot(Parser& ps, int role, TVal& tv, bool& is_r) {
+  int slot(Parser& ps, int role, TVal& tv, bool& is_r, bool& is_w_out) {
     ps.ws();
     if (ps.p >= ps.end) return -1;
     char c = *ps.p;
@@ -450,7 +462,8 @@ struct Encoder {
       if (!ps.str(s)) return -1;
       if (role == 0) {
         is_r = (s == "r");
-        tv.kind = VK_NULL;  // mf content beyond "r"-ness is irrelevant
+        is_w_out = (s == "w");
+        tv.kind = VK_NULL;  // only "r"/"w"-ness of mf matters
         return 1;
       }
       if (role == 1) {
@@ -590,11 +603,11 @@ struct Encoder {
           while (true) {
             if (arity < 3) {
               TVal tv;
-              bool is_r = false;
-              int rc = slot(ps, arity, tv, is_r);
+              bool is_r = false, is_w = false;
+              int rc = slot(ps, arity, tv, is_r, is_w);
               if (rc < 0) return false;
               if (rc == 0) elem_bad = true;
-              else if (arity == 0) m.is_read = is_r;
+              else if (arity == 0) { m.is_read = is_r; m.is_w = is_w; }
               else if (arity == 1) m.key = tv;
               else m.val = tv;
             } else {
@@ -613,10 +626,21 @@ struct Encoder {
           inner_bad = true;
         } else {
           // semantic type gates (Python tolerates these shapes but
-          // with object semantics the int64 maps can't replicate):
-          //   read value must be null or an all-int list
-          //   write value must be a plain int
-          if (m.is_read) {
+          // with object semantics the int64 maps can't replicate).
+          // append: mf=="r" reads null-or-int-list, all else writes
+          // ints. wr: mf=="w" writes ints, all else reads null-or-
+          // scalar-int (INT64_MIN is this module's null sentinel, so
+          // a literal INT64_MIN read value must also defer).
+          if (wr_mode) {
+            if (m.is_w) {
+              if (m.val.kind != VK_INT || m.val.i == INT64_MIN)
+                inner_bad = true;
+            } else if (m.val.kind == VK_INT) {
+              if (m.val.i == INT64_MIN) inner_bad = true;
+            } else if (m.val.kind != VK_NULL) {
+              inner_bad = true;
+            }
+          } else if (m.is_read) {
             if (m.val.kind != VK_NULL && m.val.kind != VK_ARR)
               inner_bad = true;
           } else if (m.val.kind != VK_INT) {
@@ -946,11 +970,13 @@ struct Encoder {
       wbk_row_off[n] = (uint32_t)wbk.size();
     }
 
-    auto note = [&](int64_t code, int64_t f0, int64_t f1, int64_t f2) {
+    auto note = [&](int64_t code, int64_t f0, int64_t f1, int64_t f2,
+                    int64_t f3 = 0) {
       h->anomalies.push_back(code);
       h->anomalies.push_back(f0);
       h->anomalies.push_back(f1);
       h->anomalies.push_back(f2);
+      h->anomalies.push_back(f3);
     };
 
     // --- writer_of + duplicate-appends -------------------------------
@@ -1109,11 +1135,11 @@ struct Encoder {
         if (writer_of.count(key)) continue;
         auto fit = failed_writes.find(key);
         if (fit != failed_writes.end()) {
-          note(5, c.key, v, fit->second);      // G1a
+          note(5, c.key, v, fit->second, -1);  // G1a (no reader row)
           if (i2 + 1 < c.len)
             note(6, c.key, v, fit->second);    // dirty-update
         } else {
-          note(7, c.key, v, 0);                // phantom-read
+          note(7, c.key, v, -1);               // phantom-read (no row)
         }
       }
     }
@@ -1237,11 +1263,291 @@ struct Encoder {
     js += ']';
     return h.release();
   }
+
+  // ---------------- encode_wr (mirrors wr.py's encode_wr_history ------
+  // with DEFAULT version-order flags: no wfr/sequential/linearizable
+  // sources, so the per-key version graph is the star INIT -> written
+  // values — always acyclic, no WW edges, and the final edge set is
+  // sorted+deduped, making key iteration order immaterial) -------------
+
+  static constexpr int64_t VNULL = INT64_MIN;   // null read sentinel
+  static constexpr int64_t NEVER = int64_t(1) << 30;  // NEVER_COMPLETED
+
+  Handle* encode_wr() {
+    // --- pairing + rows: identical recipe to encode() ----------------
+    std::vector<std::pair<int32_t, int32_t>> committed;
+    std::vector<int32_t> indeterminate, failed;
+    std::unordered_map<int32_t, int32_t> pending;
+    for (int32_t i = 0; i < (int32_t)ops.size(); ++i) {
+      const Op& o = ops[i];
+      if (o.type == T_INVOKE) {
+        auto it = pending.find(o.proc_id);
+        if (it != pending.end()) {
+          indeterminate.push_back(it->second);
+          pending.erase(it);
+        }
+        if (o.proc_is_int && o.is_txn) pending[o.proc_id] = i;
+        continue;
+      }
+      auto it = pending.find(o.proc_id);
+      if (it == pending.end()) continue;
+      int32_t inv = it->second;
+      pending.erase(it);
+      if (o.type == T_OK) committed.emplace_back(inv, i);
+      else if (o.type == T_FAIL) failed.push_back(inv);
+      else if (o.type == T_INFO) indeterminate.push_back(inv);
+    }
+    for (auto& kv : pending) indeterminate.push_back(kv.second);
+    auto bypos = [&](int32_t a, int32_t b) { return ops[a].pos < ops[b].pos; };
+    std::sort(committed.begin(), committed.end(),
+              [&](auto& a, auto& b) { return ops[a.first].pos < ops[b.first].pos; });
+    std::sort(indeterminate.begin(), indeterminate.end(), bypos);
+    std::sort(failed.begin(), failed.end(), bypos);
+    for (auto& c : committed)
+      if (ops[c.second].list_nontxn || ops[c.second].bad_mops)
+        return nullptr;
+    for (int32_t i : indeterminate)
+      if (ops[i].bad_mops) return nullptr;
+    for (int32_t i : failed)
+      if (ops[i].bad_mops) return nullptr;
+
+    struct Row { int32_t inv, comp; uint8_t status; };
+    std::vector<Row> rows;
+    rows.reserve(committed.size() + indeterminate.size());
+    for (auto& c : committed) rows.push_back({c.first, c.second, 0});
+    for (auto i : indeterminate) rows.push_back({i, i, 1});
+    const int32_t n = (int32_t)rows.size();
+
+    auto h = std::make_unique<Handle>();
+    h->n = n;
+    auto note = [&](int64_t code, int64_t f0, int64_t f1, int64_t f2,
+                    int64_t f3 = 0) {
+      h->anomalies.push_back(code);
+      h->anomalies.push_back(f0);
+      h->anomalies.push_back(f1);
+      h->anomalies.push_back(f2);
+      h->anomalies.push_back(f3);
+    };
+
+    // --- writer index + intermediates + duplicate-writes -------------
+    // writer_of is LAST-writer-wins here (wr.py:202 overwrites), unlike
+    // the append encoder's first-wins.
+    std::unordered_map<std::pair<int32_t, int64_t>, int32_t, PairHash>
+        writer_of;
+    // writers_by_key: key -> ordered (value -> row), last write wins
+    std::unordered_map<int32_t,
+                       std::unordered_map<int64_t, int32_t>> writers_by_key;
+    std::unordered_set<std::tuple<int32_t, int64_t, int32_t>, TripleHash>
+        intermediate;
+    {
+      std::unordered_map<int32_t, uint32_t> slot;
+      std::vector<int32_t> tmp_keys;
+      std::vector<std::vector<int64_t>> tmp_vals;
+      for (int32_t r = 0; r < n; ++r) {
+        slot.clear();
+        tmp_keys.clear();
+        tmp_vals.clear();
+        const Op& src = ops[rows[r].status == 0 ? rows[r].comp
+                                                : rows[r].inv];
+        for (uint32_t m = src.mop_off; m < src.mop_off + src.mop_len;
+             ++m) {
+          const Mop& mp = mops[m];
+          if (!mp.is_w) continue;
+          int32_t pk = intern_key(mp.key);
+          auto it = slot.find(pk);
+          uint32_t idx;
+          if (it == slot.end()) {
+            idx = (uint32_t)tmp_keys.size();
+            slot.emplace(pk, idx);
+            tmp_keys.push_back(pk);
+            tmp_vals.emplace_back();
+          } else {
+            idx = it->second;
+          }
+          tmp_vals[idx].push_back(mp.val.i);
+        }
+        for (uint32_t i2 = 0; i2 < tmp_keys.size(); ++i2) {
+          int32_t pk = tmp_keys[i2];
+          auto& vals = tmp_vals[i2];
+          for (int64_t v : vals) {
+            auto key = std::make_pair(pk, v);
+            if (writer_of.count(key))
+              note(9, pk, v, r);               // duplicate-writes
+            writer_of[key] = r;
+            writers_by_key[pk][v] = r;
+          }
+          for (size_t vi = 0; vi + 1 < vals.size(); ++vi)
+            intermediate.insert(std::make_tuple(pk, vals[vi], r));
+        }
+      }
+    }
+    std::unordered_map<std::pair<int32_t, int64_t>, int32_t, PairHash>
+        failed_writes;
+    for (int32_t fi : failed) {
+      const Op& src = ops[fi];
+      for (uint32_t m = src.mop_off; m < src.mop_off + src.mop_len; ++m) {
+        const Mop& mp = mops[m];
+        if (!mp.is_w) continue;
+        failed_writes[std::make_pair(intern_key(mp.key), mp.val.i)] =
+            src.pos;
+      }
+    }
+
+    // --- internal + external reads + G1a/phantom/G1b ------------------
+    // readers_by_key: key -> value (VNULL for nil) -> reader rows
+    std::unordered_map<int32_t,
+        std::unordered_map<int64_t, std::vector<int32_t>>> readers_by_key;
+    std::unordered_set<int32_t> keys_seen;
+    for (auto& kv : writers_by_key) keys_seen.insert(kv.first);
+    {
+      std::unordered_map<int32_t, int64_t> state;   // _check_internal
+      std::unordered_set<int32_t> written, exted;
+      std::vector<std::pair<int32_t, int64_t>> ext;  // ordered ext reads
+      for (int32_t r = 0; r < n; ++r) {
+        if (rows[r].status != 0) continue;
+        const Op& src = ops[rows[r].comp];
+        state.clear();
+        for (uint32_t m = src.mop_off; m < src.mop_off + src.mop_len;
+             ++m) {
+          const Mop& mp = mops[m];
+          int32_t pk = intern_key(mp.key);
+          int64_t v = mp.val.kind == VK_NULL ? VNULL : mp.val.i;
+          if (mp.is_w) {
+            state[pk] = v;
+          } else {
+            auto it = state.find(pk);
+            if (it != state.end() && it->second != v)
+              note(2, r, pk, 0);               // internal
+            state[pk] = v;
+          }
+        }
+        // ext_reads: first non-"w" access to a key not yet written
+        written.clear();
+        exted.clear();
+        ext.clear();
+        for (uint32_t m = src.mop_off; m < src.mop_off + src.mop_len;
+             ++m) {
+          const Mop& mp = mops[m];
+          int32_t pk = intern_key(mp.key);
+          if (mp.is_w) {
+            written.insert(pk);
+          } else if (!written.count(pk) && !exted.count(pk)) {
+            exted.insert(pk);
+            ext.emplace_back(pk, mp.val.kind == VK_NULL ? VNULL
+                                                        : mp.val.i);
+          }
+        }
+        for (auto& [pk, v] : ext) {
+          readers_by_key[pk][v].push_back(r);
+          keys_seen.insert(pk);
+          if (v == VNULL) continue;
+          auto key = std::make_pair(pk, v);
+          auto w = writer_of.find(key);
+          if (w == writer_of.end()) {
+            auto fit = failed_writes.find(key);
+            if (fit != failed_writes.end())
+              note(5, pk, v, fit->second, r);  // G1a
+            else
+              note(7, pk, v, r);               // phantom-read
+          } else if (w->second != r &&
+                     intermediate.count(
+                         std::make_tuple(pk, v, w->second))) {
+            note(8, pk, r, v);                 // G1b
+          }
+        }
+      }
+    }
+    h->n_keys = (int64_t)keys_seen.size();     // key_count
+
+    // --- dependency edges (default flags: star version graph) ---------
+    // WR: writer(v) -> each external reader of v.  RW: each reader of
+    // nil -> every writer of the key.  No WW edges (INIT has no
+    // writer).  Output = sorted unique triples, as sorted(set(edges)).
+    std::vector<std::array<int32_t, 3>> ed;
+    for (auto& [pk, by_val] : readers_by_key) {
+      auto wit = writers_by_key.find(pk);
+      for (auto& [v, rds] : by_val) {
+        if (v == VNULL) {
+          if (wit == writers_by_key.end()) continue;
+          for (auto& [v2, w2] : wit->second)
+            for (int32_t rd : rds)
+              if (rd != w2)
+                ed.push_back(std::array<int32_t, 3>{rd, w2, 2});  // RW
+        } else {
+          if (wit == writers_by_key.end()) continue;
+          auto w = wit->second.find(v);
+          if (w == wit->second.end()) continue;
+          for (int32_t rd : rds)
+            if (rd != w->second)
+              ed.push_back(std::array<int32_t, 3>{w->second, rd, 1});  // WR
+        }
+      }
+    }
+    std::sort(ed.begin(), ed.end());
+    ed.erase(std::unique(ed.begin(), ed.end()), ed.end());
+    h->edges.reserve(ed.size() * 3);
+    for (auto& e : ed) {
+      h->edges.push_back(e[0]);
+      h->edges.push_back(e[1]);
+      h->edges.push_back(e[2]);
+    }
+
+    // --- scalars (complete_index carries the effective transform) -----
+    h->status.resize(n);
+    h->process.resize(n);
+    h->invoke_index.resize(n);
+    h->complete_index.resize(n);
+    for (int32_t r = 0; r < n; ++r) {
+      h->status[r] = rows[r].status;
+      const Op& inv = ops[rows[r].inv];
+      h->process[r] = inv.proc_is_int ? (int32_t)inv.proc_int : -1;
+      h->invoke_index[r] = inv.pos;
+      h->complete_index[r] =
+          rows[r].status == 1 ? NEVER + r : ops[rows[r].comp].pos;
+    }
+
+    // --- pre-key names (same serialization as encode()) ----------------
+    std::string& js = h->pre_names_json;
+    js += '[';
+    for (size_t i2 = 0; i2 < pre_keys.size(); ++i2) {
+      if (i2) js += ',';
+      if (!pre_keys[i2].first) {
+        js += std::to_string(pre_keys[i2].second);
+      } else {
+        const std::string& s2 = strs[(size_t)pre_keys[i2].second];
+        js += '"';
+        for (unsigned char c : s2) {
+          switch (c) {
+            case '"': js += "\\\""; break;
+            case '\\': js += "\\\\"; break;
+            case '\b': js += "\\b"; break;
+            case '\f': js += "\\f"; break;
+            case '\n': js += "\\n"; break;
+            case '\r': js += "\\r"; break;
+            case '\t': js += "\\t"; break;
+            default:
+              if (c < 0x20) {
+                char esc[8];
+                snprintf(esc, sizeof esc, "\\u%04x", c);
+                js += esc;
+              } else {
+                js += (char)c;
+              }
+          }
+        }
+        js += '"';
+      }
+    }
+    js += ']';
+    return h.release();
+  }
 };
 
 }  // namespace
 
 extern "C" {
+
+int64_t jt_ha_abi_version() { return 2; }
 
 void* jt_ha_encode_file(const char* path) {
   Encoder enc;
@@ -1250,19 +1556,29 @@ void* jt_ha_encode_file(const char* path) {
   return enc.encode();
 }
 
+void* jt_wr_encode_file(const char* path) {
+  Encoder enc;
+  enc.wr_mode = true;
+  if (!enc.parse_file(path)) return nullptr;
+  if (enc.bail) return nullptr;
+  return enc.encode_wr();
+}
+
 void jt_ha_dims(void* hp, int64_t out[8]) {
   Handle* h = (Handle*)hp;
   out[0] = h->n;
   out[1] = h->n_keys;
   out[2] = h->max_pos;
-  out[3] = (int64_t)(h->appends.size() / 3);
+  out[3] = (int64_t)((h->appends.empty() ? h->edges.size()
+                                         : h->appends.size()) / 3);
   out[4] = (int64_t)(h->reads.size() / 3);
-  out[5] = (int64_t)(h->anomalies.size() / 4);
+  out[5] = (int64_t)(h->anomalies.size() / 5);
   out[6] = (int64_t)h->pre_names_json.size();
   out[7] = (int64_t)h->kid_to_pre.size();
 }
 
 const int32_t* jt_ha_appends(void* hp) { return ((Handle*)hp)->appends.data(); }
+const int32_t* jt_ha_edges(void* hp) { return ((Handle*)hp)->edges.data(); }
 const int32_t* jt_ha_reads(void* hp) { return ((Handle*)hp)->reads.data(); }
 const int32_t* jt_ha_status(void* hp) { return ((Handle*)hp)->status.data(); }
 const int32_t* jt_ha_process(void* hp) { return ((Handle*)hp)->process.data(); }

@@ -290,3 +290,116 @@ def test_encode_run_dir_uses_native(tmp_path, monkeypatch):
         np.testing.assert_array_equal(enc_nat.reads, enc_py.reads)
         assert enc_nat.anomalies == enc_py.anomalies
         assert enc_nat.txn_ops == [] == enc_py.txn_ops
+
+
+# ---------------------------------------------------------------------------
+# wr (rw-register) native encoder parity
+# ---------------------------------------------------------------------------
+
+from jepsen_tpu.checker.elle.native_encode import encode_wr_history_file
+from jepsen_tpu.checker.elle.wr import encode_wr_history, lean_wr_anomalies
+
+from test_fuzz_differential import rand_wr_history
+
+
+def assert_wr_parity(tmp_path, ops, name="run"):
+    d = write_run(tmp_path, ops, name)
+    nat = encode_wr_history_file(d / "history.jsonl")
+    assert nat is not None, "native wr path unexpectedly fell back"
+    py = encode_wr_history(ops)
+    lean = lean_wr_anomalies(py)
+    assert nat.n == py.n
+    assert nat.key_count == py.key_count
+    assert nat.edges == py.edges
+    np.testing.assert_array_equal(nat.status, py.status)
+    np.testing.assert_array_equal(nat.process, py.process)
+    np.testing.assert_array_equal(nat.invoke_index, py.invoke_index)
+    np.testing.assert_array_equal(nat.complete_index, py.complete_index)
+    assert list(nat.anomalies) == list(py.anomalies)
+    assert nat.anomalies == lean
+    return nat, py
+
+
+def wtxn(i, p, mops, ty="ok"):
+    inv_val = [[m[0], m[1], None if m[0] == "r" else m[2]] for m in mops]
+    return [
+        {"type": "invoke", "process": p, "f": "txn", "value": inv_val,
+         "time": i * 1000, "index": 2 * i},
+        {"type": ty, "process": p, "f": "txn",
+         "value": mops if ty == "ok" else None,
+         "time": i * 1000 + 500, "index": 2 * i + 1},
+    ]
+
+
+def test_wr_basic_edges(tmp_path):
+    ops = []
+    ops += wtxn(0, 0, [["w", "x", 1]])
+    ops += wtxn(1, 1, [["r", "x", 1]])          # WR edge 0 -> 1
+    ops += wtxn(2, 2, [["r", "x", None]])       # RW edge 2 -> 0
+    nat, py = assert_wr_parity(tmp_path, ops)
+    assert (0, 1, 1) in nat.edges               # WR
+    assert (2, 0, 2) in nat.edges               # RW
+
+
+def test_wr_anomalies(tmp_path):
+    ops = []
+    ops += wtxn(0, 0, [["w", "x", 1], ["w", "x", 2]])   # 1 intermediate
+    ops += wtxn(1, 1, [["r", "x", 1]])                  # G1b
+    ops += wtxn(2, 2, [["w", "y", 5]], ty="fail")
+    ops += wtxn(3, 3, [["r", "y", 5]])                  # G1a
+    ops += wtxn(4, 4, [["r", "z", 9]])                  # phantom
+    ops += wtxn(5, 0, [["w", "x", 2]])                  # duplicate write
+    ops += wtxn(6, 1, [["w", "w", 3], ["r", "w", 4]])   # internal
+    nat, py = assert_wr_parity(tmp_path, ops)
+    for a in ("G1b", "G1a", "phantom-read", "duplicate-writes",
+              "internal"):
+        assert a in nat.anomalies, a
+
+
+def test_wr_crashed_and_failed(tmp_path):
+    ops = []
+    ops += wtxn(0, 0, [["w", "x", 1]], ty="info")
+    ops += wtxn(1, 1, [["r", "x", 1]])
+    ops += wtxn(2, 2, [["w", "x", 2]])
+    nat, py = assert_wr_parity(tmp_path, ops)
+    assert (nat.status == 1).sum() == 1
+    assert nat.complete_index[(nat.status == 1).argmax()] >= 2 ** 30
+
+
+def test_wr_fallback_on_list_read(tmp_path):
+    ops = wtxn(0, 0, [["r", "x", [1, 2]]])
+    d = write_run(tmp_path, ops)
+    assert encode_wr_history_file(d / "history.jsonl") is None
+
+
+def test_wr_fuzz_differential(tmp_path):
+    rng = random.Random(777)
+    for trial in range(60):
+        ops = rand_wr_history(
+            rng, T=rng.randrange(5, 60), K=rng.randrange(1, 5),
+            conc=rng.randrange(1, 8),
+            corrupt_p=rng.choice([0.0, 0.2, 0.6]))
+        assert_wr_parity(tmp_path, ops, name=f"run-{trial}")
+
+
+def test_wr_encode_run_dir_env_independent(tmp_path, monkeypatch):
+    from jepsen_tpu import ingest
+    rng = random.Random(888)
+    for i in range(5):
+        ops = rand_wr_history(rng, T=40, K=3, conc=4, corrupt_p=0.4)
+        d = write_run(tmp_path, ops, name=f"run-{i}")
+        enc_nat = ingest.encode_run_dir(d, checker="wr")
+        monkeypatch.setenv("JEPSEN_TPU_NATIVE_INGEST", "0")
+        enc_py = ingest.encode_run_dir(d, checker="wr")
+        monkeypatch.delenv("JEPSEN_TPU_NATIVE_INGEST")
+        assert enc_nat.edges == enc_py.edges
+        assert enc_nat.anomalies == enc_py.anomalies
+        assert enc_nat.txn_ops == [] == enc_py.txn_ops
+
+
+def test_wr_fallback_on_int64_min_write(tmp_path):
+    # INT64_MIN is the native nil sentinel; a literal write of it must
+    # defer to Python rather than alias null reads
+    ops = wtxn(0, 0, [["w", "x", -2**63], ["r", "x", None]])
+    d = write_run(tmp_path, ops)
+    assert encode_wr_history_file(d / "history.jsonl") is None
