@@ -114,8 +114,10 @@ def resolve_formulation(use_pallas: bool | None = None,
     not just the bench. Explicit arguments win; the env picks the
     default: "bf16" / "int8" pin the XLA formulations, "pallas" /
     "pallas-int8" opt into the fused ones. The auto default is the
-    XLA matmul pipeline — measured fastest on real v5e hardware (see
-    below). Pallas needs a single-device dispatch (sharded closures
+    XLA **int8** matmul pipeline — int8 won the four-way race on real
+    v5e hardware AND on CPU (BENCH_r05_hw; the closure is exact in
+    either arithmetic), and XLA beat the fused Pallas kernels at every
+    production shape. Pallas needs a single-device dispatch (sharded closures
     stay XLA for the collectives) and a per-VARIANT lowering probe —
     an int8-specific Mosaic regression degrades to the XLA matmul
     instead of breaking production."""
@@ -133,7 +135,13 @@ def resolve_formulation(use_pallas: bool | None = None,
                 "pallas|pallas-int8); using the auto default", env)
         env = ""
     if use_int8 is None:
-        use_int8 = env in ("int8", "pallas-int8")
+        # auto default is int8: the boolean closure is exact in either
+        # arithmetic, and int8 won the race on BOTH measured backends —
+        # real v5e (74.3 vs 68.6 hist/s at the 5k-txn headline,
+        # BENCH_r05_hw) and CPU (1.5x at T=1024) — which the MXU's 2:1
+        # int8:bf16 throughput predicts. JEPSEN_TPU_CLOSURE=bf16 pins
+        # the old formulation.
+        use_int8 = env in ("int8", "pallas-int8") if env else True
     if use_pallas is None:
         if env in ("pallas", "pallas-int8") and single_device:
             # explicit opt-in only: fuse when it lowers

@@ -135,7 +135,7 @@ def encode_wr_history_file(path: str | os.PathLike):
     try:
         dims = (ctypes.c_int64 * 8)()
         L.jt_ha_dims(h, dims)
-        n, key_count, _mp, n_edges, _nr, n_anom, json_len, _n_pre = dims
+        n, key_count, _mp, _n_app, _n_rd, n_anom, json_len, n_edges = dims
         enc = WrEncoded()
         enc.n = int(n)
         enc.key_count = int(key_count)

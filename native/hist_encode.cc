@@ -54,7 +54,7 @@
 //                                        append/read tensors
 //   void   jt_ha_dims(h, int64 out[8])   n, n_keys, max_pos, n_app,
 //                                        n_rd, n_anom, pre_json_len,
-//                                        n_pre_keys
+//                                        n_edges (wr)
 //   const int32_t*  jt_ha_appends/reads/edges/status/process/kid_to_pre(h)
 //   const int64_t*  jt_ha_invoke_index/complete_index(h)
 //   const int64_t*  jt_ha_anomalies(h)   rows of (code, f0, f1, f2, f3)
@@ -868,16 +868,19 @@ struct Encoder {
     return !bail;
   }
 
-  // ---------------- encode (mirrors encode.py's encode_history) --------
+  // ---------------- shared encode plumbing -----------------------------
+  // Both encoders (append and wr) consume identical pairing semantics,
+  // anomaly-row framing, and pre-key-name serialization; one copy each
+  // so a fix can never land on one mode only.
 
-  // small helper: row-ordered writes-by-key
-  struct WbkEntry { int32_t key; uint32_t off, len; };
+  struct Row { int32_t inv, comp; uint8_t status; };  // 0 OK, 1 INFO
 
-  Handle* encode() {
-    // --- pairing (txn.bucket_txn_pairs) ------------------------------
-    std::vector<std::pair<int32_t, int32_t>> committed;  // (inv, comp)
-    std::vector<int32_t> indeterminate, failed;
-    std::unordered_map<int32_t, int32_t> pending;  // proc_id -> op idx
+  // bucket_txn_pairs + row construction; returns false -> fall back
+  // (an op whose mops the encoder must consume is unrepresentable).
+  bool pair_rows(std::vector<Row>& rows, std::vector<int32_t>& failed) {
+    std::vector<std::pair<int32_t, int32_t>> committed;
+    std::vector<int32_t> indeterminate;
+    std::unordered_map<int32_t, int32_t> pending;
     for (int32_t i = 0; i < (int32_t)ops.size(); ++i) {
       const Op& o = ops[i];
       if (o.type == T_INVOKE) {
@@ -899,29 +902,85 @@ struct Encoder {
       // T_OTHER: consumed, bucketed nowhere
     }
     for (auto& kv : pending) indeterminate.push_back(kv.second);
-    auto bypos = [&](int32_t a, int32_t b) { return ops[a].pos < ops[b].pos; };
+    auto bypos = [&](int32_t a, int32_t b) {
+      return ops[a].pos < ops[b].pos;
+    };
     std::sort(committed.begin(), committed.end(),
-              [&](auto& a, auto& b) { return ops[a.first].pos < ops[b.first].pos; });
+              [&](auto& a, auto& b) {
+                return ops[a.first].pos < ops[b.first].pos;
+              });
     std::sort(indeterminate.begin(), indeterminate.end(), bypos);
     std::sort(failed.begin(), failed.end(), bypos);
-
-    // --- rows: committed then indeterminate --------------------------
     // Fallback gates on ops whose mops the encoder actually consumes:
     // committed rows read the COMPLETION op's value (non-txn-shaped
     // lists make Python's unpacking raise; untypable mops we can't
     // encode), indeterminate and failed rows read their invoke's.
     for (auto& c : committed)
       if (ops[c.second].list_nontxn || ops[c.second].bad_mops)
-        return nullptr;
+        return false;
     for (int32_t i : indeterminate)
-      if (ops[i].bad_mops) return nullptr;
+      if (ops[i].bad_mops) return false;
     for (int32_t i : failed)
-      if (ops[i].bad_mops) return nullptr;
-    struct Row { int32_t inv, comp; uint8_t status; };  // 0 OK, 1 INFO
-    std::vector<Row> rows;
+      if (ops[i].bad_mops) return false;
     rows.reserve(committed.size() + indeterminate.size());
     for (auto& c : committed) rows.push_back({c.first, c.second, 0});
     for (auto i : indeterminate) rows.push_back({i, i, 1});
+    return true;
+  }
+
+  void note_row(Handle* h, int64_t code, int64_t f0, int64_t f1,
+                int64_t f2, int64_t f3 = 0) {
+    h->anomalies.push_back(code);
+    h->anomalies.push_back(f0);
+    h->anomalies.push_back(f1);
+    h->anomalies.push_back(f2);
+    h->anomalies.push_back(f3);
+  }
+
+  void serialize_pre_names(Handle* h) {
+    std::string& js = h->pre_names_json;
+    js += '[';
+    for (size_t i2 = 0; i2 < pre_keys.size(); ++i2) {
+      if (i2) js += ',';
+      if (!pre_keys[i2].first) {
+        js += std::to_string(pre_keys[i2].second);
+      } else {
+        const std::string& s2 = strs[(size_t)pre_keys[i2].second];
+        js += '"';
+        for (unsigned char c : s2) {
+          switch (c) {
+            case '"': js += "\\\""; break;
+            case '\\': js += "\\\\"; break;
+            case '\b': js += "\\b"; break;
+            case '\f': js += "\\f"; break;
+            case '\n': js += "\\n"; break;
+            case '\r': js += "\\r"; break;
+            case '\t': js += "\\t"; break;
+            default:
+              if (c < 0x20) {
+                char esc[8];
+                snprintf(esc, sizeof esc, "\\u%04x", c);
+                js += esc;
+              } else {
+                js += (char)c;
+              }
+          }
+        }
+        js += '"';
+      }
+    }
+    js += ']';
+  }
+
+  // ---------------- encode (mirrors encode.py's encode_history) --------
+
+  // small helper: row-ordered writes-by-key
+  struct WbkEntry { int32_t key; uint32_t off, len; };
+
+  Handle* encode() {
+    std::vector<Row> rows;
+    std::vector<int32_t> failed;
+    if (!pair_rows(rows, failed)) return nullptr;
     const int32_t n = (int32_t)rows.size();
 
     auto h = std::make_unique<Handle>();
@@ -972,11 +1031,7 @@ struct Encoder {
 
     auto note = [&](int64_t code, int64_t f0, int64_t f1, int64_t f2,
                     int64_t f3 = 0) {
-      h->anomalies.push_back(code);
-      h->anomalies.push_back(f0);
-      h->anomalies.push_back(f1);
-      h->anomalies.push_back(f2);
-      h->anomalies.push_back(f3);
+      note_row(h.get(), code, f0, f1, f2, f3);
     };
 
     // --- writer_of + duplicate-appends -------------------------------
@@ -1228,39 +1283,7 @@ struct Encoder {
       h->complete_index[r] = ops[rows[r].comp].pos;
     }
 
-    // --- pre-key names as JSON ---------------------------------------
-    std::string& js = h->pre_names_json;
-    js += '[';
-    for (size_t i2 = 0; i2 < pre_keys.size(); ++i2) {
-      if (i2) js += ',';
-      if (!pre_keys[i2].first) {
-        js += std::to_string(pre_keys[i2].second);
-      } else {
-        const std::string& s2 = strs[(size_t)pre_keys[i2].second];
-        js += '"';
-        for (unsigned char c : s2) {
-          switch (c) {
-            case '"': js += "\\\""; break;
-            case '\\': js += "\\\\"; break;
-            case '\b': js += "\\b"; break;
-            case '\f': js += "\\f"; break;
-            case '\n': js += "\\n"; break;
-            case '\r': js += "\\r"; break;
-            case '\t': js += "\\t"; break;
-            default:
-              if (c < 0x20) {
-                char esc[8];
-                snprintf(esc, sizeof esc, "\\u%04x", c);
-                js += esc;
-              } else {
-                js += (char)c;
-              }
-          }
-        }
-        js += '"';
-      }
-    }
-    js += ']';
+    serialize_pre_names(h.get());
     return h.release();
   }
 
@@ -1274,59 +1297,16 @@ struct Encoder {
   static constexpr int64_t NEVER = int64_t(1) << 30;  // NEVER_COMPLETED
 
   Handle* encode_wr() {
-    // --- pairing + rows: identical recipe to encode() ----------------
-    std::vector<std::pair<int32_t, int32_t>> committed;
-    std::vector<int32_t> indeterminate, failed;
-    std::unordered_map<int32_t, int32_t> pending;
-    for (int32_t i = 0; i < (int32_t)ops.size(); ++i) {
-      const Op& o = ops[i];
-      if (o.type == T_INVOKE) {
-        auto it = pending.find(o.proc_id);
-        if (it != pending.end()) {
-          indeterminate.push_back(it->second);
-          pending.erase(it);
-        }
-        if (o.proc_is_int && o.is_txn) pending[o.proc_id] = i;
-        continue;
-      }
-      auto it = pending.find(o.proc_id);
-      if (it == pending.end()) continue;
-      int32_t inv = it->second;
-      pending.erase(it);
-      if (o.type == T_OK) committed.emplace_back(inv, i);
-      else if (o.type == T_FAIL) failed.push_back(inv);
-      else if (o.type == T_INFO) indeterminate.push_back(inv);
-    }
-    for (auto& kv : pending) indeterminate.push_back(kv.second);
-    auto bypos = [&](int32_t a, int32_t b) { return ops[a].pos < ops[b].pos; };
-    std::sort(committed.begin(), committed.end(),
-              [&](auto& a, auto& b) { return ops[a.first].pos < ops[b.first].pos; });
-    std::sort(indeterminate.begin(), indeterminate.end(), bypos);
-    std::sort(failed.begin(), failed.end(), bypos);
-    for (auto& c : committed)
-      if (ops[c.second].list_nontxn || ops[c.second].bad_mops)
-        return nullptr;
-    for (int32_t i : indeterminate)
-      if (ops[i].bad_mops) return nullptr;
-    for (int32_t i : failed)
-      if (ops[i].bad_mops) return nullptr;
-
-    struct Row { int32_t inv, comp; uint8_t status; };
     std::vector<Row> rows;
-    rows.reserve(committed.size() + indeterminate.size());
-    for (auto& c : committed) rows.push_back({c.first, c.second, 0});
-    for (auto i : indeterminate) rows.push_back({i, i, 1});
+    std::vector<int32_t> failed;
+    if (!pair_rows(rows, failed)) return nullptr;
     const int32_t n = (int32_t)rows.size();
 
     auto h = std::make_unique<Handle>();
     h->n = n;
     auto note = [&](int64_t code, int64_t f0, int64_t f1, int64_t f2,
                     int64_t f3 = 0) {
-      h->anomalies.push_back(code);
-      h->anomalies.push_back(f0);
-      h->anomalies.push_back(f1);
-      h->anomalies.push_back(f2);
-      h->anomalies.push_back(f3);
+      note_row(h.get(), code, f0, f1, f2, f3);
     };
 
     // --- writer index + intermediates + duplicate-writes -------------
@@ -1506,39 +1486,7 @@ struct Encoder {
           rows[r].status == 1 ? NEVER + r : ops[rows[r].comp].pos;
     }
 
-    // --- pre-key names (same serialization as encode()) ----------------
-    std::string& js = h->pre_names_json;
-    js += '[';
-    for (size_t i2 = 0; i2 < pre_keys.size(); ++i2) {
-      if (i2) js += ',';
-      if (!pre_keys[i2].first) {
-        js += std::to_string(pre_keys[i2].second);
-      } else {
-        const std::string& s2 = strs[(size_t)pre_keys[i2].second];
-        js += '"';
-        for (unsigned char c : s2) {
-          switch (c) {
-            case '"': js += "\\\""; break;
-            case '\\': js += "\\\\"; break;
-            case '\b': js += "\\b"; break;
-            case '\f': js += "\\f"; break;
-            case '\n': js += "\\n"; break;
-            case '\r': js += "\\r"; break;
-            case '\t': js += "\\t"; break;
-            default:
-              if (c < 0x20) {
-                char esc[8];
-                snprintf(esc, sizeof esc, "\\u%04x", c);
-                js += esc;
-              } else {
-                js += (char)c;
-              }
-          }
-        }
-        js += '"';
-      }
-    }
-    js += ']';
+    serialize_pre_names(h.get());
     return h.release();
   }
 };
@@ -1569,12 +1517,11 @@ void jt_ha_dims(void* hp, int64_t out[8]) {
   out[0] = h->n;
   out[1] = h->n_keys;
   out[2] = h->max_pos;
-  out[3] = (int64_t)((h->appends.empty() ? h->edges.size()
-                                         : h->appends.size()) / 3);
+  out[3] = (int64_t)(h->appends.size() / 3);
   out[4] = (int64_t)(h->reads.size() / 3);
   out[5] = (int64_t)(h->anomalies.size() / 5);
   out[6] = (int64_t)h->pre_names_json.size();
-  out[7] = (int64_t)h->kid_to_pre.size();
+  out[7] = (int64_t)(h->edges.size() / 3);
 }
 
 const int32_t* jt_ha_appends(void* hp) { return ((Handle*)hp)->appends.data(); }

@@ -131,7 +131,9 @@ def test_env_reaches_production_dispatch(monkeypatch):
     monkeypatch.setenv("JEPSEN_TPU_CLOSURE", "xla-int8")  # malformed
     monkeypatch.setattr(K, "_env_warned", False)
     K.check_encoded_batch(encs)
-    assert calls["use_int8"] is False   # auto default, not half-int8
+    # malformed values fall back to the auto default (int8 since the
+    # r5 hardware race), never a half-parsed mixture
+    assert calls["use_int8"] is True and calls["use_pallas"] is False
 
     calls.clear()
     monkeypatch.setenv("JEPSEN_TPU_CLOSURE", "bf16")
