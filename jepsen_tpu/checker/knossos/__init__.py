@@ -142,7 +142,67 @@ def wgl(model: models.Model, raw_history: list[dict],
     no return entries remain (all determinate ops linearized);
     indeterminate ops may be left unlinearized. "unknown" when the
     config cache exceeds `max_configs` (mirrors knossos's memory
-    pragmatism rather than running the JVM out of heap)."""
+    pragmatism rather than running the JVM out of heap).
+
+    CAS-register histories route to the C++ twin of this search
+    (native/wgl.cc) when it's available — same walk, same cache
+    discipline, same verdicts (differential parity pinned in
+    tests/test_knossos.py); final-paths/configs witnesses are lean
+    there. This Python engine is the oracle, the fallback, and the
+    only engine for every other model."""
+    if type(model) is models.CASRegister and model.value is None:
+        res = _wgl_native(raw_history, max_configs)
+        if res is not None:
+            return res
+    return _wgl_python(model, raw_history, max_configs)
+
+
+def _wgl_native(raw_history: list[dict], max_configs: int) -> dict | None:
+    """Run the native WGL; None -> use the Python engine (lib missing,
+    unencodable history, or un-internable values)."""
+    from ... import native_lib
+    L = native_lib.wgl_lib()
+    if L is None:
+        return None
+    from . import encode as kenc
+    try:
+        # the device kernels cap pending slots at 24 (frontier width);
+        # the C++ search has no such limit and high concurrency is
+        # exactly where its speedup matters, so give the CPU route a
+        # far larger budget
+        enc = kenc.encode_register_history(raw_history, max_slots=4096)
+    except (kenc.EncodingError, TypeError):
+        return None
+    import ctypes
+
+    import numpy as np
+    ev = np.ascontiguousarray(enc.events, np.int32)
+    out = (ctypes.c_int64 * 5)()
+    L.jt_wgl_cas(ev.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                 ev.shape[0], max_configs, out)
+    verdict, n, depth, fail_op, _cache = out
+    if n == 0:
+        return {"valid?": True, "op-count": 0, "analyzer": "wgl"}
+    if verdict == 1:
+        return {"valid?": True, "op-count": int(n), "analyzer": "wgl",
+                "max-depth": int(depth), "final-paths": []}
+    if verdict == 2:
+        return {"valid?": "unknown", "op-count": int(n),
+                "analyzer": "wgl", "cause": ":config-cache-exhausted",
+                "configs": []}
+    op: Any = int(fail_op)
+    if 0 <= fail_op:        # recover the op dict for the witness
+        pairs = prepare(raw_history)
+        if fail_op < len(pairs):
+            op = pairs[int(fail_op)][0]
+    return {"valid?": False, "op-count": int(n), "analyzer": "wgl",
+            "op": op, "max-depth": int(depth),
+            "final-paths": [], "configs": []}
+
+
+def _wgl_python(model: models.Model, raw_history: list[dict],
+                max_configs: int = 10_000_000) -> dict:
+    """The pure-Python WGL engine (any model; the parity oracle)."""
     hist = reduce_history(raw_history)
     head, n, returns_left = _build_entries(hist)
     if n == 0:

@@ -91,14 +91,25 @@ def encode_dense_history(raw_history: list[dict], max_slots: int = 14,
     intern: dict = {None: 0}
     values: list = [None]
 
+    vkind: dict[int, str] = {}
+
     def vid(v):
-        if isinstance(v, list):
+        # same list/tuple ambiguity rule as encode.vid: equating what
+        # the model distinguishes is unencodable
+        kind = ("list" if isinstance(v, list)
+                else "tuple" if isinstance(v, tuple) else "scalar")
+        if kind == "list":
             v = tuple(v)
         i = intern.get(v)
-        if i is None:
+        fresh = i is None
+        if fresh:
             i = len(values)
             intern[v] = i
             values.append(v)
+        if kind != "scalar" and vkind.setdefault(i, kind) != kind:
+            raise EncodingError(
+                "value interned from both a list and an equal tuple")
+        if fresh:
             if len(values) > max_values:
                 raise EncodingError(
                     f"more than {max_values} distinct register values")

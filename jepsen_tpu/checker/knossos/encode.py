@@ -69,15 +69,30 @@ def encode_register_history(raw_history: list[dict],
     hist = h.remove_failures(h.complete(h.client_ops(raw_history)))
     intern: dict[Any, int] = {None: 0}
     values: list = [None]
+    vkind: dict[int, str] = {}
 
     def vid(v: Any) -> int:
-        if isinstance(v, list):
+        # lists intern as tuples (hashability). If an EQUAL tuple value
+        # also occurs, the intern map would equate what the Python
+        # model's == distinguishes — the interned engines could then
+        # mask a real violation, so such histories are unencodable and
+        # route to the Python oracle instead.
+        kind = "list" if isinstance(v, list) else (
+            "tuple" if isinstance(v, tuple) else "scalar")
+        if kind == "list":
             v = tuple(v)
         i = intern.get(v)
         if i is None:
             i = len(values)
             intern[v] = i
             values.append(v)
+        if kind != "scalar":
+            prev = vkind.setdefault(i, kind)
+            if prev != kind:
+                raise EncodingError(
+                    "value interned from both a list and an equal "
+                    "tuple: interned comparison would diverge from "
+                    "the model's")
         return i
 
     events: list[tuple[int, int, int, int, int, int]] = []

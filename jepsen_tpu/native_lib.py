@@ -22,11 +22,8 @@ import numpy as np
 log = logging.getLogger(__name__)
 
 _NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
-_SO = _NATIVE_DIR / "build" / "libjepsen_graph.so"
 
 _lock = threading.Lock()
-_lib: ctypes.CDLL | None = None
-_tried = False
 
 
 def _compile_so(src: Path, so: Path) -> bool:
@@ -68,28 +65,48 @@ def _load_so(src: Path, so: Path) -> ctypes.CDLL | None:
         return None
 
 
-def lib() -> ctypes.CDLL | None:
-    """The loaded library, building it on first call; None when
-    unavailable (no source tree / no compiler)."""
-    global _lib, _tried
-    if _lib is not None or _tried:
-        return _lib
+_cached: dict[str, ctypes.CDLL | None] = {}
+
+
+def _cached_lib(src_name: str, so_name: str, bind) -> ctypes.CDLL | None:
+    """One home for the lazy build-load-bind-memoize dance all three
+    native libraries share. `bind(L)` attaches restype/argtypes and
+    returns False to reject the library (e.g. a stale .so that
+    predates the current ABI — it must degrade to the Python engines,
+    not crash on missing symbols)."""
+    if src_name in _cached:
+        return _cached[src_name]
     with _lock:
-        if _lib is not None or _tried:
-            return _lib
-        _tried = True
-        L = _load_so(_NATIVE_DIR / "graph_algo.cc", _SO)
-        if L is None:
-            return None
-        i64p = ctypes.POINTER(ctypes.c_int64)
-        u8p = ctypes.POINTER(ctypes.c_uint8)
-        L.jt_tarjan_scc.restype = ctypes.c_int64
-        L.jt_tarjan_scc.argtypes = [ctypes.c_int64, i64p, i64p, i64p]
-        L.jt_reach.restype = None
-        L.jt_reach.argtypes = [ctypes.c_int64, i64p, i64p,
-                               ctypes.c_int64, i64p, i64p, u8p]
-        _lib = L
-        return _lib
+        if src_name in _cached:
+            return _cached[src_name]
+        L = _load_so(_NATIVE_DIR / src_name,
+                     _NATIVE_DIR / "build" / so_name)
+        if L is not None:
+            try:
+                if not bind(L):
+                    L = None
+            except AttributeError:
+                L = None
+        _cached[src_name] = L
+        return L
+
+
+def _bind_graph(L: ctypes.CDLL) -> bool:
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    L.jt_tarjan_scc.restype = ctypes.c_int64
+    L.jt_tarjan_scc.argtypes = [ctypes.c_int64, i64p, i64p, i64p]
+    L.jt_reach.restype = None
+    L.jt_reach.argtypes = [ctypes.c_int64, i64p, i64p,
+                           ctypes.c_int64, i64p, i64p, u8p]
+    return True
+
+
+def lib() -> ctypes.CDLL | None:
+    """The graph-kernel library (Tarjan/BFS), building on first call;
+    None when unavailable (no source tree / no compiler)."""
+    return _cached_lib("graph_algo.cc", "libjepsen_graph.so",
+                       _bind_graph)
 
 
 def available() -> bool:
@@ -98,59 +115,61 @@ def available() -> bool:
 
 # -- history-ingest encoder (native/hist_encode.cc) ----------------------
 
-_HIST_SO = _NATIVE_DIR / "build" / "libjepsen_histenc.so"
-_hist_lib: ctypes.CDLL | None = None
-_hist_tried = False
+def _bind_hist(L: ctypes.CDLL) -> bool:
+    L.jt_ha_abi_version.restype = ctypes.c_int64
+    if L.jt_ha_abi_version() != 2:
+        return False
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    L.jt_ha_encode_file.restype = ctypes.c_void_p
+    L.jt_ha_encode_file.argtypes = [ctypes.c_char_p]
+    L.jt_wr_encode_file.restype = ctypes.c_void_p
+    L.jt_wr_encode_file.argtypes = [ctypes.c_char_p]
+    L.jt_ha_dims.restype = None
+    L.jt_ha_dims.argtypes = [ctypes.c_void_p, i64p]
+    for name in ("jt_ha_appends", "jt_ha_reads", "jt_ha_edges",
+                 "jt_ha_status", "jt_ha_process", "jt_ha_kid_to_pre"):
+        fn = getattr(L, name)
+        fn.restype = i32p
+        fn.argtypes = [ctypes.c_void_p]
+    for name in ("jt_ha_invoke_index", "jt_ha_complete_index",
+                 "jt_ha_anomalies"):
+        fn = getattr(L, name)
+        fn.restype = i64p
+        fn.argtypes = [ctypes.c_void_p]
+    L.jt_ha_pre_key_names_json.restype = ctypes.c_char_p
+    L.jt_ha_pre_key_names_json.argtypes = [ctypes.c_void_p]
+    L.jt_ha_free.restype = None
+    L.jt_ha_free.argtypes = [ctypes.c_void_p]
+    return True
 
 
 def hist_lib() -> ctypes.CDLL | None:
     """The native history-ingest encoder (jt_ha_* ABI), built on first
     call; None when unavailable. Same degrade-to-Python contract as
     lib()."""
-    global _hist_lib, _hist_tried
-    if _hist_lib is not None or _hist_tried:
-        return _hist_lib
-    with _lock:
-        if _hist_lib is not None or _hist_tried:
-            return _hist_lib
-        _hist_tried = True
-        L = _load_so(_NATIVE_DIR / "hist_encode.cc", _HIST_SO)
-        if L is None:
-            return None
-        # A stale .so that predates the current ABI must degrade to the
-        # Python encoder, not crash: _load_so tolerates rebuild failure
-        # when an old lib still loads, so gate on the exported ABI
-        # version (missing symbol == version 1) before binding.
-        try:
-            L.jt_ha_abi_version.restype = ctypes.c_int64
-            if L.jt_ha_abi_version() != 2:
-                return None
-        except AttributeError:
-            return None
-        i32p = ctypes.POINTER(ctypes.c_int32)
-        i64p = ctypes.POINTER(ctypes.c_int64)
-        L.jt_ha_encode_file.restype = ctypes.c_void_p
-        L.jt_ha_encode_file.argtypes = [ctypes.c_char_p]
-        L.jt_wr_encode_file.restype = ctypes.c_void_p
-        L.jt_wr_encode_file.argtypes = [ctypes.c_char_p]
-        L.jt_ha_dims.restype = None
-        L.jt_ha_dims.argtypes = [ctypes.c_void_p, i64p]
-        for name in ("jt_ha_appends", "jt_ha_reads", "jt_ha_edges",
-                     "jt_ha_status", "jt_ha_process", "jt_ha_kid_to_pre"):
-            fn = getattr(L, name)
-            fn.restype = i32p
-            fn.argtypes = [ctypes.c_void_p]
-        for name in ("jt_ha_invoke_index", "jt_ha_complete_index",
-                     "jt_ha_anomalies"):
-            fn = getattr(L, name)
-            fn.restype = i64p
-            fn.argtypes = [ctypes.c_void_p]
-        L.jt_ha_pre_key_names_json.restype = ctypes.c_char_p
-        L.jt_ha_pre_key_names_json.argtypes = [ctypes.c_void_p]
-        L.jt_ha_free.restype = None
-        L.jt_ha_free.argtypes = [ctypes.c_void_p]
-        _hist_lib = L
-        return _hist_lib
+    return _cached_lib("hist_encode.cc", "libjepsen_histenc.so",
+                       _bind_hist)
+
+
+# -- WGL linearizability search (native/wgl.cc) --------------------------
+
+def _bind_wgl(L: ctypes.CDLL) -> bool:
+    L.jt_wgl_abi_version.restype = ctypes.c_int64
+    if L.jt_wgl_abi_version() != 1:
+        return False
+    L.jt_wgl_cas.restype = None
+    L.jt_wgl_cas.argtypes = [ctypes.POINTER(ctypes.c_int32),
+                             ctypes.c_int64, ctypes.c_int64,
+                             ctypes.POINTER(ctypes.c_int64)]
+    return True
+
+
+def wgl_lib() -> ctypes.CDLL | None:
+    """The native CAS-register WGL search (jt_wgl_* ABI), built on
+    first call; None when unavailable — the Python engine in
+    checker.knossos stays the oracle and fallback."""
+    return _cached_lib("wgl.cc", "libjepsen_wgl.so", _bind_wgl)
 
 
 def _csr(n: int, adj: list[list[int]]) -> tuple[np.ndarray, np.ndarray] | None:

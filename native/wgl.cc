@@ -1,0 +1,252 @@
+// Native Wing-Gong-Lowe linearizability search for CAS registers.
+//
+// C++ twin of jepsen_tpu/checker/knossos/__init__.py's wgl() for the
+// CAS-register model (the tiered router's only device-eligible model,
+// and the model every per-key register sweep uses). The JVM reference
+// runs this search in knossos (wgl.clj); here the Python engine stays
+// the oracle for arbitrary models and this kernel takes the
+// CAS-register fast path — same entry-list walk, same memo-cache
+// semantics, byte-identical verdicts (tests/test_knossos.py pins the
+// parity differentially, including the max_configs "unknown" cutoff,
+// which requires the cache to grow through the SAME insertion sequence).
+//
+// Input is the already-interned event stream the device kernels
+// consume (knossos/encode.py: rows of [kind, slot, f, a1, a2, known]
+// with READ/WRITE/CAS = 0/1/2, INVOKE_EV/COMPLETE_EV = 0/1; info ops
+// simply never complete — their slot stays occupied, which IS the
+// return-at-infinity rule). Model semantics (models.py CASRegister,
+// state interned with nil = 0):
+//   write: always legal, state := a1
+//   cas:   legal iff state == a1, state := a2
+//   read:  known == 0 -> always legal; else legal iff state == a1
+//
+// ABI:
+//   int64_t jt_wgl_abi_version()   -> 1
+//   void jt_wgl_cas(const int32_t* events, int64_t n_events,
+//                   int64_t max_configs, int64_t out[5])
+//     out[0] verdict: 1 valid, 0 invalid, 2 unknown (cache exhausted)
+//     out[1] op count
+//     out[2] max depth reached (max simultaneously-linearized ops)
+//     out[3] failing op id (the return the search died at), else -1
+//     out[4] final cache size
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+constexpr int32_t READ = 0, WRITE = 1, CAS = 2;
+constexpr int32_t INVOKE_EV = 0, COMPLETE_EV = 1;
+
+struct OpMeta {
+  int32_t f, a1, a2, known;
+};
+
+struct Entry {
+  bool is_call;
+  int32_t op_id;
+  int32_t match;  // entry index of the paired call/return, -1 if none
+  int32_t prev, next;
+};
+
+struct Search {
+  std::vector<OpMeta> ops;
+  std::vector<Entry> entries;  // entry 0 is the head sentinel
+  int32_t returns_total = 0;
+
+  void build(const int32_t* ev, int64_t n_events) {
+    entries.push_back({false, -1, -1, -1, -1});  // head
+    std::vector<int32_t> slot_op(64, -1), slot_call(64, -1);
+    int32_t tail = 0;
+    auto append = [&](Entry e) {
+      e.prev = tail;
+      e.next = -1;
+      int32_t idx = (int32_t)entries.size();
+      entries[tail].next = idx;
+      entries.push_back(e);
+      tail = idx;
+      return idx;
+    };
+    for (int64_t i = 0; i < n_events; ++i) {
+      const int32_t* r = ev + i * 6;
+      int32_t kind = r[0], slot = r[1];
+      if (slot >= (int32_t)slot_op.size()) {
+        slot_op.resize(slot + 1, -1);
+        slot_call.resize(slot + 1, -1);
+      }
+      if (kind == INVOKE_EV) {
+        int32_t id = (int32_t)ops.size();
+        ops.push_back({r[2], r[3], r[4], r[5]});
+        slot_op[slot] = id;
+        slot_call[slot] = append({true, id, -1, -1, -1});
+      } else if (kind == COMPLETE_EV) {
+        int32_t call = slot_call[slot];
+        if (call < 0) continue;
+        int32_t id = slot_op[slot];
+        int32_t ret = append({false, id, call, -1, -1});
+        entries[call].match = ret;
+        slot_call[slot] = -1;
+        ++returns_total;
+      }
+    }
+    // calls without returns (info / open at end) keep match = -1:
+    // return at infinity, never required to linearize.
+  }
+
+  static bool step(int32_t state, const OpMeta& op, int32_t& out) {
+    if (op.f == WRITE) {
+      out = op.a1;
+      return true;
+    }
+    if (op.f == CAS) {
+      if (state != op.a1) return false;
+      out = op.a2;
+      return true;
+    }
+    // READ
+    if (op.known != 0 && state != op.a1) return false;
+    out = state;
+    return true;
+  }
+
+  void run(int64_t max_configs, int64_t out[5]) {
+    const int32_t n = (int32_t)ops.size();
+    out[1] = n;
+    out[3] = -1;
+    if (n == 0) {
+      out[0] = 1;
+      out[2] = 0;
+      out[4] = 0;
+      return;
+    }
+    const int words = (n + 63) / 64;
+    std::vector<uint64_t> mask(words, 0);
+    int32_t state = 0;  // interned nil
+    int32_t depth = 0, best_depth = 0;
+
+    // memo cache keyed on (linearized set, state) — the same
+    // insertion discipline as the Python engine so the max_configs
+    // "unknown" cutoff fires at the identical point
+    std::unordered_set<std::string> cache;
+    std::string keybuf((size_t)words * 8 + 4, '\0');
+    auto make_key = [&](const std::vector<uint64_t>& m, int32_t s) {
+      memcpy(&keybuf[0], m.data(), (size_t)words * 8);
+      memcpy(&keybuf[(size_t)words * 8], &s, 4);
+      return keybuf;
+    };
+    cache.insert(make_key(mask, state));
+
+    struct Frame {
+      int32_t entry;
+      int32_t prev_state;
+    };
+    std::vector<Frame> stack;
+
+    auto lift = [&](int32_t e) {
+      entries[entries[e].prev].next = entries[e].next;
+      if (entries[e].next >= 0) entries[entries[e].next].prev = entries[e].prev;
+    };
+    auto unlift = [&](int32_t e) {
+      entries[entries[e].prev].next = e;
+      if (entries[e].next >= 0) entries[entries[e].next].prev = e;
+    };
+    auto backtrack = [&](int32_t& entry_out) {
+      Frame fr = stack.back();
+      stack.pop_back();
+      int32_t e2 = fr.entry;
+      unlift(e2);
+      if (entries[e2].match >= 0) {
+        unlift(entries[e2].match);
+        ++returns_left;
+      }
+      int32_t id = entries[e2].op_id;
+      mask[id >> 6] &= ~(1ULL << (id & 63));
+      --depth;
+      state = fr.prev_state;
+      entry_out = entries[e2].next;
+    };
+
+    int32_t entry = entries[0].next;
+    returns_left = returns_total;
+    while (returns_left > 0) {
+      if (entry < 0) {
+        // walked past every entry with returns remaining: guard branch
+        // (mirrors the Python engine's defensive pop-or-break)
+        if (stack.empty()) break;
+        backtrack(entry);
+        continue;
+      }
+      Entry& e = entries[entry];
+      if (e.is_call) {
+        int32_t s2;
+        bool ok = step(state, ops[e.op_id], s2);
+        bool fresh = false;
+        if (ok) {
+          uint64_t saved = mask[e.op_id >> 6];
+          mask[e.op_id >> 6] |= 1ULL << (e.op_id & 63);
+          const std::string& k = make_key(mask, s2);
+          fresh = !cache.count(k);
+          if (fresh) {
+            if ((int64_t)cache.size() >= max_configs) {
+              out[0] = 2;  // unknown: config cache exhausted
+              out[2] = best_depth;
+              out[4] = (int64_t)cache.size();
+              return;
+            }
+            cache.insert(k);
+          } else {
+            mask[e.op_id >> 6] = saved;
+          }
+        }
+        if (fresh) {
+          stack.push_back({entry, state});
+          lift(entry);
+          if (e.match >= 0) {
+            lift(e.match);
+            --returns_left;
+          }
+          state = s2;
+          ++depth;
+          if (depth > best_depth) best_depth = depth;
+          entry = entries[0].next;
+        } else {
+          entry = e.next;
+        }
+      } else {
+        // a completed op the search failed to linearize before its
+        // return
+        if (stack.empty()) {
+          out[0] = 0;
+          out[2] = best_depth;
+          out[3] = e.op_id;
+          out[4] = (int64_t)cache.size();
+          return;
+        }
+        backtrack(entry);
+      }
+    }
+    out[0] = 1;
+    out[2] = best_depth;
+    out[4] = (int64_t)cache.size();
+  }
+
+  int32_t returns_left = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+int64_t jt_wgl_abi_version() { return 1; }
+
+void jt_wgl_cas(const int32_t* events, int64_t n_events,
+                int64_t max_configs, int64_t out[5]) {
+  Search s;
+  s.build(events, n_events);
+  s.run(max_configs, out);
+}
+
+}  // extern "C"

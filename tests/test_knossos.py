@@ -609,3 +609,74 @@ class TestPackedKernelParity:
         enc.n_values = 2**30          # force the gate shut
         [r] = kker.check_encoded_batch([enc], packed=True)
         assert r["valid?"] == knossos.wgl(CASR, h)["valid?"]
+
+
+# ---------------------------------------------------------------------------
+# Native WGL parity (C++ search vs the Python oracle engine)
+# ---------------------------------------------------------------------------
+
+class TestNativeWGL:
+    def _native_available(self):
+        from jepsen_tpu import native_lib
+        return native_lib.wgl_lib() is not None
+
+    def test_differential_fuzz(self):
+        if not self._native_available():
+            pytest.skip("native WGL unavailable")
+        rng = random.Random(321)
+        checked = 0
+        for _ in range(40):
+            h = random_register_history(rng, n_ops=25, n_procs=5)
+            if rng.random() < 0.5:
+                h = corrupt(rng, h)
+            nat = knossos._wgl_native(h, 10_000_000)
+            py = knossos._wgl_python(CASR, h)
+            assert nat is not None
+            assert nat["valid?"] == py["valid?"], h
+            assert nat.get("max-depth") == py.get("max-depth"), h
+            if nat["valid?"] is False:
+                assert nat["op"] == py["op"]
+            checked += 1
+        assert checked == 40
+
+    def test_max_configs_cutoff_identical(self):
+        if not self._native_available():
+            pytest.skip("native WGL unavailable")
+        # the cutoff depends on cache-insertion order: both engines
+        # must flip to "unknown" at the same threshold
+        h = [op("invoke", p, "write", p) for p in range(7)] + \
+            [op("ok", p, "write", p) for p in range(7)]
+        for mc in (1, 2, 5, 50, 10_000):
+            nat = knossos._wgl_native(h, mc)
+            py = knossos._wgl_python(CASR, h, max_configs=mc)
+            assert nat["valid?"] == py["valid?"], mc
+
+    def test_non_cas_models_stay_python(self):
+        h = pairs_history((0, "acquire", None, "ok"),
+                          (1, "acquire", None, "ok"))
+        r = knossos.wgl(models.mutex(), h)
+        assert r["valid?"] is False   # python engine handles mutex
+
+    def test_unencodable_histories_fall_back(self):
+        # >24 pending slots exceeds the encoder's budget; wgl() must
+        # still answer via the Python engine
+        h = [op("invoke", p, "write", p) for p in range(30)] + \
+            [op("ok", p, "write", p) for p in range(30)]
+        assert knossos.wgl(CASR, h)["valid?"] is True
+
+
+def test_list_tuple_values_route_to_python_oracle():
+    """A tuple write observed as an equal-content list read: the intern
+    map would equate what CASRegister.__eq__ distinguishes, so every
+    interned engine (native WGL, dense grid, frontier kernel) must
+    refuse the history and the oracle's verdict must prevail."""
+    h = pairs_history((0, "write", (1, 2), "ok"),
+                      (0, "read", [1, 2], "ok"))
+    with pytest.raises(kenc.EncodingError):
+        kenc.encode_register_history(h)
+    assert knossos._wgl_native(h, 10_000_000) is None
+    r = knossos.wgl(CASR, h)
+    assert r["valid?"] is False       # the oracle distinguishes them
+    c = linearizable(CASR, backend="tpu")
+    [rt] = c.check_batch({}, [h], {})
+    assert rt["valid?"] is False      # device tiers fall through too
