@@ -156,6 +156,8 @@ def bench_knossos(reps: int, accel: bool = True) -> dict:
         dense.check_encoded_dense_batch(encs)
         best_tpu = min(best_tpu, time.perf_counter() - t0)
 
+    from jepsen_tpu import native_lib
+    native_lib.wgl_lib()   # warm the one-time g++ build OUTSIDE t_cpu
     t0 = time.perf_counter()
     for h in hists:
         analysis(models.cas_register(), h)
@@ -165,6 +167,10 @@ def bench_knossos(reps: int, accel: bool = True) -> dict:
         "metric": f"knossos-cas histories/sec ({OPS}-op, conc {CONC})",
         "tpu": round(B / best_tpu, 2),
         "cpu_wgl": round(B / t_cpu, 2),
+        # whether cpu_wgl is the C++ search (native/wgl.cc) or the
+        # Python engine — the two differ 3-6x, so cross-round
+        # comparisons need to know which ran
+        "cpu_wgl_native": native_lib.wgl_lib() is not None,
         "unit": "histories/sec",
         "speedup_vs_cpu": round(t_cpu / best_tpu, 3),
     }
