@@ -160,3 +160,52 @@ def test_wr_edge_batch_parity_on_device():
         tpu = elle_wr.rw_register_checker(backend="tpu").check({}, h, {})
         assert cpu["valid?"] == tpu["valid?"]
         assert sorted(cpu["anomaly-types"]) == sorted(tpu["anomaly-types"])
+
+
+def test_packed_frontier_parity_on_device():
+    """The packed single-int32 frontier kernel vs the unpacked one vs
+    the CPU engine, on the real chip (the packed kernel's sort-traffic
+    win is TPU-motivated; its parity must hold there too)."""
+    import jax.numpy as jnp
+
+    from jepsen_tpu.checker.knossos import encode as kenc
+    from jepsen_tpu.checker.knossos import kernels as kker
+    from jepsen_tpu.checker.knossos import packed as kpk
+
+    hists = ksynth.synth_register_batch(B=8, n_ops=200, n_procs=8,
+                                        info_prob=0.02, seed=21,
+                                        max_pending=10)
+    hists += [ksynth.corrupt(h, seed=i) for i, h in enumerate(hists[:4])]
+    encs = [kenc.encode_register_history(h) for h in hists]
+    batch = kenc.pack_register_batch(encs)
+    sh = batch["shape"]
+    ev = jnp.asarray(batch["events"])
+    pv, po = kpk.check_batch_device_packed(ev, frontier=512,
+                                           n_slots=sh.n_slots)
+    uv, uo = kker.check_batch_device(ev, frontier=512,
+                                     n_slots=sh.n_slots)
+    assert list(po) == list(uo)
+    for h, p, u, o in zip(hists, list(pv), list(uv), list(po)):
+        assert bool(p) == bool(u)
+        if not o:
+            assert bool(p) == analysis(models.cas_register(), h)["valid?"]
+
+
+def test_int8_auto_default_on_device(monkeypatch):
+    """The auto formulation must resolve to xla-int8 on hardware and
+    agree with an explicit bf16 pin verdict-for-verdict."""
+    from jepsen_tpu import parallel
+    from jepsen_tpu.checker.elle import encode as elle_encode
+
+    monkeypatch.delenv("JEPSEN_TPU_CLOSURE", raising=False)
+    d_pallas, d_int8 = elle_kernels.resolve_formulation(single_device=True)
+    assert d_int8 and not d_pallas
+    hists = [elle_synth.synth_append_history(T=300, K=8, seed=i,
+                                             g1c=(i % 2 == 0))
+             for i in range(4)]
+    encs = [elle_encode.encode_history(h) for h in hists]
+    auto = parallel.check_bucketed(encs, None)
+    monkeypatch.setenv("JEPSEN_TPU_CLOSURE", "bf16")
+    pinned = parallel.check_bucketed(encs, None)
+    assert [sorted(a) for a in auto] == [sorted(b) for b in pinned]
+    assert sum(1 for a in auto if "G1c" in a) == 2
