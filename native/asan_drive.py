@@ -1,0 +1,120 @@
+"""Sanitizer drive for the native libraries (ASan + UBSan).
+
+Exercises the three C++ components with the same differential fuzz the
+unit tests use, plus hostile/malformed inputs, under
+AddressSanitizer/UndefinedBehaviorSanitizer:
+
+    make -C native asan            # builds into native/build/asan/
+    LD_PRELOAD=$(gcc -print-file-name=libasan.so) \
+        ASAN_OPTIONS=detect_leaks=0 JAX_PLATFORMS=cpu \
+        python native/asan_drive.py
+
+detect_leaks=0 because CPython's interpreter allocations drown the
+report; buffer overflows / UB in the libraries still abort loudly.
+"""
+import os
+_B = os.path.join(os.path.dirname(__file__), "build", "asan")
+import ctypes, json, random, sys, tempfile
+from pathlib import Path
+import numpy as np
+_R = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _R)
+sys.path.insert(0, os.path.join(_R, "tests"))
+
+from jepsen_tpu import native_lib
+
+L = ctypes.CDLL(os.path.join(_B, "libhist_encode.so"))
+W = ctypes.CDLL(os.path.join(_B, "libwgl.so"))
+G = ctypes.CDLL(os.path.join(_B, "libgraph_algo.so"))
+# the production bindings, ABI checks included — a version bump that
+# the loaders would reject must fail here too, not bind stale argtypes
+assert native_lib._bind_hist(L)
+assert native_lib._bind_wgl(W)
+assert native_lib._bind_graph(G)
+
+from test_fuzz_differential import rand_append_history, rand_wr_history
+rng = random.Random(9090)
+_tmp = tempfile.TemporaryDirectory()
+td = Path(_tmp.name)
+n_app = n_wr = 0
+for trial in range(120):
+    kind = trial % 3
+    if kind < 2:
+        ops = rand_append_history(rng, T=rng.randrange(3, 80),
+                                  K=rng.randrange(1, 6),
+                                  conc=rng.randrange(1, 9),
+                                  info_p=rng.choice([0.0, 0.1, 0.4]),
+                                  corrupt_p=rng.choice([0.0, 0.3, 0.7]))
+    else:
+        ops = rand_wr_history(rng, T=rng.randrange(3, 80),
+                              K=rng.randrange(1, 5),
+                              conc=rng.randrange(1, 9),
+                              corrupt_p=rng.choice([0.0, 0.3, 0.7]))
+    p = td / f"h{trial}.jsonl"
+    p.write_text("\n".join(json.dumps(o) for o in ops) + "\n")
+    for fn in (L.jt_ha_encode_file, L.jt_wr_encode_file):
+        h = fn(str(p).encode())
+        if h:
+            dims = (ctypes.c_int64 * 8)()
+            L.jt_ha_dims(h, dims)
+            L.jt_ha_free(h)
+            if fn is L.jt_ha_encode_file: n_app += 1
+            else: n_wr += 1
+# malformed / hostile inputs
+hostile = [
+    b'', b'\n\n', b'{', b'{"type":"invoke"', b'[1,2,3]\n', b'null\n',
+    b'{"type":"invoke","process":0,"value":[["append",1,' + b'9'*30 + b']]}\n',
+    b'{"type":"ok","process":0,"value":"\xff\xfe"}\n',
+    b'{"a":' + b'[' * 2000 + b']' * 2000 + b'}\n',
+    b'{"type":"invoke","process":0,"value":[[]]}\n',
+    b'{"type":"invoke","process":0,"value":[["r",1,[' + b'1,'*500 + b'2]]]}\n',
+]
+for i, blob in enumerate(hostile):
+    p = td / f"bad{i}.jsonl"
+    p.write_bytes(blob)
+    for fn in (L.jt_ha_encode_file, L.jt_wr_encode_file):
+        h = fn(str(p).encode())
+        if h: L.jt_ha_free(h)
+
+# WGL under sanitizer: register histories incl. corrupt + max_configs
+from jepsen_tpu.checker.knossos import encode as kenc, synth as ksynth
+for trial in range(60):
+    h = ksynth.synth_register_history(
+        n_ops=rng.randrange(4, 120), n_procs=rng.randrange(1, 12),
+        n_values=rng.randrange(2, 8), info_prob=rng.choice([0.0, 0.1]),
+        seed=rng.randrange(1 << 30), max_pending=rng.randrange(2, 16))
+    if rng.random() < 0.5:
+        h = ksynth.corrupt(h, seed=trial)
+    try:
+        enc = kenc.encode_register_history(h)
+    except kenc.EncodingError:
+        continue
+    ev = np.ascontiguousarray(enc.events, np.int32)
+    out = (ctypes.c_int64 * 5)()
+    mc = rng.choice([1, 3, 1000, 10_000_000])
+    W.jt_wgl_cas(ev.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                 ev.shape[0], mc, out)
+# graph kernels under sanitizer: random digraphs through the CSR ABI
+i64p = ctypes.POINTER(ctypes.c_int64)
+for trial in range(40):
+    n = rng.randrange(1, 60)
+    adj = [[rng.randrange(n) for _ in range(rng.randrange(0, 5))]
+           for _ in range(n)]
+    counts = np.fromiter((len(a) for a in adj), np.int64, count=n)
+    row_ptr = np.zeros(n + 1, np.int64)
+    np.cumsum(counts, out=row_ptr[1:])
+    col = np.fromiter((w for a in adj for w in a), np.int64,
+                      count=int(row_ptr[-1]))
+    out = np.empty(n, np.int64)
+    G.jt_tarjan_scc(n, row_ptr.ctypes.data_as(i64p),
+                    col.ctypes.data_as(i64p), out.ctypes.data_as(i64p))
+    nq = rng.randrange(1, 8)
+    srcq = np.asarray([rng.randrange(n) for _ in range(nq)], np.int64)
+    dstq = np.asarray([rng.randrange(n) for _ in range(nq)], np.int64)
+    res = np.zeros(nq, np.uint8)
+    G.jt_reach(n, row_ptr.ctypes.data_as(i64p), col.ctypes.data_as(i64p),
+               nq, srcq.ctypes.data_as(i64p), dstq.ctypes.data_as(i64p),
+               res.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+
+print(f"ASAN drive complete: append={n_app} wr={n_wr} "
+      f"hostile={len(hostile)} wgl=60 graph=40")
