@@ -523,12 +523,17 @@ class Linearizable(Checker):
 
     `model` is a `models.Model` (immutable; step returns a successor).
     `algorithm` mirrors knossos: "wgl" | "linear" | "competition"; on
-    this build all CPU routes share the WGL engine and the `linear`
-    config-space search is the TPU dense-bitset kernel, selected with
-    backend="tpu". The device route is taken only for the model it
-    implements (a fresh CAS register) on histories that fit its
-    slot/value grid; everything else falls back to the CPU engine, so
-    verdicts only ever degrade to the oracle, never diverge from it."""
+    this build all CPU routes share the WGL engine (C++ for CAS
+    registers, Python otherwise) and the `linear` config-space search
+    is the TPU dense-bitset kernel, selected with backend="tpu".
+    backend="race" is the knossos-competition analogue across ENGINES:
+    the device pipeline and the CPU engine run concurrently and the
+    first full-batch finisher wins (multi-core hosts only — racing
+    doubles host work while both run). The device route is taken only
+    for the model it implements (a fresh CAS register) on histories
+    that fit its slot/value grid; everything else falls back to the
+    CPU engine, so verdicts only ever degrade to the oracle, never
+    diverge from it."""
 
     def __init__(self, m: model.Model | None = None,
                  algorithm: str = "competition", backend: str = "auto",
@@ -592,8 +597,93 @@ class Linearizable(Checker):
                 and self.model.value is None):
             return [self._cpu(hs) for hs in histories]
         from ..devices import resolve_backend
+        backend = self.backend
+        if backend == "auto":
+            # the CLI communicates --backend via JEPSEN_TPU_BACKEND and
+            # constructs checkers with "auto": honor an env-requested
+            # race here, where the race is implemented
+            import os
+            backend = os.environ.get("JEPSEN_TPU_BACKEND") or "auto"
+        if backend == "race":
+            if resolve_backend("auto") != "tpu":
+                return [self._cpu(hs) for hs in histories]
+            return self._race(histories)
         if resolve_backend(self.backend) != "tpu":
             return [self._cpu(hs) for hs in histories]
+        return self._device_batch(histories)
+
+    def _race(self, histories: list[list]) -> list[dict]:
+        """knossos.competition's racing rule, engine-scaled: run the
+        tiered device pipeline and the CPU engine concurrently and
+        return whichever finishes the WHOLE batch first (verdicts are
+        identical by the parity contract, so the race only decides
+        wall-clock). The reference races wgl against linear the same
+        way and takes the first future (knossos competition.clj via
+        jepsen checker.clj:188-219); like there, the loser can't be
+        interrupted mid-flight — the CPU side stops at the next
+        history boundary, a losing device dispatch runs its course in
+        the background. Racing doubles host work while both run, so
+        it's an explicit backend choice for multi-core hosts, not the
+        auto default."""
+        import threading
+
+        n = len(histories)
+        cpu_res: list = [None] * n
+        stop = threading.Event()
+        cpu_done = threading.Event()
+        dev_out: list = []
+        dev_done = threading.Event()
+        turn = threading.Event()
+
+        cpu_exc: list = []
+
+        def cpu_side():
+            try:
+                for i, hs in enumerate(histories):
+                    if stop.is_set():
+                        return
+                    cpu_res[i] = self._cpu(hs)
+            except Exception as e:   # propagate via the main thread
+                cpu_exc.append(e)
+            cpu_done.set()
+            turn.set()
+
+        def dev_side():
+            try:
+                dev_out.append(self._device_batch(histories))
+            except Exception as e:   # device failure: CPU decides
+                dev_out.append(e)
+            dev_done.set()
+            turn.set()
+
+        tc = threading.Thread(target=cpu_side, daemon=True,
+                              name="linearizable-race-cpu")
+        td = threading.Thread(target=dev_side, daemon=True,
+                              name="linearizable-race-dev")
+        tc.start()
+        td.start()
+        while True:
+            turn.wait()
+            turn.clear()
+            dev_ok = (dev_done.is_set() and dev_out
+                      and not isinstance(dev_out[0], Exception))
+            if dev_ok:
+                stop.set()
+                return dev_out[0]
+            if cpu_done.is_set():
+                if cpu_exc:
+                    # CPU side failed; the device result decides, or
+                    # the failure propagates as it would un-raced
+                    dev_done.wait()
+                    if dev_out and not isinstance(dev_out[0], Exception):
+                        return dev_out[0]
+                    raise cpu_exc[0]
+                return list(cpu_res)
+            # device errored first: wait for the CPU side to finish
+
+    def _device_batch(self, histories: list[list]) -> list[dict]:
+        """The tiered device pipeline (see check_batch's docstring);
+        callers have already checked model eligibility."""
         from .knossos import dense, kernels
         from .knossos import encode as kenc
         dense_encs, dense_idx = [], []

@@ -59,7 +59,7 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
                         "suite's default nemesis for the composed "
                         "package (combined.clj:318-364)")
     p.add_argument("--backend", default="auto",
-                   choices=["auto", "tpu", "cpu"],
+                   choices=["auto", "tpu", "cpu", "race"],
                    help="analysis backend: device kernels (tpu), host "
                         "oracles (cpu), or pick by hardware (auto — "
                         "the default; the north star's :backend :tpu "
@@ -139,7 +139,7 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
     p_batch.add_argument("--name", default=None,
                          help="only runs of this test name")
     p_batch.add_argument("--backend", default="auto",
-                         choices=["auto", "tpu", "cpu"])
+                         choices=["auto", "tpu", "cpu", "race"])
     p_batch.add_argument("--resume", action="store_true",
                          help="continue an interrupted sweep: skip "
                               "runs this checker already verdicted "

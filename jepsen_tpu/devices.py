@@ -234,9 +234,14 @@ def resolve_backend(backend: str = "auto") -> str:
     CPU oracle. JEPSEN_TPU_BACKEND overrides the auto resolution (the
     CLI's --backend flag sets it; tests force the device path on the
     virtual CPU mesh with it)."""
+    if backend == "race":
+        # the engine race is implemented by Linearizable.check_batch
+        # (which intercepts "race" before resolving); every other
+        # checker treats it as "auto" — device when reachable
+        backend = "auto"
     if backend != "auto":
         return backend
     env = os.environ.get("JEPSEN_TPU_BACKEND")
-    if env and env != "auto":
+    if env and env not in ("auto", "race"):
         return env
     return "tpu" if accelerator_available() else "cpu"

@@ -680,3 +680,72 @@ def test_list_tuple_values_route_to_python_oracle():
     c = linearizable(CASR, backend="tpu")
     [rt] = c.check_batch({}, [h], {})
     assert rt["valid?"] is False      # device tiers fall through too
+
+
+class TestRaceBackend:
+    """backend="race": device pipeline vs CPU engine, first full-batch
+    finisher wins; verdicts must match the oracle either way."""
+
+    def _hists(self):
+        rng = random.Random(64)
+        hists = [random_register_history(rng, n_ops=60, n_procs=6)
+                 for _ in range(4)]
+        hists += [corrupt(rng, random_register_history(
+            rng, n_ops=60, n_procs=6, info_prob=0.0)) for _ in range(2)]
+        return hists
+
+    def test_race_verdict_parity(self, monkeypatch):
+        # force the accelerator resolution so _race actually runs on
+        # the virtual CPU mesh (without it, auto resolves to cpu and
+        # the race is never entered)
+        monkeypatch.setenv("JEPSEN_TPU_BACKEND", "tpu")
+        hists = self._hists()
+        c = linearizable(CASR, backend="race")
+        res = c.check_batch({}, hists, {})
+        for h, r in zip(hists, res):
+            assert r["valid?"] == knossos.analysis(CASR, h)["valid?"]
+
+    def test_race_survives_device_failure(self, monkeypatch):
+        # a device pipeline that raises must not take the race down:
+        # the CPU side's full set decides
+        from jepsen_tpu.checker import Linearizable
+        monkeypatch.setenv("JEPSEN_TPU_BACKEND", "tpu")
+        calls = []
+        def boom(self, hists):
+            calls.append(1)
+            raise RuntimeError("boom")
+        monkeypatch.setattr(Linearizable, "_device_batch", boom)
+        hists = self._hists()
+        c = linearizable(CASR, backend="race")
+        res = c.check_batch({}, hists, {})
+        assert calls, "race never entered the device side"
+        for h, r in zip(hists, res):
+            assert r["valid?"] == knossos.analysis(CASR, h)["valid?"]
+
+    def test_race_via_env_from_cli_wiring(self, monkeypatch):
+        # the CLI exports --backend race as JEPSEN_TPU_BACKEND=race and
+        # builds checkers with backend="auto": the race must still
+        # engage (and elle-side resolve_backend must not see "race")
+        from jepsen_tpu import devices
+        monkeypatch.setenv("JEPSEN_TPU_BACKEND", "race")
+        monkeypatch.setattr(devices, "accelerator_available", lambda: True)
+        entered = []
+        from jepsen_tpu.checker import Linearizable
+        orig = Linearizable._race
+        monkeypatch.setattr(
+            Linearizable, "_race",
+            lambda self, hists: entered.append(1) or orig(self, hists))
+        hists = self._hists()
+        c = linearizable(CASR, backend="auto")
+        res = c.check_batch({}, hists, {})
+        assert entered, "env-requested race never engaged"
+        for h, r in zip(hists, res):
+            assert r["valid?"] == knossos.analysis(CASR, h)["valid?"]
+        # non-racing checkers resolve "race" like auto, never literally
+        assert devices.resolve_backend("race") in ("tpu", "cpu")
+
+    def test_race_non_register_model_goes_cpu(self):
+        h = pairs_history((0, "acquire", None, "ok"),
+                          (1, "acquire", None, "ok"))
+        c = linearizable(models.mutex(), backend="race")
+        assert c.check_batch({}, [h], {})[0]["valid?"] is False
