@@ -612,6 +612,12 @@ class Linearizable(Checker):
             return [self._cpu(hs) for hs in histories]
         return self._device_batch(histories)
 
+    #: losing race dispatches still draining in background threads;
+    #: joined at interpreter exit so teardown can't kill a thread
+    #: mid-XLA-dispatch (pthread aborts with "exception not rethrown")
+    _race_threads: set = set()
+    _race_atexit = [False]
+
     def _race(self, histories: list[list]) -> list[dict]:
         """knossos.competition's racing rule, engine-scaled: run the
         tiered device pipeline and the CPU engine concurrently and
@@ -645,6 +651,9 @@ class Linearizable(Checker):
                     cpu_res[i] = self._cpu(hs)
             except Exception as e:   # propagate via the main thread
                 cpu_exc.append(e)
+            finally:
+                Linearizable._race_threads.discard(
+                    threading.current_thread())
             cpu_done.set()
             turn.set()
 
@@ -653,6 +662,9 @@ class Linearizable(Checker):
                 dev_out.append(self._device_batch(histories))
             except Exception as e:   # device failure: CPU decides
                 dev_out.append(e)
+            finally:
+                Linearizable._race_threads.discard(
+                    threading.current_thread())
             dev_done.set()
             turn.set()
 
@@ -660,8 +672,18 @@ class Linearizable(Checker):
                               name="linearizable-race-cpu")
         td = threading.Thread(target=dev_side, daemon=True,
                               name="linearizable-race-dev")
+        if not Linearizable._race_atexit[0]:
+            Linearizable._race_atexit[0] = True
+            import atexit
+
+            def _drain():
+                for t in list(Linearizable._race_threads):
+                    t.join(timeout=120)
+            atexit.register(_drain)
         tc.start()
+        Linearizable._race_threads.add(tc)
         td.start()
+        Linearizable._race_threads.add(td)
         while True:
             turn.wait()
             turn.clear()

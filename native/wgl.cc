@@ -33,7 +33,6 @@
 #include <cstdint>
 #include <cstring>
 #include <string>
-#include <unordered_set>
 #include <vector>
 
 namespace {
@@ -129,15 +128,79 @@ struct Search {
 
     // memo cache keyed on (linearized set, state) — the same
     // insertion discipline as the Python engine so the max_configs
-    // "unknown" cutoff fires at the identical point
-    std::unordered_set<std::string> cache;
-    std::string keybuf((size_t)words * 8 + 4, '\0');
-    auto make_key = [&](const std::vector<uint64_t>& m, int32_t s) {
-      memcpy(&keybuf[0], m.data(), (size_t)words * 8);
-      memcpy(&keybuf[(size_t)words * 8], &s, 4);
-      return keybuf;
+    // "unknown" cutoff fires at the identical point. Exact keys in an
+    // open-addressing arena (no per-insert allocation, single hash):
+    // a false-positive hit would wrongly prune a branch, so probes
+    // compare the full key, never just a fingerprint.
+    struct Cache {
+      const int words;
+      std::vector<uint64_t> arena;   // n_keys * (words + 1) packed keys
+      std::vector<uint32_t> slots;   // offset+1 into arena, 0 = empty
+      size_t count = 0;
+
+      explicit Cache(int w) : words(w), slots(1024, 0) {
+        arena.reserve(1024 * (w + 1));
+      }
+      static uint64_t mix(uint64_t h, uint64_t v) {
+        // splitmix64-style: every input bit diffuses through the
+        // whole word — config keys differ in single mask bits, and a
+        // weak mixer clusters linear probing into long chains
+        h ^= v;
+        h *= 0xbf58476d1ce4e5b9ULL;
+        h ^= h >> 27;
+        h *= 0x94d049bb133111ebULL;
+        h ^= h >> 31;
+        return h;
+      }
+      uint64_t hash(const uint64_t* key) const {
+        uint64_t h = 0x243f6a8885a308d3ULL;
+        for (int i = 0; i <= words; ++i) h = mix(h, key[i]);
+        return h;
+      }
+      bool full() const {
+        // u32 arena offsets: past this, slot offsets would wrap and
+        // lookups could alias — callers treat it as cache exhaustion
+        return arena.size() + (size_t)words + 2 >= 0xffffffffull;
+      }
+      bool insert_if_absent(const uint64_t* key) {
+        // returns true when the key was new (and inserted)
+        if ((count + 1) * 4 >= slots.size() * 3) grow();
+        size_t m = slots.size() - 1;
+        size_t i = (size_t)hash(key) & m;
+        while (true) {
+          uint32_t off = slots[i];
+          if (off == 0) {
+            slots[i] = (uint32_t)(arena.size() + 1);
+            arena.insert(arena.end(), key, key + words + 1);
+            ++count;
+            return true;
+          }
+          if (memcmp(&arena[off - 1], key,
+                     (size_t)(words + 1) * 8) == 0)
+            return false;
+          i = (i + 1) & m;
+        }
+      }
+      void grow() {
+        std::vector<uint32_t> ns(slots.size() * 2, 0);
+        size_t m = ns.size() - 1;
+        for (uint32_t off : slots) {
+          if (off == 0) continue;
+          size_t i = (size_t)hash(&arena[off - 1]) & m;
+          while (ns[i] != 0) i = (i + 1) & m;
+          ns[i] = off;
+        }
+        slots.swap(ns);
+      }
     };
-    cache.insert(make_key(mask, state));
+    Cache cache(words);
+    std::vector<uint64_t> keybuf((size_t)words + 1);
+    auto load_key = [&](const std::vector<uint64_t>& m, int32_t s) {
+      memcpy(keybuf.data(), m.data(), (size_t)words * 8);
+      keybuf[words] = (uint64_t)(uint32_t)s;
+      return keybuf.data();
+    };
+    cache.insert_if_absent(load_key(mask, state));
 
     struct Frame {
       int32_t entry;
@@ -187,18 +250,22 @@ struct Search {
         if (ok) {
           uint64_t saved = mask[e.op_id >> 6];
           mask[e.op_id >> 6] |= 1ULL << (e.op_id & 63);
-          const std::string& k = make_key(mask, s2);
-          fresh = !cache.count(k);
-          if (fresh) {
-            if ((int64_t)cache.size() >= max_configs) {
+          const uint64_t* k = load_key(mask, s2);
+          if ((int64_t)cache.count >= max_configs || cache.full()) {
+            // mirror Python: the cutoff check precedes the insert, so
+            // only a WOULD-BE-fresh key may trip it (keybuf is stable
+            // and never aliases the arena, so k is safe to pass)
+            bool would_insert = cache.insert_if_absent(k);
+            if (would_insert) {
               out[0] = 2;  // unknown: config cache exhausted
               out[2] = best_depth;
-              out[4] = (int64_t)cache.size();
+              out[4] = (int64_t)cache.count - 1;
               return;
             }
-            cache.insert(k);
-          } else {
             mask[e.op_id >> 6] = saved;
+          } else {
+            fresh = cache.insert_if_absent(k);
+            if (!fresh) mask[e.op_id >> 6] = saved;
           }
         }
         if (fresh) {
@@ -222,7 +289,7 @@ struct Search {
           out[0] = 0;
           out[2] = best_depth;
           out[3] = e.op_id;
-          out[4] = (int64_t)cache.size();
+          out[4] = (int64_t)cache.count;
           return;
         }
         backtrack(entry);
@@ -230,7 +297,7 @@ struct Search {
     }
     out[0] = 1;
     out[2] = best_depth;
-    out[4] = (int64_t)cache.size();
+    out[4] = (int64_t)cache.count;
   }
 
   int32_t returns_left = 0;
