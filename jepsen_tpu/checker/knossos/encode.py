@@ -63,10 +63,77 @@ class EncodedRegisterHistory:
     half_doublings_peak: int = 0
 
 
+def _reduced_seq(raw_history: list[dict]) -> list[tuple]:
+    """The dict-free twin of reduce_history for the encoder: tuple
+    passes replicating client_ops / complete / remove_failures — each
+    with ITS OWN pairing semantics, which diverge on malformed
+    histories (a stray ok can complete a stale invoke once
+    remove_failures deletes the intervening fail pair, so the stages
+    cannot be fused into one pairing). Output rows are
+    (kind, process, f, value) with kind in {0 invoke, 1 info,
+    2 other-completion}; ok-completed invocations carry the
+    completion's value; failed pairs and fail ops are gone. ~2x the
+    encoder throughput vs materializing three dict lists; events
+    equality with the dict pipeline is pinned by
+    tests/test_knossos.py's reduction-parity fuzz and verdict parity
+    by the kernel-vs-oracle differentials."""
+    items: list = []           # (ty, p, f, v) client ops, in order
+    for o in raw_history:
+        p = o.get("process")
+        if not isinstance(p, int):
+            continue
+        items.append((o.get("type"), p, o.get("f"), o.get("value")))
+
+    # complete(): ok completions hand their value to THEIR invocation;
+    # nil-valued info completions inherit the invocation's value
+    # (pending popped by any completion type, stale invokes overwritten)
+    value = [v for _ty, _p, _f, v in items]
+    pend: dict = {}
+    for i, (ty, p, f, v) in enumerate(items):
+        if ty == "invoke":
+            pend[p] = i
+        else:
+            j = pend.pop(p, None)
+            if j is None:
+                continue
+            if ty == "ok":
+                value[j] = v
+            elif ty == "info" and v is None:
+                value[i] = value[j]
+
+    # remove_failures(): pairs()-matched fail completions delete their
+    # invocation; every fail op vanishes regardless
+    pend.clear()
+    dropped: set = set()
+    for i, (ty, p, f, v) in enumerate(items):
+        if ty == "invoke":
+            pend[p] = i
+        else:
+            j = pend.pop(p, None)
+            if ty == "fail":
+                dropped.add(i)
+                if j is not None:
+                    dropped.add(j)
+
+    # surviving ops, completion-kind resolved; the encoder walk does
+    # its own slot pairing exactly as it did over the dict list
+    out: list = []
+    for i, (ty, p, f, v) in enumerate(items):
+        if i in dropped:
+            continue
+        if ty == "invoke":
+            out.append((0, p, f, value[i]))
+        elif ty == "info":
+            out.append((1, p, f, value[i]))
+        else:                  # ok or unknown completion type
+            out.append((2, p, f, v))
+    return out
+
+
 def encode_register_history(raw_history: list[dict],
                             max_slots: int = 24) -> EncodedRegisterHistory:
     """Compile one register history into the kernel event stream."""
-    hist = h.remove_failures(h.complete(h.client_ops(raw_history)))
+    hist = _reduced_seq(raw_history)
     intern: dict[Any, int] = {None: 0}
     values: list = [None]
     vkind: dict[int, str] = {}
@@ -106,12 +173,11 @@ def encode_register_history(raw_history: list[dict],
     uncond_peak = 0
     half_peak = 0
 
-    for o in hist:
-        p = o.get("process")
-        if h.is_invoke(o):
-            f = _F_CODES.get(o.get("f"))
+    for kind, p, fname, v in hist:
+        if kind == 0:          # invoke
+            f = _F_CODES.get(fname)
             if f is None:
-                raise EncodingError(f"unencodable op f={o.get('f')!r}")
+                raise EncodingError(f"unencodable op f={fname!r}")
             if free:
                 slot = free.pop()
             else:
@@ -122,7 +188,6 @@ def encode_register_history(raw_history: list[dict],
                     raise EncodingError(
                         f"concurrency exceeds {max_slots} pending slots")
             slot_of[p] = slot
-            v = o.get("value")
             if f == CAS:
                 if not (isinstance(v, (list, tuple)) and len(v) == 2):
                     raise EncodingError(f"cas value {v!r} is not [old new]")
@@ -144,10 +209,10 @@ def encode_register_history(raw_history: list[dict],
             half_peak = max(half_peak, open_now + open_uncond)
         elif p in slot_of:
             slot = slot_of.pop(p)
-            if h.is_info(o):
-                # Return at infinity: slot stays occupied, no event
-                # (and, if unconditional, keeps inflating the frontier
-                # forever — uncond_peak already counts it).
+            if kind == 1:
+                # info: return at infinity — slot stays occupied, no
+                # event (and, if unconditional, keeps inflating the
+                # frontier forever; uncond_peak already counts it)
                 continue
             events.append((COMPLETE_EV, slot, 0, 0, 0, 0))
             open_now -= 1

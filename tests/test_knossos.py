@@ -749,3 +749,72 @@ class TestRaceBackend:
                           (1, "acquire", None, "ok"))
         c = linearizable(models.mutex(), backend="race")
         assert c.check_batch({}, [h], {})[0]["valid?"] is False
+
+
+class TestReducedSeqParity:
+    """_reduced_seq (the encoder's dict-free reduction) must produce
+    the SAME event stream as encoding the dict pipeline's output —
+    including on malformed histories (stale invokes, stray
+    completions, unknown op types), where the stages' distinct pairing
+    rules interact (a stray ok can complete a stale invoke once the
+    fail pair between them is deleted)."""
+
+    def _encode_via_dicts(self, h):
+        """Reference: the original dict-pipeline reduction feeding an
+        equivalent encoder walk, reconstructed from reduce_history."""
+        import numpy as np
+        from jepsen_tpu.checker.knossos import encode as kenc
+        hist = knossos.reduce_history(h)
+        seq = []
+        for o in hist:
+            ty = o.get("type")
+            if ty == "invoke":
+                seq.append((0, o.get("process"), o.get("f"),
+                            o.get("value")))
+            elif ty == "info":
+                seq.append((1, o.get("process"), o.get("f"),
+                            o.get("value")))
+            else:
+                seq.append((2, o.get("process"), o.get("f"),
+                            o.get("value")))
+        return seq
+
+    def test_reviewer_repro(self):
+        # fail pair between a stale invoke and its stray ok completion
+        h = [op("invoke", 0, "write", 1), op("invoke", 0, "write", 2),
+             op("fail", 0, "write", 2), op("ok", 0, "write", 1)]
+        from jepsen_tpu.checker.knossos import encode as kenc
+        assert kenc._reduced_seq(h) == self._encode_via_dicts(h)
+        enc = kenc.encode_register_history(h)
+        # the stray ok completes the stale invoke: 1 invoke + 1 complete
+        assert (enc.events[:, 0] == 1).sum() == 1
+
+    def test_fuzz_reductions_agree(self):
+        from jepsen_tpu.checker.knossos import encode as kenc
+        rng = random.Random(8088)
+        types = ["invoke", "ok", "fail", "info", "invoke", "ok",
+                 "weird", None]
+        fs = ["read", "write", "cas"]
+        for trial in range(400):
+            h = []
+            for i in range(rng.randrange(1, 30)):
+                ty = rng.choice(types)
+                f = rng.choice(fs)
+                v = ([rng.randrange(3), rng.randrange(3)]
+                     if f == "cas" else
+                     rng.choice([None, rng.randrange(4)]))
+                o = {"process": rng.randrange(3), "f": f, "value": v}
+                if ty is not None:
+                    o["type"] = ty
+                h.append(o)
+            assert kenc._reduced_seq(h) == self._encode_via_dicts(h), h
+
+    def test_fuzz_well_formed_verdicts(self):
+        rng = random.Random(4242)
+        for trial in range(60):
+            h = random_register_history(rng, n_ops=30, n_procs=4)
+            if rng.random() < 0.5:
+                h = corrupt(rng, h)
+            nat = knossos._wgl_native(h, 10_000_000)
+            py = knossos._wgl_python(CASR, h)
+            assert nat is not None and nat["valid?"] == py["valid?"]
