@@ -51,8 +51,7 @@ from ...devices import default_devices, ensure_platform_pin
 
 ensure_platform_pin()
 from ...util import pad_to_multiple
-from ... import history as h
-from .encode import CAS, READ, WRITE, EncodingError
+from .encode import CAS, READ, WRITE, EncodingError, _reduced_seq
 
 _F_CODES = {"read": READ, "write": WRITE, "cas": CAS}
 
@@ -72,20 +71,18 @@ class DenseEncoded:
 def encode_dense_history(raw_history: list[dict], max_slots: int = 14,
                          max_values: int = 64) -> DenseEncoded:
     """Compile one register history to the dense kernel's timeline."""
-    hist = h.remove_failures(h.complete(h.client_ops(raw_history)))
+    hist = _reduced_seq(raw_history)   # dict-free reduce_history twin
 
     # Which invocations never complete determinately? (info ops, and
     # open calls at history end). Info *reads* are dropped entirely.
-    last_comp: dict = {}
     opens: dict = {}
     determinate: set[int] = set()
-    for i, o in enumerate(hist):
-        p = o.get("process")
-        if h.is_invoke(o):
+    for i, (kind, p, f, v) in enumerate(hist):
+        if kind == 0:
             opens[p] = i
         elif p in opens:
             j = opens.pop(p)
-            if not h.is_info(o):
+            if kind != 1:
                 determinate.add(j)
 
     intern: dict = {None: 0}
@@ -125,13 +122,11 @@ def encode_dense_history(raw_history: list[dict], max_slots: int = 14,
     n_ops = 0
     peak = 1
 
-    for i, o in enumerate(hist):
-        p = o.get("process")
-        if h.is_invoke(o):
-            f = _F_CODES.get(o.get("f"))
+    for i, (kind, p, fname, v) in enumerate(hist):
+        if kind == 0:
+            f = _F_CODES.get(fname)
             if f is None:
-                raise EncodingError(f"unencodable op f={o.get('f')!r}")
-            v = o.get("value")
+                raise EncodingError(f"unencodable op f={fname!r}")
             if i not in determinate and f == READ:
                 continue  # reduction 1: info reads constrain nothing
             if not free:
@@ -153,7 +148,7 @@ def encode_dense_history(raw_history: list[dict], max_slots: int = 14,
             n_ops += 1
         elif p in slot_of:
             slot = slot_of.pop(p)
-            if h.is_info(o):
+            if kind == 1:
                 continue  # return at infinity: slot stays occupied
             steps_regs.append(regs.copy())
             steps_comp.append(slot)

@@ -28,7 +28,6 @@ from typing import Any
 
 import numpy as np
 
-from ... import history as h
 
 READ, WRITE, CAS = 0, 1, 2
 INVOKE_EV, COMPLETE_EV, PAD_EV = 0, 1, 2
@@ -67,9 +66,12 @@ def _reduced_seq(raw_history: list[dict]) -> list[tuple]:
     """The dict-free twin of reduce_history for the encoder: tuple
     passes replicating client_ops / complete / remove_failures — each
     with ITS OWN pairing semantics, which diverge on malformed
-    histories (a stray ok can complete a stale invoke once
-    remove_failures deletes the intervening fail pair, so the stages
-    cannot be fused into one pairing). Output rows are
+    histories. The reduction pairing runs over the PRE-deletion op
+    list while the encoder re-pairs the post-deletion survivors — a
+    stray ok can complete a stale invoke once the fail pair between
+    them is deleted, so reduction and encoder pairing must stay
+    separate (complete and remove_failures themselves share one
+    pairing and are fused below). Output rows are
     (kind, process, f, value) with kind in {0 invoke, 1 info,
     2 other-completion}; ok-completed invocations carry the
     completion's value; failed pairs and fail ops are gone. ~2x the
@@ -84,36 +86,29 @@ def _reduced_seq(raw_history: list[dict]) -> list[tuple]:
             continue
         items.append((o.get("type"), p, o.get("f"), o.get("value")))
 
-    # complete(): ok completions hand their value to THEIR invocation;
-    # nil-valued info completions inherit the invocation's value
-    # (pending popped by any completion type, stale invokes overwritten)
+    # complete() + remove_failures() share one pairing (both pair over
+    # the PRE-deletion op list with pending popped by any completion
+    # type): ok completions hand their value to THEIR invocation,
+    # nil-valued info completions inherit the invocation's value, and
+    # pairs-matched fail completions delete their invocation (every
+    # fail op vanishes regardless)
     value = [v for _ty, _p, _f, v in items]
     pend: dict = {}
-    for i, (ty, p, f, v) in enumerate(items):
-        if ty == "invoke":
-            pend[p] = i
-        else:
-            j = pend.pop(p, None)
-            if j is None:
-                continue
-            if ty == "ok":
-                value[j] = v
-            elif ty == "info" and v is None:
-                value[i] = value[j]
-
-    # remove_failures(): pairs()-matched fail completions delete their
-    # invocation; every fail op vanishes regardless
-    pend.clear()
     dropped: set = set()
     for i, (ty, p, f, v) in enumerate(items):
         if ty == "invoke":
             pend[p] = i
-        else:
-            j = pend.pop(p, None)
-            if ty == "fail":
-                dropped.add(i)
-                if j is not None:
-                    dropped.add(j)
+            continue
+        j = pend.pop(p, None)
+        if ty == "fail":
+            dropped.add(i)
+            if j is not None:
+                dropped.add(j)
+        elif j is not None:
+            if ty == "ok":
+                value[j] = v
+            elif ty == "info" and v is None:
+                value[i] = value[j]
 
     # surviving ops, completion-kind resolved; the encoder walk does
     # its own slot pairing exactly as it did over the dict list

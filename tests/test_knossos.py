@@ -762,8 +762,6 @@ class TestReducedSeqParity:
     def _encode_via_dicts(self, h):
         """Reference: the original dict-pipeline reduction feeding an
         equivalent encoder walk, reconstructed from reduce_history."""
-        import numpy as np
-        from jepsen_tpu.checker.knossos import encode as kenc
         hist = knossos.reduce_history(h)
         seq = []
         for o in hist:
@@ -783,14 +781,12 @@ class TestReducedSeqParity:
         # fail pair between a stale invoke and its stray ok completion
         h = [op("invoke", 0, "write", 1), op("invoke", 0, "write", 2),
              op("fail", 0, "write", 2), op("ok", 0, "write", 1)]
-        from jepsen_tpu.checker.knossos import encode as kenc
         assert kenc._reduced_seq(h) == self._encode_via_dicts(h)
         enc = kenc.encode_register_history(h)
         # the stray ok completes the stale invoke: 1 invoke + 1 complete
         assert (enc.events[:, 0] == 1).sum() == 1
 
     def test_fuzz_reductions_agree(self):
-        from jepsen_tpu.checker.knossos import encode as kenc
         rng = random.Random(8088)
         types = ["invoke", "ok", "fail", "info", "invoke", "ok",
                  "weird", None]
