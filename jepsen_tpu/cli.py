@@ -555,7 +555,8 @@ def _analyze_store_register(store: Store, run_dirs: list,
         if not client_fs or not client_fs <= {"read", "write", "cas"}:
             fallback.append(i)
             continue
-        ks = independent.history_keys(hist)
+        by_key = independent.subhistories(hist)   # one pass, all keys
+        ks = list(by_key)
         # a plain cas value is [old new] (scalars); a LIFTED cas value
         # is [key [old new]] — second element a list marks it lifted
         if not ks and any(
@@ -571,8 +572,7 @@ def _analyze_store_register(store: Store, run_dirs: list,
             fallback.append(i)
             continue
         for k in (ks or [None]):
-            subs.append(independent.subhistory(k, hist)
-                        if ks else hist)
+            subs.append(by_key[k] if ks else hist)
             owners.append((i, k))
 
     try:

@@ -277,6 +277,30 @@ def subhistory(k, history: list) -> list:
     return out
 
 
+def subhistories(history: list) -> dict:
+    """Every key's subhistory in ONE pass — identical per-key lists to
+    subhistory(k, ...) but O(ops + keys·unlifted) instead of the
+    per-key scan's O(keys·ops), which dominates store-wide register
+    sweeps (hundreds of keys per run). Keys appear in first-seen order
+    (dict ordering); un-lifted ops land in every key's list, including
+    keys first seen later (their list starts with the un-lifted prefix
+    so far, exactly as the per-key filter has it)."""
+    subs: dict = {}
+    unlifted: list = []
+    for o in history:
+        v = o.get("value")
+        if is_tuple(v):
+            lst = subs.get(v.key)
+            if lst is None:
+                lst = subs[v.key] = list(unlifted)
+            lst.append({**o, "value": v.value})
+        else:
+            unlifted.append(o)
+            for lst in subs.values():
+                lst.append(o)
+    return subs
+
+
 class IndependentChecker(Checker):
     """Check each key's subhistory with the sub-checker
     (independent.clj:451-502).
@@ -314,8 +338,9 @@ class IndependentChecker(Checker):
 
     def check(self, test, history, opts):
         opts = opts or {}
-        ks = history_keys(history)
-        subs = [subhistory(k, history) for k in ks]
+        by_key = subhistories(history)
+        ks = list(by_key)
+        subs = [by_key[k] for k in ks]
         if hasattr(self.sub, "check_batch"):
             # Batch checkers get the shared opts (one device dispatch, no
             # per-key namespacing) and so must not write store artifacts

@@ -814,3 +814,24 @@ class TestReducedSeqParity:
             nat = knossos._wgl_native(h, 10_000_000)
             py = knossos._wgl_python(CASR, h)
             assert nat is not None and nat["valid?"] == py["valid?"]
+
+
+def test_subhistories_single_pass_parity():
+    """independent.subhistories must match per-key subhistory() exactly
+    — including un-lifted (nemesis) ops appearing in every key's list,
+    even keys first seen after them."""
+    t = independent.tuple_
+    h = [
+        {"type": "info", "process": "nemesis", "f": "start", "value": None},
+        op("invoke", 0, "write", t(1, 5)),
+        op("ok", 0, "write", t(1, 5)),
+        {"type": "info", "process": "nemesis", "f": "stop", "value": None},
+        op("invoke", 1, "read", t(2, None)),
+        op("ok", 1, "read", t(2, 5)),
+        op("invoke", 2, "cas", t(1, [5, 6])),
+        op("ok", 2, "cas", t(1, [5, 6])),
+    ]
+    by_key = independent.subhistories(h)
+    assert list(by_key) == independent.history_keys(h)
+    for k in by_key:
+        assert by_key[k] == independent.subhistory(k, h), k
