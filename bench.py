@@ -271,6 +271,125 @@ def bench_long_history(reps: int) -> dict:
     }
 
 
+def _write_register_store(root: Path, runs: int, ops: int, keys: int,
+                          bad_every: int) -> list[Path]:
+    """Lifted CAS-register run dirs, etcd-shaped: every key carries a
+    genuinely CONCURRENT register history (the knossos simulator's
+    overlapping ops, concurrency 4) on its own process range, round-
+    robin interleaved and value-lifted to [key value]. Every
+    `bad_every`-th run gets one deterministic violation — a serial
+    read of a never-written value — so invalid counts are exact at
+    any BENCH_REG_* scaling."""
+    from jepsen_tpu.checker.knossos import synth as ksynth
+
+    per_key = max(6, ops // keys)
+    dirs = []
+    for r in range(runs):
+        corrupt = bad_every and r % bad_every == bad_every - 1
+        streams = []
+        for k in range(keys):
+            h = ksynth.synth_register_history(
+                n_ops=per_key, n_procs=4, n_values=8, info_prob=0.01,
+                seed=r * 10_007 + k, max_pending=6)
+            if corrupt and k == 0:
+                # a fresh process (sentinel, remapped below) reads a
+                # value nothing ever wrote: guaranteed invalid
+                h = h + [
+                    {"type": "invoke", "process": -1, "f": "read",
+                     "value": None},
+                    {"type": "ok", "process": -1, "f": "read",
+                     "value": 999_983},
+                ]
+            lifted = []
+            for o in h:
+                # disjoint process ranges keep the interleaved run a
+                # legal history (one outstanding op per process)
+                p = keys * 4 + k if o["process"] == -1 \
+                    else o["process"] + k * 4
+                lifted.append({"type": o["type"], "process": p,
+                               "f": o["f"], "value": [k, o.get("value")]})
+            streams.append(lifted)
+        lines = []
+        idx = 0
+        live = [iter(s) for s in streams]
+        while live:
+            nxt = []
+            for it in live:
+                o = next(it, None)
+                if o is None:
+                    continue
+                lines.append(json.dumps({**o, "index": idx}))
+                idx += 1
+                nxt.append(it)
+            live = nxt
+        d = root / f"run-{r:04d}"
+        d.mkdir()
+        (d / "history.jsonl").write_text("\n".join(lines) + "\n")
+        dirs.append(d)
+    return dirs
+
+
+def bench_register_sweep(n_dev: int, devices) -> dict:
+    """BASELINE config #1 end to end: a store of lifted CAS-register
+    runs -> pool load -> single-pass per-key split -> one tiered
+    check_batch over every key of every run (analyze-store --checker
+    register semantics, artifact writes elided). The CPU tier is the
+    native WGL search when available."""
+    import shutil
+    import tempfile
+
+    from jepsen_tpu import independent, ingest
+    from jepsen_tpu.checker import linearizable, models
+
+    accel = _accel(devices)
+    RUNS = int(os.environ.get("BENCH_REG_RUNS", 64 if accel else 16))
+    OPS = int(os.environ.get("BENCH_REG_OPS", 1000))
+    KEYS = int(os.environ.get("BENCH_REG_KEYS", 50))
+    root = Path(tempfile.mkdtemp(prefix="bench-reg-"))
+    try:
+        dirs = _write_register_store(root, RUNS, OPS, KEYS, 8)
+        c = linearizable(models.cas_register(), backend="auto")
+        t0 = time.perf_counter()
+        hists = ingest.parallel_load(dirs)
+        t_load = time.perf_counter() - t0
+        bad = [h for h in hists if isinstance(h, Exception)]
+        assert not bad, bad[:1]
+        t0 = time.perf_counter()
+        subs, owners = [], []
+        for i, hist in enumerate(hists):
+            hist = independent.relift_history(hist)
+            by_key = independent.subhistories(hist)
+            for k, sub in by_key.items():
+                subs.append(sub)
+                owners.append(i)
+        t_split = time.perf_counter() - t0
+        c.check_batch({}, subs, {})     # compile + native-lib warmup
+        t0 = time.perf_counter()
+        results = c.check_batch({}, subs, {})
+        t_check = time.perf_counter() - t0
+        per_run = {}
+        for i, res in zip(owners, results):
+            per_run.setdefault(i, []).append(res["valid?"])
+        invalid = sum(1 for vs in per_run.values() if False in vs)
+        assert invalid == RUNS // 8, (invalid, RUNS // 8)
+        total = t_load + t_split + t_check
+        from jepsen_tpu import native_lib
+        return {
+            "metric": f"register sweep store->verdict runs/sec "
+                      f"({RUNS}x{OPS}-op, {KEYS} keys)",
+            "value": round(RUNS / total, 2),
+            "unit": "runs/sec",
+            "keys_per_sec": round(len(subs) / total, 1),
+            "load_secs": round(t_load, 3),
+            "split_secs": round(t_split, 3),
+            "check_secs": round(t_check, 3),
+            "invalid_found": invalid,
+            "cpu_wgl_native": native_lib.wgl_lib() is not None,
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_end_to_end(n_dev: int, devices) -> dict:
     """Store -> verdict, ingest included: write B histories as
     history.jsonl run dirs, then time process-pool encode + bucketed
@@ -641,6 +760,7 @@ def run_benches() -> int:
             ("knossos", bench_knossos, (reps, _accel(devices))),
             ("long_history", bench_long_history, (reps,)),
             ("end_to_end", bench_end_to_end, (n_dev, devices)),
+            ("register_sweep", bench_register_sweep, (n_dev, devices)),
             ("north_star", bench_north_star, (n_dev, devices)),
             ("generator", bench_generator, (reps,))):
         try:
@@ -688,7 +808,8 @@ def main() -> int:
         return None, (f"bench child rc={p.returncode}: "
                       + " | ".join(tail))[:400]
 
-    blocks = ("knossos", "long_history", "end_to_end", "north_star",
+    blocks = ("knossos", "long_history", "end_to_end", "register_sweep",
+              "north_star",
               "generator")
     cpu_env = {"JEPSEN_TPU_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu",
                "BENCH_ATTEMPT": "cpu-retry"}
