@@ -17,7 +17,8 @@ Prints exactly ONE JSON line. The primary metric is the Elle rate
 numbers ride along under "knossos" with their own speedup-vs-CPU.
 
 Scale via env vars: BENCH_B/BENCH_T/BENCH_K (elle), BENCH_KN_B/
-BENCH_KN_OPS/BENCH_KN_CONC (knossos), BENCH_REPS.
+BENCH_KN_OPS/BENCH_KN_CONC (knossos), BENCH_REG_RUNS/BENCH_REG_OPS/
+BENCH_REG_KEYS (register sweep), BENCH_NS_* (north star), BENCH_REPS.
 """
 
 from __future__ import annotations
