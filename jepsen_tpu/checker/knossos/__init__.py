@@ -144,42 +144,53 @@ def wgl(model: models.Model, raw_history: list[dict],
     config cache exceeds `max_configs` (mirrors knossos's memory
     pragmatism rather than running the JVM out of heap).
 
-    CAS-register histories route to the C++ twin of this search
-    (native/wgl.cc) when it's available — same walk, same cache
-    discipline, same verdicts (differential parity pinned in
+    CAS-register and fresh-mutex histories route to the C++ twin of
+    this search (native/wgl.cc) when it's available — same walk, same
+    cache discipline, same verdicts (differential parity pinned in
     tests/test_knossos.py); final-paths/configs witnesses are lean
     there. This Python engine is the oracle, the fallback, and the
     only engine for every other model."""
     if type(model) is models.CASRegister and model.value is None:
-        res = _wgl_native(raw_history, max_configs)
+        res = _wgl_native(raw_history, max_configs, "cas")
+        if res is not None:
+            return res
+    elif type(model) is models.Mutex and model.locked is False:
+        res = _wgl_native(raw_history, max_configs, "mutex")
         if res is not None:
             return res
     return _wgl_python(model, raw_history, max_configs)
 
 
-def _wgl_native(raw_history: list[dict], max_configs: int) -> dict | None:
-    """Run the native WGL; None -> use the Python engine (lib missing,
-    unencodable history, or un-internable values)."""
+def _wgl_native(raw_history: list[dict], max_configs: int,
+                model_kind: str = "cas") -> dict | None:
+    """Run the native WGL (CAS register or mutex); None -> use the
+    Python engine (lib missing, unencodable history, or un-internable
+    values)."""
     from ... import native_lib
     L = native_lib.wgl_lib()
     if L is None:
         return None
     from . import encode as kenc
     try:
-        # the device kernels cap pending slots at 24 (frontier width);
-        # the C++ search has no such limit and high concurrency is
-        # exactly where its speedup matters, so give the CPU route a
-        # far larger budget
-        enc = kenc.encode_register_history(raw_history, max_slots=4096)
+        if model_kind == "mutex":
+            ev, model_id = kenc.encode_mutex_history(raw_history), 1
+        else:
+            # the device kernels cap pending slots at 24 (frontier
+            # width); the C++ search has no such limit and high
+            # concurrency is exactly where its speedup matters, so
+            # give the CPU route a far larger budget
+            ev = kenc.encode_register_history(
+                raw_history, max_slots=4096).events
+            model_id = 0
     except (kenc.EncodingError, TypeError):
         return None
     import ctypes
 
     import numpy as np
-    ev = np.ascontiguousarray(enc.events, np.int32)
+    ev = np.ascontiguousarray(ev, np.int32)
     out = (ctypes.c_int64 * 5)()
-    L.jt_wgl_cas(ev.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-                 ev.shape[0], max_configs, out)
+    L.jt_wgl_run(ev.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                 ev.shape[0], max_configs, model_id, out)
     verdict, n, depth, fail_op, _cache = out
     if n == 0:
         return {"valid?": True, "op-count": 0, "analyzer": "wgl"}

@@ -29,7 +29,7 @@ from typing import Any
 import numpy as np
 
 
-READ, WRITE, CAS = 0, 1, 2
+READ, WRITE, CAS, ACQUIRE, RELEASE = 0, 1, 2, 3, 4
 INVOKE_EV, COMPLETE_EV, PAD_EV = 0, 1, 2
 
 _F_CODES = {"read": READ, "write": WRITE, "cas": CAS}
@@ -123,6 +123,44 @@ def _reduced_seq(raw_history: list[dict]) -> list[tuple]:
         else:                  # ok or unknown completion type
             out.append((2, p, f, v))
     return out
+
+
+_F_CODES_MUTEX = {"acquire": ACQUIRE, "release": RELEASE}
+
+
+def encode_mutex_history(raw_history: list[dict],
+                         max_slots: int = 4096) -> "np.ndarray":
+    """Compile a mutex history (acquire/release, no values) into the
+    [E, 6] event stream the native WGL search consumes — same slot
+    bookkeeping as the register encoder, no interning (the lock's
+    state space is {free, held})."""
+    hist = _reduced_seq(raw_history)
+    events: list = []
+    slot_of: dict = {}
+    free: list = []
+    next_slot = 0
+    for kind, p, fname, v in hist:
+        if kind == 0:
+            f = _F_CODES_MUTEX.get(fname)
+            if f is None:
+                raise EncodingError(f"unencodable mutex op f={fname!r}")
+            if free:
+                slot = free.pop()
+            else:
+                slot = next_slot
+                next_slot += 1
+                if next_slot > max_slots:
+                    raise EncodingError(
+                        f"concurrency exceeds {max_slots} pending slots")
+            slot_of[p] = slot
+            events.append((INVOKE_EV, slot, f, 0, 0, 0))
+        elif p in slot_of:
+            slot = slot_of.pop(p)
+            if kind == 1:
+                continue   # info: return at infinity, slot stays held
+            events.append((COMPLETE_EV, slot, 0, 0, 0, 0))
+            free.append(slot)
+    return np.asarray(events, np.int32).reshape(-1, 6)
 
 
 def encode_register_history(raw_history: list[dict],

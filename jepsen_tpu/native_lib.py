@@ -156,19 +156,20 @@ def hist_lib() -> ctypes.CDLL | None:
 
 def _bind_wgl(L: ctypes.CDLL) -> bool:
     L.jt_wgl_abi_version.restype = ctypes.c_int64
-    if L.jt_wgl_abi_version() != 1:
+    if L.jt_wgl_abi_version() != 2:
         return False
-    L.jt_wgl_cas.restype = None
-    L.jt_wgl_cas.argtypes = [ctypes.POINTER(ctypes.c_int32),
+    L.jt_wgl_run.restype = None
+    L.jt_wgl_run.argtypes = [ctypes.POINTER(ctypes.c_int32),
                              ctypes.c_int64, ctypes.c_int64,
+                             ctypes.c_int64,
                              ctypes.POINTER(ctypes.c_int64)]
     return True
 
 
 def wgl_lib() -> ctypes.CDLL | None:
-    """The native CAS-register WGL search (jt_wgl_* ABI), built on
-    first call; None when unavailable — the Python engine in
-    checker.knossos stays the oracle and fallback."""
+    """The native WGL search (jt_wgl_* ABI; CAS-register and mutex
+    models), built on first call; None when unavailable — the Python
+    engine in checker.knossos stays the oracle and fallback."""
     return _cached_lib("wgl.cc", "libjepsen_wgl.so", _bind_wgl)
 
 

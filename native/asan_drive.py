@@ -92,8 +92,8 @@ for trial in range(60):
     ev = np.ascontiguousarray(enc.events, np.int32)
     out = (ctypes.c_int64 * 5)()
     mc = rng.choice([1, 3, 1000, 10_000_000])
-    W.jt_wgl_cas(ev.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-                 ev.shape[0], mc, out)
+    W.jt_wgl_run(ev.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                 ev.shape[0], mc, 0, out)
 # graph kernels under sanitizer: random digraphs through the CSR ABI
 i64p = ctypes.POINTER(ctypes.c_int64)
 for trial in range(40):

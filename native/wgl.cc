@@ -1,29 +1,34 @@
-// Native Wing-Gong-Lowe linearizability search for CAS registers.
+// Native Wing-Gong-Lowe linearizability search.
 //
 // C++ twin of jepsen_tpu/checker/knossos/__init__.py's wgl() for the
 // CAS-register model (the tiered router's only device-eligible model,
-// and the model every per-key register sweep uses). The JVM reference
-// runs this search in knossos (wgl.clj); here the Python engine stays
-// the oracle for arbitrary models and this kernel takes the
-// CAS-register fast path — same entry-list walk, same memo-cache
+// and the model every per-key register sweep uses) and the mutex
+// model (hazelcast-style lock workloads). The JVM reference runs this
+// search in knossos (wgl.clj); here the Python engine stays the
+// oracle for arbitrary models and this kernel takes the encoded fast
+// path — same entry-list walk, same memo-cache
 // semantics, byte-identical verdicts (tests/test_knossos.py pins the
 // parity differentially, including the max_configs "unknown" cutoff,
 // which requires the cache to grow through the SAME insertion sequence).
 //
 // Input is the already-interned event stream the device kernels
 // consume (knossos/encode.py: rows of [kind, slot, f, a1, a2, known]
-// with READ/WRITE/CAS = 0/1/2, INVOKE_EV/COMPLETE_EV = 0/1; info ops
-// simply never complete — their slot stays occupied, which IS the
-// return-at-infinity rule). Model semantics (models.py CASRegister,
-// state interned with nil = 0):
-//   write: always legal, state := a1
-//   cas:   legal iff state == a1, state := a2
-//   read:  known == 0 -> always legal; else legal iff state == a1
+// with READ/WRITE/CAS/ACQUIRE/RELEASE = 0/1/2/3/4, INVOKE_EV/
+// COMPLETE_EV = 0/1; info ops simply never complete — their slot
+// stays occupied, which IS the return-at-infinity rule). Model
+// semantics (models.py, state interned with nil = 0):
+//   CASRegister (model 0):
+//     write: always legal, state := a1
+//     cas:   legal iff state == a1, state := a2
+//     read:  known == 0 -> always legal; else legal iff state == a1
+//   Mutex (model 1, state 0 = free, 1 = held):
+//     acquire: legal iff state == 0, state := 1
+//     release: legal iff state == 1, state := 0
 //
 // ABI:
-//   int64_t jt_wgl_abi_version()   -> 1
-//   void jt_wgl_cas(const int32_t* events, int64_t n_events,
-//                   int64_t max_configs, int64_t out[5])
+//   int64_t jt_wgl_abi_version()   -> 2
+//   void jt_wgl_run(const int32_t* events, int64_t n_events,
+//                   int64_t max_configs, int64_t model, int64_t out[5])
 //     out[0] verdict: 1 valid, 0 invalid, 2 unknown (cache exhausted)
 //     out[1] op count
 //     out[2] max depth reached (max simultaneously-linearized ops)
@@ -37,7 +42,7 @@
 
 namespace {
 
-constexpr int32_t READ = 0, WRITE = 1, CAS = 2;
+constexpr int32_t READ = 0, WRITE = 1, CAS = 2, ACQUIRE = 3, RELEASE = 4;
 constexpr int32_t INVOKE_EV = 0, COMPLETE_EV = 1;
 
 struct OpMeta {
@@ -96,19 +101,27 @@ struct Search {
   }
 
   static bool step(int32_t state, const OpMeta& op, int32_t& out) {
-    if (op.f == WRITE) {
-      out = op.a1;
-      return true;
+    switch (op.f) {
+      case WRITE:
+        out = op.a1;
+        return true;
+      case CAS:
+        if (state != op.a1) return false;
+        out = op.a2;
+        return true;
+      case ACQUIRE:
+        if (state != 0) return false;
+        out = 1;
+        return true;
+      case RELEASE:
+        if (state != 1) return false;
+        out = 0;
+        return true;
+      default:  // READ
+        if (op.known != 0 && state != op.a1) return false;
+        out = state;
+        return true;
     }
-    if (op.f == CAS) {
-      if (state != op.a1) return false;
-      out = op.a2;
-      return true;
-    }
-    // READ
-    if (op.known != 0 && state != op.a1) return false;
-    out = state;
-    return true;
   }
 
   void run(int64_t max_configs, int64_t out[5]) {
@@ -307,10 +320,15 @@ struct Search {
 
 extern "C" {
 
-int64_t jt_wgl_abi_version() { return 1; }
+int64_t jt_wgl_abi_version() { return 2; }
 
-void jt_wgl_cas(const int32_t* events, int64_t n_events,
-                int64_t max_configs, int64_t out[5]) {
+void jt_wgl_run(const int32_t* events, int64_t n_events,
+                int64_t max_configs, int64_t model, int64_t out[5]) {
+  // `model` selects step semantics only through the f codes already
+  // present in the event rows, so the search itself is model-blind;
+  // the parameter exists to keep the ABI explicit about what the
+  // encoder produced (0 = cas-register, 1 = mutex).
+  (void)model;
   Search s;
   s.build(events, n_events);
   s.run(max_configs, out);

@@ -835,3 +835,73 @@ def test_subhistories_single_pass_parity():
     assert list(by_key) == independent.history_keys(h)
     for k in by_key:
         assert by_key[k] == independent.subhistory(k, h), k
+
+
+class TestNativeMutexWGL:
+    """The native WGL's mutex model vs the Python oracle."""
+
+    @staticmethod
+    def _mutex_history(rng, n_ops=30, n_procs=4, corrupt=False):
+        """Simulated lock: acquire/release with real overlap (invoke
+        and completion interleave across processes); optionally corrupt
+        by flipping an op's f."""
+        hist, held, pending = [], [None], {}
+        for i in range(n_ops):
+            p = rng.randrange(n_procs)
+            if p in pending:
+                f, _g = pending.pop(p)
+                # info/fail completions exercise return-at-infinity and
+                # the fail-pair dropping; keeping the SIMULATED state as
+                # if the op took effect stays conservative for "ok"
+                # parity while still generating both engines' hard paths
+                ty = rng.choices(["ok", "info", "fail"],
+                                 [0.8, 0.1, 0.1])[0]
+                hist.append(op(ty, p, f))
+                continue
+            if held[0] is None and rng.random() < 0.6:
+                hist.append(op("invoke", p, "acquire"))
+                held[0] = p
+                pending[p] = ("acquire", True)
+            elif held[0] is not None and rng.random() < 0.6:
+                q = held[0]
+                if q in pending:
+                    continue
+                hist.append(op("invoke", q, "release"))
+                held[0] = None
+                pending[q] = ("release", True)
+        for p, (f, _g) in list(pending.items()):
+            hist.append(op("ok", p, f))
+        if corrupt and len(hist) > 2:
+            i = rng.randrange(len(hist))
+            hist[i] = {**hist[i],
+                       "f": "acquire" if hist[i]["f"] == "release"
+                       else "release"}
+        return hist
+
+    def test_mutex_differential_fuzz(self):
+        from jepsen_tpu import native_lib
+        if native_lib.wgl_lib() is None:
+            pytest.skip("native WGL unavailable")
+        rng = random.Random(6060)
+        MUT = models.mutex()
+        for trial in range(120):
+            h = self._mutex_history(rng, n_ops=rng.randrange(6, 40),
+                                    n_procs=rng.randrange(2, 6),
+                                    corrupt=rng.random() < 0.5)
+            nat = knossos._wgl_native(h, 10_000_000, "mutex")
+            py = knossos._wgl_python(MUT, h)
+            assert nat is not None
+            assert nat["valid?"] == py["valid?"], h
+            assert nat.get("max-depth") == py.get("max-depth"), h
+
+    def test_mutex_goldens_via_wgl(self):
+        # the public wgl() entry now routes fresh-mutex models natively
+        h = pairs_history((0, "acquire", None, "ok"),
+                          (1, "acquire", None, "ok"))
+        assert knossos.wgl(models.mutex(), h)["valid?"] is False
+        h2 = pairs_history((0, "acquire", None, "ok"),
+                           (0, "release", None, "ok"),
+                           (1, "acquire", None, "ok"))
+        assert knossos.wgl(models.mutex(), h2)["valid?"] is True
+        # a held-lock initial state must stay on the Python engine
+        assert knossos.wgl(models.Mutex(True), h2)["valid?"] is False
