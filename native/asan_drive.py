@@ -94,6 +94,23 @@ for trial in range(60):
     mc = rng.choice([1, 3, 1000, 10_000_000])
     W.jt_wgl_run(ev.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
                  ev.shape[0], mc, 0, out)
+# mutex WGL under sanitizer (random, possibly-illegal op streams)
+for trial in range(30):
+    h = []
+    for i in range(rng.randrange(4, 40)):
+        p = rng.randrange(4)
+        ty = rng.choice(["invoke", "ok", "info", "fail"])
+        f = rng.choice(["acquire", "release"])
+        h.append({"type": ty, "process": p, "f": f, "value": None})
+    try:
+        ev = kenc.encode_mutex_history(h)
+    except kenc.EncodingError:
+        continue
+    ev = np.ascontiguousarray(ev, np.int32)
+    out = (ctypes.c_int64 * 5)()
+    W.jt_wgl_run(ev.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                 ev.shape[0], rng.choice([2, 10_000_000]), 1, out)
+
 # graph kernels under sanitizer: random digraphs through the CSR ABI
 i64p = ctypes.POINTER(ctypes.c_int64)
 for trial in range(40):
@@ -117,4 +134,4 @@ for trial in range(40):
                res.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
 
 print(f"ASAN drive complete: append={n_app} wr={n_wr} "
-      f"hostile={len(hostile)} wgl=60 graph=40")
+      f"hostile={len(hostile)} wgl=60 mutex=30 graph=40")

@@ -403,3 +403,19 @@ def test_wr_fallback_on_int64_min_write(tmp_path):
     ops = wtxn(0, 0, [["w", "x", -2**63], ["r", "x", None]])
     d = write_run(tmp_path, ops)
     assert encode_wr_history_file(d / "history.jsonl") is None
+
+
+def test_edn_only_run_dir_uses_python_path(tmp_path):
+    """A run dir with only history.edn (reference-format store) must
+    flow through the Python loader+encoder — the native path reads
+    history.jsonl only."""
+    from jepsen_tpu import history as h
+    from jepsen_tpu import ingest
+    ops = synth.synth_append_history(T=30, K=4, seed=2)
+    d = tmp_path / "run"
+    d.mkdir()
+    (d / "history.edn").write_text(h.history_to_edn(ops))
+    enc = ingest.encode_run_dir(d)
+    py = encode_history(ops)
+    np.testing.assert_array_equal(enc.appends, py.appends)
+    np.testing.assert_array_equal(enc.reads, py.reads)
