@@ -5,8 +5,9 @@ Two device phases are timed:
 1. Elle list-append: histories checked per second for 10k-op (≈5k-txn)
    histories — dependency-edge build + transitive-closure cycle
    detection (detect mode: one closure per history, the common
-   all-valid path; classification of cyclic histories is a second pass
-   over the rare positives).
+   all-valid path; classify mode runs the FUSED kernel, whose
+   classification closures sit behind a lax.cond and only fire for
+   batches with positives).
 2. Knossos CAS: wall-clock for a batch of etcd-shaped 1k-op CAS
    register subhistories (concurrency 10) through the dense-bitset
    linearizability kernel, vs the CPU WGL engine on the same batch —
@@ -18,7 +19,8 @@ numbers ride along under "knossos" with their own speedup-vs-CPU.
 
 Scale via env vars: BENCH_B/BENCH_T/BENCH_K (elle), BENCH_KN_B/
 BENCH_KN_OPS/BENCH_KN_CONC (knossos), BENCH_REG_RUNS/BENCH_REG_OPS/
-BENCH_REG_KEYS (register sweep), BENCH_NS_* (north star), BENCH_REPS.
+BENCH_REG_KEYS (register sweep), BENCH_NS_* (north star), BENCH_DP_*
+(dp scaling; BENCH_DP_CHILD=0 skips its CPU child), BENCH_REPS.
 """
 
 from __future__ import annotations
@@ -91,8 +93,14 @@ def bench_elle(n_dev: int, devices, reps: int) -> dict:
         "vs_baseline": _vs_baseline(rate, target, T),
         "shape": {"B": B, "T": T, "K": K},
         # the variants the common path skips: full anomaly
-        # classification, and strict-serializability (realtime edges)
+        # classification (fused detect/classify kernel — on this
+        # all-valid batch the classification closures stay behind
+        # their lax.cond, so the rate should track detect), and
+        # strict-serializability (realtime edges)
         "classify_rate": timed(max(2, reps // 2), classify=True),
+        # the pre-fusion chained-closure classify, for the honest A/B
+        "classify_unfused_rate": timed(max(2, reps // 2), classify=True,
+                                       fused=False),
         "realtime_rate": timed(max(2, reps // 2), classify=False,
                                realtime=True),
     }
@@ -357,9 +365,14 @@ def bench_register_sweep(n_dev: int, devices) -> dict:
         assert not bad, bad[:1]
         t0 = time.perf_counter()
         subs, owners = [], []
-        for i, hist in enumerate(hists):
-            hist = independent.relift_history(hist)
-            by_key = independent.subhistories(hist)
+        split_stats: dict = {}
+        for i, (d, hist) in enumerate(zip(dirs, hists)):
+            # native per-key split: hist_encode.cc emits each op's key
+            # id in one C++ pass over the jsonl, so the per-op Python
+            # relift/is_tuple walk disappears (pure-Python fallback
+            # preserved under JEPSEN_TPU_NATIVE_SPLIT=0)
+            by_key = independent.subhistories_path(
+                hist, Path(d) / "history.jsonl", stats=split_stats)
             for k, sub in by_key.items():
                 subs.append(sub)
                 owners.append(i)
@@ -386,9 +399,144 @@ def bench_register_sweep(n_dev: int, devices) -> dict:
             "check_secs": round(t_check, 3),
             "invalid_found": invalid,
             "cpu_wgl_native": native_lib.wgl_lib() is not None,
+            # whether the C++ per-key splitter (jt_ks_*) ACTUALLY
+            # carried every run's split (counted per call, not just
+            # gate+library availability — a silent per-file fallback
+            # to the Python walk must not report as native)
+            "native_split": (split_stats.get("native", 0) == RUNS
+                             and not split_stats.get("python")),
         }
     finally:
         shutil.rmtree(root, ignore_errors=True)
+
+
+def _dp_rates(devices, B: int, T: int, K: int, dps, reps: int) -> list:
+    """Fixed-total-batch (strong-scaling) detect rates over explicit
+    (dp, 1) meshes carved from `devices` — the dp-scaling measurement,
+    shared by bench_dp_scaling and the pinned dp-efficiency test. Each
+    dp checks the SAME B-history batch, so on a shared-core virtual
+    CPU mesh the ideal ratio rate(dpN)/rate(dp1) is ~1.0 (the cores do
+    the same work either way; what's measured is sharding overhead),
+    while on real chips the ideal is ~N."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from jepsen_tpu import parallel
+    from jepsen_tpu.checker.elle import synth
+
+    batch = synth.synth_valid_batch(B=B, T=T, K=K, seed=5)
+    shape = batch["shape"]
+    out = []
+    for dp in dps:
+        if dp > len(devices) or B % dp:
+            continue
+        mesh = Mesh(np.asarray(devices[:dp]).reshape(dp, 1),
+                    ("dp", "mp"))
+        fn = parallel.sharded_check_fn(mesh, shape, classify=False)
+        args = parallel.shard_batch(mesh, batch)
+        jax.block_until_ready(fn(*args))     # compile + warm
+        best = float("inf")
+        for _ in range(max(2, reps)):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            best = min(best, time.perf_counter() - t0)
+        out.append({"dp": dp, "rate": round(B / best, 2)})
+    return out
+
+
+def _dp_scaling_inner() -> list:
+    """Child-process body for the CPU dp-scaling run: boots XLA with
+    >= 8 (virtual) devices. Runs before any jax import in this
+    process, so the flag pin is still effective."""
+    if "xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    return _dp_rates(jax.devices(),
+                     B=int(os.environ.get("BENCH_DP_B", 16)),
+                     T=int(os.environ.get("BENCH_DP_T", 256)),
+                     K=int(os.environ.get("BENCH_DP_K", 8)),
+                     dps=(1, 2, 4, 8),
+                     reps=int(os.environ.get("BENCH_REPS", 3)))
+
+
+def bench_dp_scaling(n_dev: int, devices) -> dict:
+    """North-star shape (scaled) at dp=1/2/4/8 over a fixed batch, with
+    per-device efficiency. With >= 8 devices already addressable (a
+    real slice, or the test tier's virtual mesh) the measurement runs
+    inline; a 1-device CPU backend re-runs it in a child pinned to the
+    8-virtual-device CPU mesh (--xla_force_host_platform_device_count),
+    so the dp sharding path is exercised on every backend."""
+    accel = _accel(devices)
+    inline = len(devices) >= 8
+    # the child is always CPU-pinned, so its shape must be CPU-sized
+    # even when THIS process sits on a (small) accelerator: T=1024 on
+    # a CPU child is ~64x the per-history closure work of T=256 and
+    # can eat the whole subprocess budget
+    cpu_sized = not (accel and inline)
+    B = int(os.environ.get("BENCH_DP_B", 16 if cpu_sized else 32))
+    T = int(os.environ.get("BENCH_DP_T", 256 if cpu_sized else 1024))
+    K = int(os.environ.get("BENCH_DP_K", 8))
+    reps = int(os.environ.get("BENCH_REPS", 3))
+    virtual = not accel
+    if inline:
+        rows = _dp_rates(devices, B, T, K, (1, 2, 4, 8), reps)
+    elif os.environ.get("BENCH_DP_CHILD", "1") == "0":
+        return {"skipped": "needs >=8 devices (BENCH_DP_CHILD=0)"}
+    else:
+        import subprocess
+
+        env = {**os.environ, "BENCH_DP_INNER": "1",
+               "JAX_PLATFORMS": "cpu", "JEPSEN_TPU_PLATFORM": "cpu",
+               "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                             + " --xla_force_host_platform_device_count"
+                               "=8").strip(),
+               "BENCH_DP_B": str(B), "BENCH_DP_T": str(T),
+               "BENCH_DP_K": str(K), "BENCH_REPS": str(reps)}
+        p = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           capture_output=True, text=True, timeout=900,
+                           env=env)
+        rows = None
+        for line in reversed((p.stdout or "").strip().splitlines()):
+            try:
+                got = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(got, list):   # stray JSON-ish prints skipped
+                rows = got
+                break
+        if rows is None:
+            raise RuntimeError(
+                f"dp child rc={p.returncode}: "
+                + (p.stderr or "")[-200:])
+        virtual = True
+    r1 = next((r["rate"] for r in rows if r["dp"] == 1), None)
+    for r in rows:
+        r["vs_dp1"] = round(r["rate"] / r1, 3) if r1 else None
+        # strong scaling over the fixed batch: on real chips ideal
+        # rate is dp x rate(dp1); on the shared-core virtual mesh the
+        # cores do the same total work at every dp, so the honest
+        # per-device number is just vs_dp1 (sharding overhead)
+        r["per_device_efficiency"] = (
+            round(r["rate"] / (r["dp"] * r1), 3)
+            if (not virtual and r1) else r["vs_dp1"])
+    d8 = next((r for r in rows if r["dp"] == 8), None)
+    measured = [r["dp"] for r in rows]
+    return {
+        "metric": f"dp-scaling detect rate ({B}x{T}-txn fixed batch, "
+                  f"dp={'/'.join(map(str, measured))})",
+        "unit": "histories/sec",
+        "mesh": "virtual-cpu-8" if virtual else f"{len(devices)}-dev",
+        "rates": rows,
+        # tiers _dp_rates couldn't run (B not a dp multiple / too few
+        # devices) — named so a null dp8_efficiency is self-explaining
+        "skipped_dps": [d for d in (1, 2, 4, 8) if d not in measured],
+        "dp8_efficiency": (d8 or {}).get("per_device_efficiency"),
+    }
 
 
 def bench_end_to_end(n_dev: int, devices) -> dict:
@@ -618,34 +766,72 @@ def bench_north_star(n_dev: int, devices) -> dict:
             tracer = _prof.trace(profile_dir)
         else:
             tracer = contextlib.nullcontext()
-        # Timed region = analyze-store's streaming pipeline: each
-        # chunk's device sweep overlaps the pool's parsing of the next
-        # chunk (on accelerators the device time hides under ingest).
+        # Timed region = analyze-store's streaming pipeline, now
+        # genuinely double-buffered: chunk N is DISPATCHED async
+        # (check_bucketed_async — no blocking device_get), then chunk
+        # N-1's flags are collected and rendered while N computes, and
+        # the pool parses chunk N+1 in the background throughout.
+        # Every host second lands in a named phase: parse (main-thread
+        # stall on the ingest pool), pack / h2d / dispatch (inside
+        # check_bucketed_async), collect (block + D2H), render.
         # Pipelining decision passed down as a parameter (the same
         # cleanup cli.py got): a worker pays off on a 1-core host only
         # when a real device runs the checks.
         procs = max(1, os.cpu_count() or 1) if accel else None
         pipe_info: dict = {}
-        dev_spans: list = []   # wall-clock device-dispatch windows
+        dev_spans: list = []   # wall-clock device-in-flight windows
+        phases: dict = {}
+        verdicts: list = []
+        pend = None            # (PendingVerdicts, chunk encs, t_dispatch)
+
+        def collect(pend_):
+            """Resolve one in-flight chunk: close its device window
+            (dispatch-enqueued -> flags materialized, monotonic — the
+            same clock as the workers' parse spans) and render."""
+            pv, pencs, ptd = pend_
+            flags = pv.result(phases)
+            dev_spans.append((ptd, time.monotonic()))
+            tr = time.perf_counter()
+            verdicts.extend(elle.render_verdict(e, c, prohibited)
+                            for e, c in zip(pencs, flags))
+            parallel._acc_phase(phases, "render", tr)
+
         with tracer:
             t0 = time.perf_counter()
-            cycles = []
-            for part in ingest.iter_encode_chunks(dirs, "append",
-                                                  chunk=chunk,
-                                                  processes=procs,
-                                                  info=pipe_info):
-                chunk_encs = [e for _d, e in part]
-                assert not any(isinstance(e, Exception)
-                               for e in chunk_encs)
-                td = time.monotonic()   # same clock as parse_spans
-                cycles.extend(parallel.check_bucketed(
-                    chunk_encs, mesh, budget_cells=budget))
-                dev_spans.append((td, time.monotonic()))
+            it = iter(ingest.iter_encode_chunks(dirs, "append",
+                                                chunk=chunk,
+                                                processes=procs,
+                                                info=pipe_info))
+            while True:
+                if pend is not None and pend[0].is_ready():
+                    # flags already materialized: close this chunk's
+                    # device window BEFORE the next parse stall, so an
+                    # idle device can never count host parsing as
+                    # overlap (the honesty contract of
+                    # pipeline_overlap_secs)
+                    collect(pend)
+                    pend = None
+                tw = time.perf_counter()
+                part = next(it, None)
+                parallel._acc_phase(phases, "parse", tw)
+                nxt = None
+                if part is not None:
+                    chunk_encs = [e for _d, e in part]
+                    assert not any(isinstance(e, Exception)
+                                   for e in chunk_encs)
+                    pv = parallel.check_bucketed_async(
+                        chunk_encs, mesh, budget_cells=budget,
+                        phases=phases)
+                    # window starts AFTER the async enqueue returns —
+                    # the device cannot have been computing earlier
+                    nxt = (pv, chunk_encs, time.monotonic())
+                if pend is not None:
+                    collect(pend)
+                if part is None:
+                    break
+                pend = nxt
             t_sweep = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        verdicts = [elle.render_verdict(e, c, prohibited)
-                    for e, c in zip(encs, cycles)]
-        t_render = time.perf_counter() - t0
+        t_render = phases.get("render", 0.0)
 
         n_bad = sum(1 for v in verdicts if v["valid?"] is False)
         expect_bad = B // bad_every if bad_every else 0
@@ -653,16 +839,17 @@ def bench_north_star(n_dev: int, devices) -> dict:
         assert all("G1c" in v["anomaly-types"] for v in verdicts
                    if v["valid?"] is False)
 
-        # store->verdict wall clock: the pipelined sweep (ingest and
-        # device check overlapped) plus rendering
-        total = t_sweep + t_render
+        # store->verdict wall clock: the double-buffered sweep, with
+        # rendering overlapped inside it (the render phase rides the
+        # device's compute windows)
+        total = t_sweep
         rate = B / total
         target = 10_000 / 60.0 * (n_dev / 8.0)
         # MFU from MEASURED closure rounds: the detect pass squares one
-        # [T_pad, T_pad] bf16 matrix per round per history at 2·T³
-        # FLOPs; the kernel early-exits at its fixpoint, so the round
-        # count is read back from the while_loop counter on a sample of
-        # the real batch instead of assumed (VERDICT r3 weak-3).
+        # [T_pad, T_pad] matrix per round per history at 2·T³ ops; the
+        # kernel early-exits at its fixpoint, so the round count is
+        # read back from the while_loop counter on a sample of the
+        # real batch instead of assumed (VERDICT r3 weak-3).
         t_pad = K_.pad_to(T, 128)
         env_rounds = os.environ.get("BENCH_NS_ROUNDS")
         if env_rounds is not None:
@@ -679,9 +866,20 @@ def bench_north_star(n_dev: int, devices) -> dict:
                 rounds_src = f"measured on {len(sample)} histories"
             except Exception as e:
                 rounds, rounds_src = 5.0, f"fallback: {e!r}"[:120]
-        peak = float(os.environ.get("BENCH_PEAK_TFLOPS", 197)) * 1e12
+        # peak throughput of the formulation the sweep ACTUALLY ran:
+        # the auto default is the int8 closure (resolve_formulation),
+        # whose v5e MXU peak is 394 TOPS — not bf16's 197 TFLOPS
+        use_pallas_f, use_int8_f = K_.resolve_formulation(
+            single_device=mesh is None)
+        peak = float(os.environ.get(
+            "BENCH_PEAK_TFLOPS", 394 if use_int8_f else 197)) * 1e12
         mfu = (B * rounds * 2 * t_pad ** 3) / (t_check * peak * n_dev) \
             if accel else None
+        formulation = (("pallas" if use_pallas_f else "xla")
+                       + ("-int8" if use_int8_f else "-bf16"))
+        phase_out = {k: round(phases.get(k, 0.0), 3)
+                     for k in ("parse", "pack", "h2d", "dispatch",
+                               "collect", "render")}
         return {
             "metric": f"north-star store->verdict histories/sec "
                       f"({B}x{T}-txn, {n_dev} dev)",
@@ -692,17 +890,24 @@ def bench_north_star(n_dev: int, devices) -> dict:
             "sweep_secs": round(t_sweep, 3),
             "ingest_secs": round(t_ingest, 3),
             "check_secs": round(t_check, 3),
-            # overlap is only a claim when background workers actually
-            # ran; the serial path's smaller sweep time is just warm
-            # caches, not pipelining
-            "pipeline_overlap": round(
-                max(0.0, t_ingest + t_check - t_sweep), 3)
-            if pipe_info.get("pooled") else 0.0,
-            # MEASURED overlap: seconds where a worker's parse span
-            # intersected a device-dispatch span — direct evidence the
-            # pipeline hid host parsing under device compute, immune
-            # to the end-to-end subtraction's startup noise
-            "pipeline_overlap_measured": round(ingest.overlap_seconds(
+            # Full attribution of sweep_secs: every main-thread second
+            # of the pipelined sweep lands in exactly one phase —
+            # parse (stall on the ingest pool), pack (bucket planning +
+            # host tensor packing), h2d (device_put/sharding), dispatch
+            # (async kernel enqueue), collect (block + D2H + flag
+            # decode), render (verdict rendering). Their sum tracks
+            # sweep_secs up to loop glue.
+            "phases": phase_out,
+            "phases_sum_secs": round(sum(phase_out.values()), 3),
+            # THE overlap number (one field, measured, replacing the
+            # old pipeline_overlap/pipeline_overlap_measured pair):
+            # seconds where a pool worker's parse span intersected a
+            # device-in-flight span (async enqueue returned -> flags
+            # materialized; a chunk observed ready before a stall is
+            # closed first, so an idle device never counts host
+            # parsing as overlap). 0.0 whenever the sweep ran
+            # strictly serial.
+            "pipeline_overlap_secs": round(ingest.overlap_seconds(
                 pipe_info.get("parse_spans", []), dev_spans), 3),
             "pipelined": bool(pipe_info.get("pooled")),
             # whether the C++ jsonl->tensor path (native/hist_encode.cc)
@@ -712,9 +917,12 @@ def bench_north_star(n_dev: int, devices) -> dict:
             "invalid_found": n_bad,
             "closure_rounds": rounds,
             "rounds_source": rounds_src,
+            "mfu_formulation": formulation,
             "mfu_measured": round(mfu, 4) if mfu is not None else None,
             "mfu_model": f"{rounds:g} rounds ({rounds_src}) x 2T^3 "
-                         f"bf16, peak {peak / 1e12:g} TF/chip",
+                         f"{'int8' if use_int8_f else 'bf16'} ops, "
+                         f"peak {peak / 1e12:g} "
+                         f"{'TOPS' if use_int8_f else 'TFLOPS'}/chip",
         }
     finally:
         shutil.rmtree(root, ignore_errors=True)
@@ -763,6 +971,7 @@ def run_benches() -> int:
             ("end_to_end", bench_end_to_end, (n_dev, devices)),
             ("register_sweep", bench_register_sweep, (n_dev, devices)),
             ("north_star", bench_north_star, (n_dev, devices)),
+            ("dp_scaling", bench_dp_scaling, (n_dev, devices)),
             ("generator", bench_generator, (reps,))):
         try:
             if name in force_fail:
@@ -784,6 +993,10 @@ def main() -> int:
     client creation ignores signals and can't free itself. Only a
     supervisor that never touches JAX can guarantee the driver always
     gets a JSON line (round 2 recorded rc=1 and zero perf evidence)."""
+    if os.environ.get("BENCH_DP_INNER"):
+        # dp-scaling child: booted with the 8-virtual-device CPU mesh
+        print(json.dumps(_dp_scaling_inner()))
+        return 0
     if os.environ.get("BENCH_CHILD"):
         return run_benches()
 
@@ -810,7 +1023,7 @@ def main() -> int:
                       + " | ".join(tail))[:400]
 
     blocks = ("knossos", "long_history", "end_to_end", "register_sweep",
-              "north_star",
+              "north_star", "dp_scaling",
               "generator")
     cpu_env = {"JEPSEN_TPU_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu",
                "BENCH_ATTEMPT": "cpu-retry"}

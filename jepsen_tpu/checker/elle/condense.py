@@ -267,9 +267,14 @@ def check_condensed(enc: EncodedHistory, *, classify: bool = True,
     if per_scc:
         # bucketed: many small SCCs padded to the largest one's T would
         # otherwise pack into a single over-budget [B,T,T]x3 dispatch
+        # fused=False: every SCC here is cyclic by construction, so the
+        # fused kernel's any-cycle cond would always fire and its
+        # unseeded full closure would just re-walk what the chained
+        # warm starts get for free
         for res in K.check_edge_batch_bucketed(per_scc, classify=True,
                                                realtime=realtime,
                                                process_order=False,
-                                               devices=devices):
+                                               devices=devices,
+                                               fused=False):
             flags.update(res)
     return flags

@@ -105,6 +105,18 @@ def pack_batch(encs: list[EncodedHistory],
 _env_warned = False
 
 
+def fused_classify_enabled() -> bool:
+    """One home for the JEPSEN_TPU_FUSED_CLASSIFY gate (default on):
+    classify dispatches run the fused detect/classify kernel — one
+    detect closure per history, with the classification closures behind
+    a `lax.cond` that only fires when some history in the batch is
+    cyclic. `=0` restores the separate detect-then-classify re-dispatch
+    (the pre-fusion two-pass strategy) for A/B runs."""
+    import os
+
+    return os.environ.get("JEPSEN_TPU_FUSED_CLASSIFY", "1") != "0"
+
+
 def resolve_formulation(use_pallas: bool | None = None,
                         use_int8: bool | None = None, *,
                         single_device: bool) -> tuple[bool, bool]:
@@ -232,7 +244,7 @@ def _closure_batched(m: jnp.ndarray, steps: int, constrain,
     """Transitive closure of [B,T,T] boolean adjacencies via repeated
     squaring; each squaring is one batched matmul on the MXU — bf16 by
     default, or int8×int8→int32 with use_int8: the MXU's int8 path has
-    ~2× the bf16 throughput on v5e (399 TOPS vs 197 TFLOPS) and the
+    ~2× the bf16 throughput on v5e (394 TOPS vs 197 TFLOPS) and the
     boolean closure is exact in either (non-negative terms, int32
     accumulation never overflows below T=2^31). use_pallas composes
     with use_int8 (fusion × arithmetic); the bench races all four
@@ -318,7 +330,8 @@ def check_batched_impl(appends, reads, invoke_index, complete_index, process,
                        steps: int, classify: bool, realtime: bool,
                        process_order: bool, constrain,
                        use_pallas: bool = False,
-                       use_int8: bool = False) -> jnp.ndarray:
+                       use_int8: bool = False,
+                       fused: bool = True) -> jnp.ndarray:
     """THE cycle-check kernel: packed [B,...] tensors -> [B] int32 flag
     words. `n_live` is the per-history real txn count ([B]); rows beyond
     it are excluded from realtime/process edges."""
@@ -329,14 +342,33 @@ def check_batched_impl(appends, reads, invoke_index, complete_index, process,
         ww, wr, rw, invoke_index, complete_index, process, n_live,
         steps=steps, classify=classify, realtime=realtime,
         process_order=process_order, constrain=constrain,
-        use_pallas=use_pallas, use_int8=use_int8)
+        use_pallas=use_pallas, use_int8=use_int8, fused=fused)
+
+
+def _flags_from_closures(ww, wr, rw, c_ww, c_wwr, c_full, cycle,
+                         nI) -> jnp.ndarray:
+    """Anomaly flag words from the three edge classes and their three
+    (nested) closures — the one classification formula, shared by the
+    fused and unfused classify paths so their verdicts can't drift."""
+    cT_wwr = jnp.swapaxes(c_wwr, 1, 2)
+    g0 = jnp.any(ww & jnp.swapaxes(c_ww, 1, 2) & nI, axis=(1, 2))
+    g1c = jnp.any(wr & cT_wwr, axis=(1, 2))
+    g_single = jnp.any(rw & cT_wwr, axis=(1, 2))
+    g2 = jnp.any(rw & jnp.swapaxes(c_full, 1, 2) & ~cT_wwr, axis=(1, 2))
+    cycle = cycle | g0 | g1c | g_single | g2
+    return (g0.astype(jnp.int32) << G0) \
+        | (g1c.astype(jnp.int32) << G1C) \
+        | (g_single.astype(jnp.int32) << G_SINGLE) \
+        | (g2.astype(jnp.int32) << G2_ITEM) \
+        | (cycle.astype(jnp.int32) << CYCLE)
 
 
 def classify_matrices_impl(ww, wr, rw, invoke_index, complete_index, process,
                            n_live, *, steps: int, classify: bool,
                            realtime: bool, process_order: bool,
                            constrain, use_pallas: bool = False,
-                           use_int8: bool = False) -> jnp.ndarray:
+                           use_int8: bool = False,
+                           fused: bool = True) -> jnp.ndarray:
     """Closure + anomaly classification over explicit [B,T,T] boolean edge
     matrices. Entry point for checkers (rw-register) whose edge
     construction happens host-side from inferred version graphs rather
@@ -372,10 +404,43 @@ def classify_matrices_impl(ww, wr, rw, invoke_index, complete_index, process,
         cycle = jnp.any(full & jnp.swapaxes(c_full, 1, 2) & nI,
                         axis=(1, 2))
         return cycle.astype(jnp.int32) << CYCLE
-    # Chained warm starts: closure(A|B) == closure(closure(A)|B), so
-    # seeding each wider closure with the previous result is exact and
-    # each seeded closure converges in the few rounds its NEW edge
-    # class adds, instead of re-walking the whole graph three times.
+    if fused:
+        # Fused detect/classify (Elle's own design point: classification
+        # falls out of the same graph detection walks): run the detect
+        # closure first, and gate the classification closures behind a
+        # lax.cond on "any history in this batch is cyclic". The common
+        # all-valid batch pays exactly the detect cost — one closure —
+        # while a batch with positives runs the per-class closures
+        # REUSING the already-computed full closure for the cycle and
+        # G2-item tests. Exact, because every per-class witness edge
+        # implies a cycle in the full graph (each edge class is a
+        # subset of `full` and each per-class closure a subset of
+        # c_full), so a batch where the detect test fires nowhere can
+        # only classify to zero flags.
+        c_full, _ = _closure_batched(full, steps, constrain, use_pallas,
+                                     use_int8)
+        cycle = jnp.any(full & jnp.swapaxes(c_full, 1, 2) & nI,
+                        axis=(1, 2))
+
+        def _classify(ops):
+            ww_, wr_, rw_, c_full_, cycle_ = ops
+            c_ww, _ = _closure_batched(ww_, steps, constrain,
+                                       use_pallas, use_int8)
+            c_wwr, _ = _closure_batched(c_ww | wr_, steps, constrain,
+                                        use_pallas, use_int8)
+            return _flags_from_closures(ww_, wr_, rw_, c_ww, c_wwr,
+                                        c_full_, cycle_, nI)
+
+        def _clean(ops):
+            return ops[4].astype(jnp.int32) << CYCLE
+
+        return jax.lax.cond(jnp.any(cycle), _classify, _clean,
+                            (ww, wr, rw, c_full, cycle))
+    # Unfused baseline (JEPSEN_TPU_FUSED_CLASSIFY=0): chained warm
+    # starts — closure(A|B) == closure(closure(A)|B), so seeding each
+    # wider closure with the previous result is exact and each seeded
+    # closure converges in the few rounds its NEW edge class adds,
+    # instead of re-walking the whole graph three times.
     c_ww, _ = _closure_batched(ww, steps, constrain, use_pallas,
                                use_int8)
     c_wwr, _ = _closure_batched(c_ww | wr, steps, constrain, use_pallas,
@@ -383,17 +448,8 @@ def classify_matrices_impl(ww, wr, rw, invoke_index, complete_index, process,
     c_full, _ = _closure_batched(c_wwr | rw, steps, constrain,
                                  use_pallas, use_int8)
     cycle = jnp.any(full & jnp.swapaxes(c_full, 1, 2) & nI, axis=(1, 2))
-    cT_wwr = jnp.swapaxes(c_wwr, 1, 2)
-    g0 = jnp.any(ww & jnp.swapaxes(c_ww, 1, 2) & nI, axis=(1, 2))
-    g1c = jnp.any(wr & cT_wwr, axis=(1, 2))
-    g_single = jnp.any(rw & cT_wwr, axis=(1, 2))
-    g2 = jnp.any(rw & jnp.swapaxes(c_full, 1, 2) & ~cT_wwr, axis=(1, 2))
-    cycle = cycle | g0 | g1c | g_single | g2
-    return (g0.astype(jnp.int32) << G0) \
-        | (g1c.astype(jnp.int32) << G1C) \
-        | (g_single.astype(jnp.int32) << G_SINGLE) \
-        | (g2.astype(jnp.int32) << G2_ITEM) \
-        | (cycle.astype(jnp.int32) << CYCLE)
+    return _flags_from_closures(ww, wr, rw, c_ww, c_wwr, c_full, cycle,
+                                nI)
 
 
 def _identity(x):
@@ -402,37 +458,40 @@ def _identity(x):
 
 @functools.partial(jax.jit, static_argnames=(
     "n_keys", "max_pos", "n_txns", "steps", "classify", "realtime",
-    "process_order", "use_pallas", "use_int8"))
+    "process_order", "use_pallas", "use_int8", "fused"))
 def check_batch_device(appends, reads, invoke_index, complete_index, process,
                        n_live, *, n_keys: int, max_pos: int, n_txns: int,
                        steps: int, classify: bool = True,
                        realtime: bool = False,
                        process_order: bool = False,
                        use_pallas: bool = False,
-                       use_int8: bool = False) -> jnp.ndarray:
+                       use_int8: bool = False,
+                       fused: bool = True) -> jnp.ndarray:
     """Single-device jitted entry over a packed batch: [B] int32 flags."""
     return check_batched_impl(
         appends, reads, invoke_index, complete_index, process, n_live,
         n_keys=n_keys, max_pos=max_pos, n_txns=n_txns, steps=steps,
         classify=classify, realtime=realtime, process_order=process_order,
-        constrain=_identity, use_pallas=use_pallas, use_int8=use_int8)
+        constrain=_identity, use_pallas=use_pallas, use_int8=use_int8,
+        fused=fused)
 
 
 @functools.partial(jax.jit, static_argnames=(
     "steps", "classify", "realtime", "process_order", "use_pallas",
-    "use_int8"))
+    "use_int8", "fused"))
 def classify_matrices_device(ww, wr, rw, invoke_index, complete_index,
                              process, n_live, *, steps: int,
                              classify: bool = True, realtime: bool = False,
                              process_order: bool = False,
                              use_pallas: bool = False,
-                             use_int8: bool = False) -> jnp.ndarray:
+                             use_int8: bool = False,
+                             fused: bool = True) -> jnp.ndarray:
     """Jitted single-device entry over packed [B,T,T] edge matrices."""
     return classify_matrices_impl(
         ww, wr, rw, invoke_index, complete_index, process, n_live,
         steps=steps, classify=classify, realtime=realtime,
         process_order=process_order, constrain=_identity,
-        use_pallas=use_pallas, use_int8=use_int8)
+        use_pallas=use_pallas, use_int8=use_int8, fused=fused)
 
 
 def pack_edge_matrices(per_history: list[dict], multiple: int = 128) -> dict:
@@ -471,7 +530,8 @@ def pack_edge_matrices(per_history: list[dict], multiple: int = 128) -> dict:
 
 def check_edge_batch(per_history: list[dict], realtime: bool = False,
                      process_order: bool = False,
-                     classify: bool = True, devices=None) -> list[dict]:
+                     classify: bool = True, devices=None,
+                     fused: bool | None = None) -> list[dict]:
     """Device cycle check over host-built edge lists: per-history
     {anomaly-name: True} dicts (the rw-register device path, and the
     per-SCC classify stage of the condensed long-history path).
@@ -499,10 +559,12 @@ def check_edge_batch(per_history: list[dict], realtime: bool = False,
                 for k in names]
     use_pallas, use_int8 = resolve_formulation(
         single_device=len(devices) == 1)
+    if fused is None:
+        fused = fused_classify_enabled()
     flags = classify_matrices_device(
         *args, steps=closure_steps(p["T"]), classify=classify,
         realtime=realtime, process_order=process_order,
-        use_pallas=use_pallas, use_int8=use_int8)
+        use_pallas=use_pallas, use_int8=use_int8, fused=fused)
     return [flags_to_names(int(w)) for w in np.asarray(flags)[:n]]
 
 
@@ -510,7 +572,8 @@ def check_edge_batch_bucketed(per_history: list[dict],
                               realtime: bool = False,
                               process_order: bool = False,
                               classify: bool = True, devices=None,
-                              budget_cells: int = 1 << 27) -> list[dict]:
+                              budget_cells: int = 1 << 27,
+                              fused: bool | None = None) -> list[dict]:
     """check_edge_batch with device-memory-aware length bucketing: the
     packed matrices are B·T_pad² cells × 3 edge classes, so one
     unbucketed dispatch over a big store would blow HBM. Reuses
@@ -529,7 +592,8 @@ def check_edge_batch_bucketed(per_history: list[dict],
         res = check_edge_batch([per_history[j] for j in bucket],
                                realtime=realtime,
                                process_order=process_order,
-                               classify=classify, devices=devices)
+                               classify=classify, devices=devices,
+                               fused=fused)
         for j, r in zip(bucket, res):
             out[j] = r
     return out  # type: ignore[return-value]
@@ -582,5 +646,6 @@ def check_encoded_batch(encs: list[EncodedHistory],
         *args, n_keys=shape.n_keys, max_pos=shape.max_pos,
         n_txns=shape.n_txns, steps=closure_steps(shape.n_txns),
         classify=classify, realtime=realtime, process_order=process_order,
-        use_pallas=use_pallas, use_int8=use_int8)
+        use_pallas=use_pallas, use_int8=use_int8,
+        fused=fused_classify_enabled())
     return [flags_to_names(int(w)) for w in np.asarray(flags)[:n]]

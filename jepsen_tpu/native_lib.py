@@ -117,7 +117,7 @@ def available() -> bool:
 
 def _bind_hist(L: ctypes.CDLL) -> bool:
     L.jt_ha_abi_version.restype = ctypes.c_int64
-    if L.jt_ha_abi_version() != 2:
+    if L.jt_ha_abi_version() != 3:
         return False
     i32p = ctypes.POINTER(ctypes.c_int32)
     i64p = ctypes.POINTER(ctypes.c_int64)
@@ -141,6 +141,17 @@ def _bind_hist(L: ctypes.CDLL) -> bool:
     L.jt_ha_pre_key_names_json.argtypes = [ctypes.c_void_p]
     L.jt_ha_free.restype = None
     L.jt_ha_free.argtypes = [ctypes.c_void_p]
+    # per-key split (jt_ks_*): same library, own handle type
+    L.jt_ks_split_file.restype = ctypes.c_void_p
+    L.jt_ks_split_file.argtypes = [ctypes.c_char_p]
+    L.jt_ks_dims.restype = None
+    L.jt_ks_dims.argtypes = [ctypes.c_void_p, i64p]
+    L.jt_ks_key_ids.restype = i32p
+    L.jt_ks_key_ids.argtypes = [ctypes.c_void_p]
+    L.jt_ks_key_names_json.restype = ctypes.c_char_p
+    L.jt_ks_key_names_json.argtypes = [ctypes.c_void_p]
+    L.jt_ks_free.restype = None
+    L.jt_ks_free.argtypes = [ctypes.c_void_p]
     return True
 
 
@@ -227,6 +238,41 @@ def tarjan_scc_csr(n: int, row_ptr: np.ndarray,
     out = np.empty(n, np.int64)
     L.jt_tarjan_scc(n, _p(row_ptr), _p(col), _p(out))
     return out
+
+
+def split_key_ids(path) -> tuple[list, np.ndarray] | None:
+    """Per-op [key value] split ids for a history.jsonl, from the
+    native splitter (hist_encode.cc's jt_ks_* ABI): returns
+    (keys, key_ids) where `keys` are the lifted key values in
+    first-seen order and `key_ids` is an int32 array aligned with the
+    file's op lines (-1 = un-lifted op). None means "use the Python
+    splitter" (lib unavailable, file absent, or content whose lift /
+    key-equality semantics the native pass can't replicate)."""
+    import json
+
+    L = hist_lib()
+    if L is None:
+        return None
+    h = L.jt_ks_split_file(os.fsencode(path))
+    if not h:
+        return None
+    try:
+        dims = (ctypes.c_int64 * 4)()
+        L.jt_ks_dims(h, dims)
+        n_ops, n_keys, json_len, _lifted = dims
+        if n_ops == 0:
+            ids = np.zeros(0, np.int32)
+        else:
+            ids = np.ctypeslib.as_array(
+                L.jt_ks_key_ids(h), shape=(int(n_ops),)).copy()
+        keys = json.loads(
+            L.jt_ks_key_names_json(h).decode("utf-8")) if json_len \
+            else []
+        if len(keys) != int(n_keys):
+            return None  # ABI drift: don't guess
+        return keys, ids
+    finally:
+        L.jt_ks_free(h)
 
 
 def reach(n: int, adj: list[list[int]],

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import functools
 import math
+import time
 from typing import Sequence
 
 import jax
@@ -87,7 +88,8 @@ def sharded_check_fn(mesh: Mesh | None, shape: K.BatchShape, *,
                      classify: bool = True, realtime: bool = False,
                      process_order: bool = False,
                      use_pallas: bool | None = None,
-                     use_int8: bool | None = None):
+                     use_int8: bool | None = None,
+                     fused: bool | None = None):
     """Build a jitted batched checker around kernels.check_batched_impl.
     With a mesh, inputs are expected sharded over 'dp' and the closure
     matrices are constrained to P('dp', None, 'mp'); without one, it's
@@ -112,8 +114,14 @@ def sharded_check_fn(mesh: Mesh | None, shape: K.BatchShape, *,
                          "sharded dispatch uses the XLA closure path")
     use_pallas, use_int8 = K.resolve_formulation(
         use_pallas, use_int8, single_device=mesh is None)
+    if fused is None:
+        fused = K.fused_classify_enabled()
+    # fused only exists in classify mode; normalize so detect-mode
+    # dispatches never compile twice over an irrelevant flag
+    fused = bool(fused) and classify
     return _sharded_check_fn_cached(mesh, shape, classify, realtime,
-                                    process_order, use_pallas, use_int8)
+                                    process_order, use_pallas, use_int8,
+                                    fused)
 
 
 @functools.lru_cache(maxsize=64)
@@ -121,7 +129,8 @@ def _sharded_check_fn_cached(mesh: Mesh | None, shape: K.BatchShape,
                              classify: bool, realtime: bool,
                              process_order: bool,
                              use_pallas: bool = False,
-                             use_int8: bool = False):
+                             use_int8: bool = False,
+                             fused: bool = False):
     if mesh is not None:
         spec = P("dp", None, "mp")
 
@@ -136,7 +145,8 @@ def _sharded_check_fn_cached(mesh: Mesh | None, shape: K.BatchShape,
         K.check_batched_impl, n_keys=shape.n_keys, max_pos=shape.max_pos,
         n_txns=shape.n_txns, steps=K.closure_steps(shape.n_txns),
         classify=classify, realtime=realtime, process_order=process_order,
-        constrain=constrain, use_pallas=use_pallas, use_int8=use_int8)
+        constrain=constrain, use_pallas=use_pallas, use_int8=use_int8,
+        fused=fused)
     if mesh is None:
         return jax.jit(f)
     in_shard = NamedSharding(mesh, P("dp"))
@@ -246,53 +256,95 @@ def bucket_by_length(encs: Sequence, *, multiple: int = 128,
     return buckets
 
 
-def check_bucketed(encs: Sequence, mesh: Mesh | None = None, *,
-                   classify: bool = True, realtime: bool = False,
-                   process_order: bool = False,
-                   budget_cells: int = 1 << 27,
-                   two_pass: bool | None = None) -> list[dict]:
-    """Check many encoded histories bucketed by length: one device
-    dispatch per bucket, results returned in input order.
+def _acc_phase(phases: dict | None, key: str, t0: float) -> None:
+    """Accumulate a wall-clock span into a caller-supplied phase dict —
+    the sweep-attribution hook (every host second of a bucketed sweep
+    lands in exactly one named phase)."""
+    if phases is not None:
+        phases[key] = phases.get(key, 0.0) + (time.perf_counter() - t0)
 
-    With classify=True the default strategy is detect-then-classify
-    (two_pass): sweep every bucket in detect mode (one closure per
-    history), then re-dispatch ONLY the flagged histories with the
-    classification closures. On the production regime — sweeps that are
-    mostly valid — this pays the ~3x classify cost only for the rare
-    positives, so the sweep runs at the detect rate; verdicts are
-    identical because a cycle-free graph classifies to zero flags."""
-    if not len(encs):
-        return []
-    if two_pass is None:
-        two_pass = classify
-    if classify and two_pass:
-        detect = check_bucketed(encs, mesh, classify=False,
-                                realtime=realtime,
-                                process_order=process_order,
-                                budget_cells=budget_cells)
-        flagged = [i for i, f in enumerate(detect) if f]
-        if not flagged:
-            return detect
-        full = check_bucketed([encs[i] for i in flagged], mesh,
-                              classify=True, realtime=realtime,
-                              process_order=process_order,
-                              budget_cells=budget_cells, two_pass=False)
-        out = list(detect)
-        for i, r in zip(flagged, full):
-            out[i] = r
-        return out
-    out: list[dict | None] = [None] * len(encs)
+
+class PendingVerdicts:
+    """Verdicts still in flight: `check_bucketed_async` queues every
+    bucket's device dispatch without a host sync, so the caller can
+    overlap ingest/packing of the NEXT chunk with the device's work on
+    this one. `.result()` blocks, pulls the flag words D2H and returns
+    per-history {anomaly: True} dicts in input order."""
+
+    def __init__(self, n: int, parts: list):
+        self._n = n
+        self._parts = parts       # [(bucket indices, device flags)]
+
+    def is_ready(self) -> bool:
+        """True when every bucket's flags have materialized (no block):
+        lets callers close an honest device-in-flight window — a chunk
+        whose flags are already ready before the next host stall must
+        not count that stall as pipeline overlap."""
+        return all(getattr(f, "is_ready", lambda: True)()
+                   for _, f in self._parts)
+
+    def result(self, phases: dict | None = None) -> list[dict]:
+        t0 = time.perf_counter()
+        out: list[dict | None] = [None] * self._n
+        for idx, flags in self._parts:
+            flags = np.asarray(jax.block_until_ready(flags))
+            # padded replicas (indices shorter than flags) are dropped
+            for i, w in zip(idx, flags):
+                out[i] = K.flags_to_names(int(w))
+        self._parts = []
+        _acc_phase(phases, "collect", t0)
+        return out  # type: ignore[return-value]
+
+
+def check_bucketed_async(encs: Sequence, mesh: Mesh | None = None, *,
+                         classify: bool = True, realtime: bool = False,
+                         process_order: bool = False,
+                         budget_cells: int = 1 << 27,
+                         fused: bool | None = None,
+                         max_inflight: int = 2,
+                         phases: dict | None = None) -> PendingVerdicts:
+    """Dispatch a bucketed sweep WITHOUT blocking on the device: every
+    bucket is packed, transferred and queued (JAX dispatch is async),
+    and the returned PendingVerdicts resolves the flags later. This is
+    the double-buffered pipeline's core — the caller dispatches chunk N,
+    then collects chunk N-1 while N computes.
+
+    `max_inflight` bounds how many buckets' packed tensors are resident
+    at once: once more than that many dispatches are outstanding, the
+    oldest is resolved to host flags before the next bucket packs —
+    host packing far outruns the O(T^3) closure, so an unbounded queue
+    would accumulate every bucket's ~budget_cells input tensors in
+    device/host memory (exactly what budget_cells exists to prevent).
+    Double-buffering only needs depth 2.
+
+    `phases` (optional dict) accumulates per-phase host wall-clock:
+    "pack" (bucket planning + host tensor packing), "h2d" (device_put /
+    sharding), "dispatch" (async kernel enqueue); `.result(phases)`
+    and the max_inflight back-pressure add "collect" (block + D2H +
+    flag rendering)."""
+    parts: list = []
+    inflight: list[int] = []    # indices into parts, oldest first
     dp = mesh.devices.shape[0] if mesh is not None else 1
-    for bucket in bucket_by_length(encs, budget_cells=budget_cells, dp=dp):
+    t0 = time.perf_counter()
+    buckets = bucket_by_length(encs, budget_cells=budget_cells, dp=dp)
+    _acc_phase(phases, "pack", t0)
+    for bucket in buckets:
+        while len(inflight) >= max(1, max_inflight):
+            j = inflight.pop(0)
+            t0 = time.perf_counter()
+            idx, flags = parts[j]
+            parts[j] = (idx, np.asarray(jax.block_until_ready(flags)))
+            _acc_phase(phases, "collect", t0)
+        t0 = time.perf_counter()
         group = [encs[i] for i in bucket]
         bucket_mesh = mesh
         if mesh is not None:
             # Pad ragged buckets to a dp multiple by replicating the
-            # last history (results dropped below) so the dispatch still
-            # shards across the mesh instead of falling to one device —
-            # unless the padding itself would blow the budget (a single
-            # history bigger than budget/dp), in which case dispatch
-            # unsharded rather than 8x over budget.
+            # last history (results dropped at collect) so the dispatch
+            # still shards across the mesh instead of falling to one
+            # device — unless the padding itself would blow the budget
+            # (a single history bigger than budget/dp), in which case
+            # dispatch unsharded rather than 8x over budget.
             tpad = max(K.pad_to(max(e.n for e in group), 128), 1)
             padded = pad_to_multiple(group, dp)
             if len(padded) * tpad * tpad <= budget_cells:
@@ -301,11 +353,76 @@ def check_bucketed(encs: Sequence, mesh: Mesh | None = None, *,
                 bucket_mesh = None
         shape = K.BatchShape.plan(group)
         packed = K.pack_batch(group, shape)
+        _acc_phase(phases, "pack", t0)
+        t0 = time.perf_counter()
         fn = sharded_check_fn(bucket_mesh, shape, classify=classify,
                               realtime=realtime,
-                              process_order=process_order)
+                              process_order=process_order, fused=fused)
         args = shard_batch(bucket_mesh, packed)
-        flags = np.asarray(jax.block_until_ready(fn(*args)))
-        for i, w in zip(bucket, flags):
-            out[i] = K.flags_to_names(int(w))
-    return out  # type: ignore[return-value]
+        _acc_phase(phases, "h2d", t0)
+        t0 = time.perf_counter()
+        parts.append((bucket, fn(*args)))
+        inflight.append(len(parts) - 1)
+        _acc_phase(phases, "dispatch", t0)
+    return PendingVerdicts(len(encs), parts)
+
+
+def check_bucketed(encs: Sequence, mesh: Mesh | None = None, *,
+                   classify: bool = True, realtime: bool = False,
+                   process_order: bool = False,
+                   budget_cells: int = 1 << 27,
+                   two_pass: bool | None = None,
+                   fused: bool | None = None,
+                   phases: dict | None = None) -> list[dict]:
+    """Check many encoded histories bucketed by length: one device
+    dispatch per bucket, results returned in input order.
+
+    With classify=True the default strategy is the FUSED detect/classify
+    kernel (kernels.fused_classify_enabled): one dispatch per bucket
+    runs the detect closure and only fires the classification closures
+    (via lax.cond) when some history in the bucket is cyclic, reusing
+    the detect pass's full closure for the cycle/G2 tests. On the
+    production regime — sweeps that are mostly valid — every bucket runs
+    at the detect rate with no re-dispatch, which is what lets the
+    streaming pipeline stay async end to end. The cond is per BUCKET:
+    one positive makes its whole bucket pay the classification
+    closures (~3x detect), trading that for zero re-dispatch, no
+    re-pack, and no per-subset recompiles; a sweep whose positives are
+    dense enough to trip most buckets can pin two_pass=True (or
+    JEPSEN_TPU_FUSED_CLASSIFY=0) to get the flagged-subset re-dispatch
+    back.
+
+    two_pass=True (the pre-fusion strategy, and the default when
+    JEPSEN_TPU_FUSED_CLASSIFY=0) sweeps every bucket in detect mode and
+    re-dispatches ONLY flagged histories with the chained classification
+    closures. Verdicts are identical on every strategy because a
+    cycle-free graph classifies to zero flags."""
+    if not len(encs):
+        return []
+    if fused is None:
+        fused = K.fused_classify_enabled()
+    if two_pass is None:
+        two_pass = classify and not fused
+    if classify and two_pass:
+        detect = check_bucketed(encs, mesh, classify=False,
+                                realtime=realtime,
+                                process_order=process_order,
+                                budget_cells=budget_cells, phases=phases)
+        flagged = [i for i, f in enumerate(detect) if f]
+        if not flagged:
+            return detect
+        # the re-dispatch population is all-cyclic, where the chained
+        # warm starts beat the fused kernel's unseeded detect closure
+        full = check_bucketed([encs[i] for i in flagged], mesh,
+                              classify=True, realtime=realtime,
+                              process_order=process_order,
+                              budget_cells=budget_cells, two_pass=False,
+                              fused=False, phases=phases)
+        out = list(detect)
+        for i, r in zip(flagged, full):
+            out[i] = r
+        return out
+    return check_bucketed_async(
+        encs, mesh, classify=classify, realtime=realtime,
+        process_order=process_order, budget_cells=budget_cells,
+        fused=fused, phases=phases).result(phases)

@@ -61,6 +61,15 @@
 //   const char*     jt_ha_pre_key_names_json(h)
 //   void   jt_ha_free(h)
 //
+//   void*  jt_ks_split_file(path)        per-key split ids for
+//                                        independent.subhistories:
+//                                        NULL -> Python splitter
+//   void   jt_ks_dims(h, int64 out[4])   n_ops, n_keys, names_json_len,
+//                                        lifted
+//   const int32_t*  jt_ks_key_ids(h)     per op line; -1 = un-lifted
+//   const char*     jt_ks_key_names_json(h)
+//   void   jt_ks_free(h)
+//
 // Anomaly rows (code, f0, f1, f2, f3):
 //   1 duplicate-appends   (pre_key, value, row, 0)
 //   2 internal            (row, pre_key, 0, 0)
@@ -388,6 +397,33 @@ struct Parser {
     }
   }
 };
+
+// JSON-escape `s` (decoded UTF-8) into `js` so json.loads round-trips
+// it to the identical Python str — shared by the encoder's pre-key
+// table and the splitter's key table.
+void append_json_string(std::string& js, const std::string& s) {
+  js += '"';
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': js += "\\\""; break;
+      case '\\': js += "\\\\"; break;
+      case '\b': js += "\\b"; break;
+      case '\f': js += "\\f"; break;
+      case '\n': js += "\\n"; break;
+      case '\r': js += "\\r"; break;
+      case '\t': js += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char esc[8];
+          snprintf(esc, sizeof esc, "\\u%04x", c);
+          js += esc;
+        } else {
+          js += (char)c;
+        }
+    }
+  }
+  js += '"';
+}
 
 // ---------------------------------------------------------------- encoder
 
@@ -942,32 +978,10 @@ struct Encoder {
     js += '[';
     for (size_t i2 = 0; i2 < pre_keys.size(); ++i2) {
       if (i2) js += ',';
-      if (!pre_keys[i2].first) {
+      if (!pre_keys[i2].first)
         js += std::to_string(pre_keys[i2].second);
-      } else {
-        const std::string& s2 = strs[(size_t)pre_keys[i2].second];
-        js += '"';
-        for (unsigned char c : s2) {
-          switch (c) {
-            case '"': js += "\\\""; break;
-            case '\\': js += "\\\\"; break;
-            case '\b': js += "\\b"; break;
-            case '\f': js += "\\f"; break;
-            case '\n': js += "\\n"; break;
-            case '\r': js += "\\r"; break;
-            case '\t': js += "\\t"; break;
-            default:
-              if (c < 0x20) {
-                char esc[8];
-                snprintf(esc, sizeof esc, "\\u%04x", c);
-                js += esc;
-              } else {
-                js += (char)c;
-              }
-          }
-        }
-        js += '"';
-      }
+      else
+        append_json_string(js, strs[(size_t)pre_keys[i2].second]);
     }
     js += ']';
   }
@@ -1491,11 +1505,275 @@ struct Encoder {
   }
 };
 
+// ------------------------------------------------------------- key split
+//
+// Per-op [key value] split ids for jepsen_tpu/independent.py's
+// store-wide register sweeps (the jt_ks_* ABI): one pass over
+// history.jsonl emits, for every op line, the id of the key its lifted
+// value belongs to (-1 for un-lifted ops) plus the interned key table
+// in first-seen order — replicating relift_history's lift heuristic
+// and subhistories' key ordering exactly, so Python can build the
+// per-key subhistories from the op dicts it already loaded without the
+// per-op relift/is_tuple walk. Anything whose lift or key-equality
+// semantics the int64/string interning can't replicate (float / bool /
+// null / compound first elements on a lifted op — Python's 1 == True
+// == 1.0 — oversized ints, malformed JSON, exotic line breaks)
+// returns NULL and the caller falls back to the pure-Python splitter,
+// so this path can never be wrong, only inapplicable.
+
+struct SplitHandle {
+  std::vector<int32_t> key_ids;    // per op line; -1 = un-lifted
+  std::string key_names_json;      // first-seen order
+  int64_t n_keys = 0;
+  int64_t lifted = 0;              // did the relift heuristic fire?
+};
+
+struct Splitter {
+  struct SOp {
+    uint8_t key_kind = 0;       // 0 none, 1 int, 2 str, 3 unrepresentable
+    int64_t key_i = 0;
+    int32_t key_sid = -1;
+    bool has_value = false;     // "value" present and non-null
+    bool is_list = false;       // value is a JSON array
+    bool is_pair = false;       // ... of exactly 2 elements
+    bool is_nemesis = false;    // process == "nemesis"
+    bool is_ok = false;         // type == "ok"
+    bool is_read = false;       // f == "read"
+  };
+  std::vector<SOp> sops;
+  std::vector<std::string> strs;                   // interned key strings
+  std::unordered_map<std::string, int32_t> str_ids;
+  std::vector<int64_t> ipool;                      // Parser scratch
+  std::string scratch, scratch2;
+
+  // value member: records shape (null / list / pair) and the first
+  // element as the candidate key. Returns false on hard JSON error.
+  bool value_member(Parser& ps, SOp& op) {
+    op.has_value = op.is_list = op.is_pair = false;
+    op.key_kind = 0;
+    ps.ws();
+    if (ps.p >= ps.end) return false;
+    char c = *ps.p;
+    if (c == 'n') {
+      // null: o.get("value") is None — no value, never lifts
+      return ps.lit("null");
+    }
+    if (c != '[') {             // scalar / dict / bool / string value
+      op.has_value = true;
+      ps.skip();
+      return !ps.bail;
+    }
+    ++ps.p;
+    op.has_value = true;
+    op.is_list = true;
+    int n_elems = 0;
+    ps.ws();
+    if (ps.eat(']')) return true;
+    while (true) {
+      ps.ws();
+      if (ps.p >= ps.end) return false;
+      if (n_elems == 0) {
+        char c0 = *ps.p;
+        if (c0 == '"') {
+          std::string& s2 = scratch2;
+          if (!ps.str(s2)) return false;
+          auto it = str_ids.find(s2);
+          if (it != str_ids.end()) op.key_sid = it->second;
+          else {
+            op.key_sid = (int32_t)strs.size();
+            str_ids.emplace(s2, op.key_sid);
+            strs.push_back(s2);
+          }
+          op.key_kind = 2;
+        } else if (c0 == '-' || (c0 >= '0' && c0 <= '9')) {
+          int64_t v;
+          bool is_f;
+          if (ps.integer(v, is_f)) {
+            op.key_kind = 1;
+            op.key_i = v;
+          } else if (is_f) {
+            op.key_kind = 3;    // float key: Python 1.0 == 1 interning
+          } else {
+            return false;       // malformed number / int64 overflow
+          }
+        } else {
+          op.key_kind = 3;      // bool / null / list / dict key
+          ps.skip();
+          if (ps.bail) return false;
+        }
+      } else {
+        ps.skip();              // element count is all that matters
+        if (ps.bail) return false;
+      }
+      ++n_elems;
+      if (ps.eat(',')) continue;
+      if (ps.eat(']')) break;
+      return false;
+    }
+    op.is_pair = (n_elems == 2);
+    return true;
+  }
+
+  bool parse_line(const char* s, const char* e) {
+    Parser ps;
+    ps.p = s;
+    ps.end = e;
+    ps.ipool = &ipool;
+    ps.spool = &strs;
+    ps.ws();
+    if (ps.p >= ps.end) return true;  // blank
+    if (*ps.p != '{') return false;
+    ++ps.p;
+    SOp op;
+    ps.ws();
+    if (!ps.eat('}')) {
+      while (true) {
+        ps.ws();
+        if (ps.p >= ps.end || *ps.p != '"') return false;
+        std::string& k = scratch;
+        if (!ps.str(k)) return false;
+        if (!ps.eat(':')) return false;
+        ps.ws();
+        if (ps.p >= ps.end) return false;
+        if (k == "type" || k == "f" || k == "process") {
+          if (*ps.p == '"') {
+            std::string& v = scratch2;
+            if (!ps.str(v)) return false;
+            if (k == "type") op.is_ok = (v == "ok");
+            else if (k == "f") op.is_read = (v == "read");
+            else op.is_nemesis = (v == "nemesis");
+          } else {
+            // non-string member: never equals the string it's tested
+            // against (duplicate members: json.loads keeps the last,
+            // so reset rather than keep an earlier string's verdict)
+            ps.skip();
+            if (ps.bail) return false;
+            if (k == "type") op.is_ok = false;
+            else if (k == "f") op.is_read = false;
+            else op.is_nemesis = false;
+          }
+        } else if (k == "value") {
+          if (!value_member(ps, op)) return false;
+        } else {
+          ps.skip();
+          if (ps.bail) return false;
+        }
+        if (ps.eat(',')) continue;
+        if (ps.eat('}')) break;
+        return false;
+      }
+    }
+    ps.ws();
+    if (ps.p != ps.end) return false;  // trailing garbage on the line
+    sops.push_back(op);
+    return true;
+  }
+
+  bool parse_file(const char* path) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return false;
+    fseek(f, 0, SEEK_END);
+    long sz = ftell(f);
+    fseek(f, 0, SEEK_SET);
+    if (sz < 0) { fclose(f); return false; }
+    std::string buf;
+    buf.resize((size_t)sz);
+    if (sz > 0 && fread(&buf[0], 1, (size_t)sz, f) != (size_t)sz) {
+      fclose(f);
+      return false;
+    }
+    fclose(f);
+    if (!Encoder::utf8_valid_no_exotic_breaks(
+            (const unsigned char*)buf.data(), buf.size()))
+      return false;
+    sops.reserve((size_t)(sz / 96) + 8);
+    const char* s = buf.data();
+    const char* e = s + buf.size();
+    const char* line = s;
+    // identical framing to Encoder::parse_file / load_history_dir:
+    // '\n', '\r\n', lone '\r' end a line; blank lines consume no index
+    for (const char* q = s; q <= e; ++q) {
+      if (q == e || *q == '\n' || *q == '\r') {
+        if (q > line) {
+          const char* t = line;
+          while (t < q && (*t == ' ' || *t == '\t')) ++t;
+          if (t < q && !parse_line(line, q)) return false;
+        }
+        if (q < e && *q == '\r' && q + 1 < e && q[1] == '\n') ++q;
+        line = q + 1;
+      }
+    }
+    return true;
+  }
+
+  SplitHandle* split() {
+    // relift_history's heuristic, applied to the raw JSON shapes:
+    // every non-null client (non-nemesis) value must be a 2-element
+    // list, at least one must exist, and some client ok-read must
+    // carry a list value — otherwise nothing lifts and every op is
+    // un-lifted (subhistories then returns {}).
+    bool any_val = false, all_pairs = true, any_okread = false;
+    for (const SOp& o : sops) {
+      if (o.is_nemesis) continue;
+      if (o.has_value) {
+        any_val = true;
+        if (!o.is_pair) all_pairs = false;
+      }
+      if (o.is_ok && o.is_read && o.is_list) any_okread = true;
+    }
+    const bool lifted = any_val && all_pairs && any_okread;
+    auto h = std::make_unique<SplitHandle>();
+    h->key_ids.assign(sops.size(), -1);
+    h->lifted = lifted ? 1 : 0;
+    h->key_names_json = "[]";
+    if (!lifted) return h.release();
+    std::unordered_map<int64_t, int32_t> ikeys;
+    std::unordered_map<int32_t, int32_t> skeys;
+    std::vector<std::pair<bool, int64_t>> keys;  // (is_str, int | sid)
+    for (size_t i = 0; i < sops.size(); ++i) {
+      const SOp& o = sops[i];
+      if (o.is_nemesis || !o.is_pair) continue;
+      int32_t id;
+      if (o.key_kind == 1) {
+        auto it = ikeys.find(o.key_i);
+        if (it != ikeys.end()) id = it->second;
+        else {
+          id = (int32_t)keys.size();
+          ikeys.emplace(o.key_i, id);
+          keys.emplace_back(false, o.key_i);
+        }
+      } else if (o.key_kind == 2) {
+        auto it = skeys.find(o.key_sid);
+        if (it != skeys.end()) id = it->second;
+        else {
+          id = (int32_t)keys.size();
+          skeys.emplace(o.key_sid, id);
+          keys.emplace_back(true, (int64_t)o.key_sid);
+        }
+      } else {
+        return nullptr;  // unrepresentable key on a lifted op
+      }
+      h->key_ids[i] = id;
+    }
+    h->n_keys = (int64_t)keys.size();
+    std::string& js = h->key_names_json;
+    js.clear();
+    js += '[';
+    for (size_t i = 0; i < keys.size(); ++i) {
+      if (i) js += ',';
+      if (!keys[i].first) js += std::to_string(keys[i].second);
+      else append_json_string(js, strs[(size_t)keys[i].second]);
+    }
+    js += ']';
+    return h.release();
+  }
+};
+
 }  // namespace
 
 extern "C" {
 
-int64_t jt_ha_abi_version() { return 2; }
+int64_t jt_ha_abi_version() { return 3; }
 
 void* jt_ha_encode_file(const char* path) {
   Encoder enc;
@@ -1546,5 +1824,31 @@ const char* jt_ha_pre_key_names_json(void* hp) {
 }
 
 void jt_ha_free(void* hp) { delete (Handle*)hp; }
+
+// -- per-key split (jt_ks_*) ---------------------------------------------
+
+void* jt_ks_split_file(const char* path) {
+  Splitter sp;
+  if (!sp.parse_file(path)) return nullptr;
+  return sp.split();   // may itself be NULL (unrepresentable key)
+}
+
+void jt_ks_dims(void* hp, int64_t out[4]) {
+  SplitHandle* h = (SplitHandle*)hp;
+  out[0] = (int64_t)h->key_ids.size();   // n ops
+  out[1] = h->n_keys;
+  out[2] = (int64_t)h->key_names_json.size();
+  out[3] = h->lifted;
+}
+
+const int32_t* jt_ks_key_ids(void* hp) {
+  return ((SplitHandle*)hp)->key_ids.data();
+}
+
+const char* jt_ks_key_names_json(void* hp) {
+  return ((SplitHandle*)hp)->key_names_json.c_str();
+}
+
+void jt_ks_free(void* hp) { delete (SplitHandle*)hp; }
 
 }  // extern "C"

@@ -210,18 +210,21 @@ def _encs(n_good: int, n_bad: int, T: int = 96, K: int = 8):
     return out
 
 
-def test_two_pass_matches_single_pass():
+def test_strategies_agree():
     encs = _encs(6, 2)
-    two = parallel.check_bucketed(encs, None)          # default: two-pass
-    one = parallel.check_bucketed(encs, None, two_pass=False)
-    assert two == one
-    assert all(f == {} for f in two[:6])
-    assert all("G1c" in f for f in two[6:])
+    fused = parallel.check_bucketed(encs, None)   # default: fused
+    two = parallel.check_bucketed(encs, None, two_pass=True)
+    one = parallel.check_bucketed(encs, None, two_pass=False,
+                                  fused=False)
+    assert fused == two == one
+    assert all(f == {} for f in fused[:6])
+    assert all("G1c" in f for f in fused[6:])
 
 
-def test_two_pass_all_valid_skips_classify(monkeypatch):
-    """On an all-valid sweep the classify closures never run: every
-    dispatch is detect-mode."""
+def test_fused_default_is_single_dispatch(monkeypatch):
+    """The fused default dispatches each bucket ONCE in classify mode
+    (the classification closures stay behind the kernel's lax.cond) —
+    no detect pre-pass, no re-dispatch of positives."""
     calls = []
     orig = parallel.sharded_check_fn
 
@@ -230,7 +233,23 @@ def test_two_pass_all_valid_skips_classify(monkeypatch):
         return orig(mesh, shape, **kw)
 
     monkeypatch.setattr(parallel, "sharded_check_fn", spy)
-    out = parallel.check_bucketed(_encs(5, 0), None)
+    out = parallel.check_bucketed(_encs(5, 1), None)
+    assert all(f == {} for f in out[:5]) and "G1c" in out[5]
+    assert calls == [True], calls
+
+
+def test_two_pass_all_valid_skips_classify(monkeypatch):
+    """With the explicit two-pass strategy an all-valid sweep never
+    runs a classify dispatch: every dispatch is detect-mode."""
+    calls = []
+    orig = parallel.sharded_check_fn
+
+    def spy(mesh, shape, **kw):
+        calls.append(kw.get("classify"))
+        return orig(mesh, shape, **kw)
+
+    monkeypatch.setattr(parallel, "sharded_check_fn", spy)
+    out = parallel.check_bucketed(_encs(5, 0), None, two_pass=True)
     assert all(f == {} for f in out)
     assert calls and not any(calls), calls
 

@@ -22,6 +22,10 @@ TINY = {
     "BENCH_E2E_B": "3", "BENCH_E2E_T": "128",
     "BENCH_NS_B": "3", "BENCH_NS_T": "128", "BENCH_NS_K": "8",
     "BENCH_GEN_OPS": "2000",
+    # dp-scaling would spawn its own 8-virtual-device child here; skip
+    # it in the supervisor tests (tests/test_dp_scaling.py covers the
+    # measurement itself on the in-process virtual mesh)
+    "BENCH_DP_CHILD": "0",
 }
 
 
@@ -42,10 +46,35 @@ def test_supervisor_happy_path():
     assert out["value"] > 0
     assert out["backend"] == "cpu"
     for block in ("knossos", "long_history", "end_to_end",
-                  "north_star", "generator"):
+                  "north_star", "dp_scaling", "generator"):
         assert block in out, block
         assert "error" not in out[block], out[block]
-    assert out["north_star"]["invalid_found"] >= 1
+    ns = out["north_star"]
+    assert ns["invalid_found"] >= 1
+    # phase-attributed sweep: the per-phase fields must explain
+    # sweep_secs (sum within 10%), and overlap is ONE measured field
+    assert set(ns["phases"]) == {"parse", "pack", "h2d", "dispatch",
+                                 "collect", "render"}
+    assert abs(ns["phases_sum_secs"] - ns["sweep_secs"]) <= \
+        0.1 * ns["sweep_secs"] + 0.02, ns
+    assert "pipeline_overlap_secs" in ns
+    assert "pipeline_overlap" not in ns
+    assert "pipeline_overlap_measured" not in ns
+    # the MFU model must name the formulation the sweep actually ran
+    assert ns["mfu_formulation"].split("-")[-1] in ns["mfu_model"]
+    # the register sweep's split phase must actually ride the native
+    # splitter whenever the toolchain can build it AND the gate is on
+    # (a silent fall-back to the Python walk would send split_secs
+    # back above check_secs without failing anything); hosts without
+    # g++ — and explicit JEPSEN_TPU_NATIVE_SPLIT=0 runs — degrade
+    # cleanly and must report False
+    from jepsen_tpu import native_lib
+    reg = out["register_sweep"]
+    if native_lib.hist_lib() is None \
+            or os.environ.get("JEPSEN_TPU_NATIVE_SPLIT") == "0":
+        assert reg["native_split"] is False
+    else:
+        assert reg["native_split"] is True
     assert out["generator"]["value"] > 0
     # shape-honest ratios: scaled-down shapes (T < 5000) must NOT be
     # divided by the full-shape target — report null + the real shape
@@ -97,7 +126,7 @@ def test_supervisor_structured_error_child_still_retries_cpu():
     assert out["backend"] == "cpu"
     assert out.get("tpu_error")
     for block in ("knossos", "long_history", "end_to_end",
-                  "north_star", "generator"):
+                  "north_star", "dp_scaling", "generator"):
         assert block in out, block
         assert "error" not in out[block], out[block]
 

@@ -1,0 +1,43 @@
+"""dp-scaling efficiency on the virtual 8-device CPU mesh.
+
+Strong scaling over a FIXED toy batch: dp=8 shards the same histories
+over all 8 virtual devices, so the cores do the same total work as
+dp=1 and the ratio rate(dp8)/rate(dp1) measures pure sharding overhead
+(collectives, layout, padding) — ideal ~1.0. The bench's dp_scaling
+block reports the same measurement (bench._dp_rates); this pins the
+floor so a sharding regression can't silently tax every mesh sweep.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+
+import jax
+import pytest
+
+_spec = importlib.util.spec_from_file_location(
+    "bench_mod", os.path.join(os.path.dirname(__file__), "..",
+                              "bench.py"))
+bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench)
+
+
+def test_dp8_efficiency_at_least_70_percent():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-virtual-device CPU mesh")
+    rows = bench._dp_rates(devs, B=16, T=384, K=8, dps=(1, 8), reps=3)
+    rates = {r["dp"]: r["rate"] for r in rows}
+    assert set(rates) == {1, 8}, rows
+    assert rates[8] >= 0.7 * rates[1], rows
+
+
+def test_dp_rates_cover_requested_ladder():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs the 8-virtual-device CPU mesh")
+    rows = bench._dp_rates(devs, B=8, T=256, K=8, dps=(1, 2, 4, 8),
+                           reps=2)
+    assert [r["dp"] for r in rows] == [1, 2, 4, 8]
+    assert all(r["rate"] > 0 for r in rows)

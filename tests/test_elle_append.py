@@ -459,8 +459,9 @@ def test_independent_append_uses_batched_device_dispatch(monkeypatch):
     assert res["results"]["a"]["valid?"] is True
     assert res["results"]["b"]["valid?"] is False
     assert res["failures"] == ["b"]
-    # one outer sweep over all 3 keys (the recursive entries are the
-    # two-pass detect and the classify re-dispatch of the flagged key)
+    # one outer sweep over all 3 keys (the fused detect/classify
+    # kernel needs no re-dispatch; under JEPSEN_TPU_FUSED_CLASSIFY=0
+    # the recursive two-pass entries ride along)
     assert calls[0] == 3 and calls.count(3) >= 1, calls
 
 

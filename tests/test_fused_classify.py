@@ -1,0 +1,168 @@
+"""Fused detect/classify parity: the single-dispatch kernel (detect
+closure + lax.cond-gated classification) must agree bit-for-bit with
+the unfused chained-closure classify AND with the detect pass's cycle
+verdict, across all four anomaly classes and the synthetic corpus
+(checker/elle/synth.py). This pins the tentpole contract: a sweep can
+run classify=True at the detect rate without verdict drift.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import parallel
+from jepsen_tpu.checker.elle import synth
+from jepsen_tpu.checker.elle import kernels as K
+from jepsen_tpu.checker.elle.encode import encode_history
+
+
+def txn(i, p, mops):
+    inv = [[m[0], m[1], None if m[0] == "r" else m[2]] for m in mops]
+    return [
+        {"type": "invoke", "process": p, "f": "txn", "value": inv,
+         "time": i * 1000, "index": 2 * i},
+        {"type": "ok", "process": p, "f": "txn", "value": mops,
+         "time": i * 1000 + 500, "index": 2 * i + 1},
+    ]
+
+
+def hist_g0():
+    """ww cycle: t0 and t1 append to two keys in opposite orders, as
+    later observed by reads fixing both version orders."""
+    h = []
+    h += txn(0, 0, [["append", "x", 1], ["append", "y", 2]])
+    h += txn(1, 1, [["append", "y", 1], ["append", "x", 2]])
+    h += txn(2, 2, [["r", "x", [1, 2]], ["r", "y", [1, 2]]])
+    return h
+
+
+def hist_g1c():
+    """wr cycle: two txns read EACH OTHER's appends."""
+    h = []
+    h += txn(0, 0, [["append", "a", 1], ["r", "b", [1]]])
+    h += txn(1, 1, [["append", "b", 1], ["r", "a", [1]]])
+    return h
+
+
+def hist_g_single():
+    """rw + ww cycle: t0's read of k1@[] is overwritten by t1, and t1
+    ww-precedes t0 on k2. The trailing observer fixes both version
+    chains (unobserved appends encode pos -1 and emit no edges)."""
+    h = []
+    h += txn(0, 0, [["r", "k1", []], ["append", "k2", 2]])
+    h += txn(1, 1, [["append", "k1", 1], ["append", "k2", 1]])
+    h += txn(2, 2, [["r", "k1", [1]], ["r", "k2", [1, 2]]])
+    return h
+
+
+def hist_g2():
+    """Pure rw cycle (write skew): both txns read the empty prefix the
+    other then appends to; the observer fixes the version chains."""
+    h = []
+    h += txn(0, 0, [["r", "p", []], ["append", "q", 1]])
+    h += txn(1, 1, [["r", "q", []], ["append", "p", 1]])
+    h += txn(2, 2, [["r", "p", [1]], ["r", "q", [1]]])
+    return h
+
+
+ANOMALY_HISTS = {
+    "G0": hist_g0,
+    "G1c": hist_g1c,
+    "G-single": hist_g_single,
+    "G2-item": hist_g2,
+}
+
+
+@pytest.mark.parametrize("name", sorted(ANOMALY_HISTS))
+def test_fused_matches_unfused_and_detect_per_class(name):
+    enc = encode_history(ANOMALY_HISTS[name]())
+    encs = [enc]
+    fused = parallel.check_bucketed(encs, None, fused=True,
+                                    two_pass=False)
+    unfused = parallel.check_bucketed(encs, None, fused=False,
+                                      two_pass=False)
+    detect = parallel.check_bucketed(encs, None, classify=False)
+    assert fused == unfused, (name, fused, unfused)
+    assert name in fused[0], (name, fused)
+    # detect's cycle bit must fire exactly when classify flags exist
+    assert bool(detect[0]) == bool(fused[0]), (name, detect, fused)
+
+
+def test_fused_mixed_batch_parity():
+    """One bucket mixing all four anomaly classes with valid histories:
+    the cond fires for the bucket, and every history's flags still
+    match the unfused kernel exactly."""
+    encs = [encode_history(mk()) for mk in ANOMALY_HISTS.values()]
+    encs += [synth.synth_encoded_history(96, K=8) for _ in range(4)]
+    fused = parallel.check_bucketed(encs, None, fused=True,
+                                    two_pass=False)
+    unfused = parallel.check_bucketed(encs, None, fused=False,
+                                      two_pass=False)
+    two_pass = parallel.check_bucketed(encs, None, two_pass=True)
+    assert fused == unfused == two_pass
+    assert all(f == {} for f in fused[4:])
+    assert all(fused[:4])
+
+
+def test_fused_all_valid_synth_corpus():
+    """The synthetic valid corpus classifies to zero flags through the
+    fused kernel (the cond's clean branch), matching detect."""
+    batch = synth.synth_valid_batch(B=4, T=256, K=16, seed=2)
+    shape = batch["shape"]
+    args = parallel.shard_batch(None, batch)
+    fused = parallel.sharded_check_fn(None, shape, classify=True,
+                                      fused=True)
+    detect = parallel.sharded_check_fn(None, shape, classify=False)
+    f = np.asarray(fused(*args))
+    d = np.asarray(detect(*args))
+    assert (f == 0).all(), f
+    assert (d == 0).all(), d
+
+
+def test_fused_injected_cycles_flag_identically():
+    """synth.inject_g1c positives through the packed-batch kernel:
+    fused and unfused flag words must be identical, and the flagged
+    rows exactly the injected ones."""
+    batch = synth.synth_valid_batch(B=6, T=256, K=8, seed=3)
+    bad = np.array([1, 4])
+    batch = synth.inject_g1c(batch, bad, K=8)
+    shape = batch["shape"]
+    args = parallel.shard_batch(None, batch)
+    f = np.asarray(parallel.sharded_check_fn(
+        None, shape, classify=True, fused=True)(*args))
+    u = np.asarray(parallel.sharded_check_fn(
+        None, shape, classify=True, fused=False)(*args))
+    np.testing.assert_array_equal(f, u)
+    assert set(np.nonzero(f)[0].tolist()) == set(bad.tolist())
+
+
+def test_fused_on_mesh_matches_single_device():
+    """The lax.cond + sharded closure combination must survive GSPMD:
+    same verdicts through a dp x mp mesh as unsharded."""
+    encs = [encode_history(hist_g1c()), encode_history(hist_g2())]
+    encs += [synth.synth_encoded_history(96, K=8) for _ in range(6)]
+    mesh = parallel.make_mesh()
+    sharded = parallel.check_bucketed(encs, mesh, fused=True,
+                                      two_pass=False)
+    local = parallel.check_bucketed(encs, None, fused=True,
+                                    two_pass=False)
+    assert sharded == local
+
+
+def test_env_gate_restores_two_pass_default(monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_FUSED_CLASSIFY", "0")
+    assert not K.fused_classify_enabled()
+    calls = []
+    orig = parallel.check_bucketed_async
+
+    def spy(encs, mesh=None, **kw):
+        calls.append(kw.get("classify", True))
+        return orig(encs, mesh, **kw)
+
+    monkeypatch.setattr(parallel, "check_bucketed_async", spy)
+    encs = [synth.synth_encoded_history(96, K=8) for _ in range(3)]
+    out = parallel.check_bucketed(encs, None)
+    assert all(f == {} for f in out)
+    # all-valid two-pass: exactly one detect sweep, no classify pass
+    assert calls == [False], calls

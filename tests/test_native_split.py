@@ -1,0 +1,267 @@
+"""Differential tests: native per-key split vs the pure-Python splitter.
+
+The native path (hist_encode.cc's jt_ks_* ABI via native_lib.split_key_ids
+and independent.subhistories_path) promises per-key subhistories
+op-for-op identical to `subhistories(relift_history(h))` for every file
+it accepts, and None (-> Python fallback) for everything else. These
+tests enforce both halves on targeted edge cases (empty-string keys,
+single-op keys, :info-only keys, nemesis interleavings, non-lifting
+histories) and a fuzzed lifted-register corpus built from the knossos
+simulator — the same construction the bench's register sweep uses.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+
+import pytest
+
+from jepsen_tpu import independent, native_lib
+
+pytestmark = pytest.mark.skipif(
+    native_lib.hist_lib() is None,
+    reason="native hist encoder unavailable (no g++?)")
+
+
+def write_hist(tmp_path, ops, name="h"):
+    p = tmp_path / f"{name}.jsonl"
+    p.write_text("\n".join(json.dumps(o) for o in ops) + "\n")
+    return p
+
+
+def load(p):
+    lines = [ln for ln in p.read_text().splitlines() if ln.strip()]
+    return json.loads("[" + ",".join(lines) + "]") if lines else []
+
+
+def assert_split_parity(tmp_path, ops, name="h", expect_native=True):
+    """subhistories_path must equal the pure relift+subhistories walk,
+    key order included; with expect_native, the native splitter must
+    actually have accepted the file."""
+    p = write_hist(tmp_path, ops, name)
+    hist = load(p)
+    if expect_native:
+        assert native_lib.split_key_ids(p) is not None, \
+            f"{name}: native splitter unexpectedly fell back"
+    nat = independent.subhistories_path(hist, p)
+    pure = independent.subhistories(independent.relift_history(hist))
+    assert list(nat) == list(pure), (name, list(nat), list(pure))
+    for k in pure:
+        assert nat[k] == pure[k], (name, k)
+    return nat
+
+
+def reg_op(ty, proc, f, key, val, **extra):
+    return {"type": ty, "process": proc, "f": f, "value": [key, val],
+            **extra}
+
+
+def test_basic_lifted_split(tmp_path):
+    ops = []
+    for i in range(30):
+        k = i % 3
+        ops.append(reg_op("invoke", i % 4, "read", k, None, index=2 * i))
+        ops.append(reg_op("ok", i % 4, "read", k, i, index=2 * i + 1))
+    subs = assert_split_parity(tmp_path, ops, "basic")
+    assert list(subs) == [0, 1, 2]
+    assert all(len(v) == 20 for v in subs.values())
+
+
+def test_nemesis_ops_land_in_every_key(tmp_path):
+    ops = [
+        {"type": "info", "process": "nemesis", "f": "start", "value": None},
+        reg_op("invoke", 0, "read", "a", None),
+        reg_op("ok", 0, "read", "a", 1),
+        {"type": "info", "process": "nemesis", "f": "stop",
+         "value": ["not", "lifted"]},
+        reg_op("invoke", 1, "write", "b", 2),
+        reg_op("ok", 1, "write", "b", 2),
+    ]
+    subs = assert_split_parity(tmp_path, ops, "nemesis")
+    # the late key 'b' starts with the un-lifted prefix seen so far
+    assert subs["b"][0]["f"] == "start"
+    assert subs["b"][1]["f"] == "stop"
+
+
+def test_empty_string_key_and_single_op_key(tmp_path):
+    ops = [
+        reg_op("invoke", 0, "read", "", None),
+        reg_op("ok", 0, "read", "", 7),
+        # single-op key: invoke with no completion
+        reg_op("invoke", 1, "write", "lonely", 3),
+    ]
+    subs = assert_split_parity(tmp_path, ops, "edge-keys")
+    assert list(subs) == ["", "lonely"]
+    assert len(subs["lonely"]) == 1
+
+
+def test_info_only_key(tmp_path):
+    ops = [
+        reg_op("invoke", 0, "read", 1, None),
+        reg_op("ok", 0, "read", 1, 5),
+        # a key that only ever appears on :info ops
+        reg_op("invoke", 2, "cas", 99, [1, 2]),
+        reg_op("info", 2, "cas", 99, None),
+    ]
+    # the info completion has value None -> un-lifted (lands in every
+    # key), while its invoke lifts to key 99: exactly what the pure
+    # walk does
+    subs = assert_split_parity(tmp_path, ops, "info-only")
+    assert 99 in subs
+
+
+def test_unlifted_scalar_history_stays_unsplit(tmp_path):
+    ops = [{"type": "invoke", "process": 0, "f": "read", "value": None},
+           {"type": "ok", "process": 0, "f": "read", "value": 3},
+           {"type": "invoke", "process": 1, "f": "write", "value": 4},
+           {"type": "ok", "process": 1, "f": "write", "value": 4}]
+    subs = assert_split_parity(tmp_path, ops, "scalar")
+    assert subs == {}
+
+
+def test_cas_only_history_is_ambiguous_not_lifted(tmp_path):
+    # every value is a 2-element list but no ok read exists: the
+    # relift heuristic must NOT fire (reference ambiguity rule)
+    ops = [{"type": "invoke", "process": 0, "f": "cas", "value": [1, 2]},
+           {"type": "ok", "process": 0, "f": "cas", "value": [1, 2]}]
+    subs = assert_split_parity(tmp_path, ops, "cas-only")
+    assert subs == {}
+
+
+def test_empty_history(tmp_path):
+    p = tmp_path / "empty.jsonl"
+    p.write_text("")
+    assert independent.subhistories_path([], p) == {}
+
+
+def test_mixed_int_and_string_keys(tmp_path):
+    ops = [
+        reg_op("invoke", 0, "read", 1, None),
+        reg_op("ok", 0, "read", 1, 0),
+        reg_op("invoke", 1, "read", "1", None),
+        reg_op("ok", 1, "read", "1", 0),
+        reg_op("invoke", 2, "write", -7, 3),
+    ]
+    subs = assert_split_parity(tmp_path, ops, "mixed")
+    # int 1 and string "1" are distinct Python keys; both must intern
+    # separately on the native side too
+    assert list(subs) == [1, "1", -7]
+
+
+def test_fallback_on_float_key(tmp_path):
+    ops = [
+        reg_op("invoke", 0, "read", 1.5, None),
+        reg_op("ok", 0, "read", 1.5, 2),
+    ]
+    p = write_hist(tmp_path, ops, "floatkey")
+    assert native_lib.split_key_ids(p) is None
+    assert_split_parity(tmp_path, ops, "floatkey", expect_native=False)
+
+
+def test_fallback_on_bool_key(tmp_path):
+    # Python's True == 1 key interning can't be replicated in int64
+    ops = [
+        reg_op("invoke", 0, "read", True, None),
+        reg_op("ok", 0, "read", True, 2),
+    ]
+    p = write_hist(tmp_path, ops, "boolkey")
+    assert native_lib.split_key_ids(p) is None
+    assert_split_parity(tmp_path, ops, "boolkey", expect_native=False)
+
+
+def test_fallback_on_big_int_key(tmp_path):
+    big = 2 ** 70
+    ops = [
+        reg_op("invoke", 0, "read", big, None),
+        reg_op("ok", 0, "read", big, 2),
+    ]
+    p = write_hist(tmp_path, ops, "bigkey")
+    assert native_lib.split_key_ids(p) is None
+    assert_split_parity(tmp_path, ops, "bigkey", expect_native=False)
+
+
+def test_gate_env_pins_python_path(tmp_path, monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_NATIVE_SPLIT", "0")
+    calls = []
+    orig = native_lib.split_key_ids
+    monkeypatch.setattr(native_lib, "split_key_ids",
+                        lambda p: calls.append(p) or orig(p))
+    ops = [reg_op("invoke", 0, "read", 0, None),
+           reg_op("ok", 0, "read", 0, 1)]
+    assert_split_parity(tmp_path, ops, "gated", expect_native=False)
+    assert not calls
+
+
+def test_misaligned_history_falls_back(tmp_path):
+    """A caller holding a DIFFERENT history than the file (edited,
+    truncated) must get the pure-Python answer, not mixed-up ids."""
+    ops = [reg_op("invoke", 0, "read", 0, None),
+           reg_op("ok", 0, "read", 0, 1),
+           reg_op("invoke", 1, "read", 1, None),
+           reg_op("ok", 1, "read", 1, 2)]
+    p = write_hist(tmp_path, ops, "misaligned")
+    hist = load(p)[:2]   # caller's copy is shorter than the file
+    nat = independent.subhistories_path(hist, p)
+    assert nat == independent.subhistories(
+        independent.relift_history(hist))
+
+
+def lifted_register_history(rng, keys, per_key, nemesis_p=0.1):
+    """A lifted multi-key register run, interleaved round-robin — the
+    bench's _write_register_store shape plus random nemesis ops."""
+    from jepsen_tpu.checker.knossos import synth as ksynth
+
+    streams = []
+    for j, k in enumerate(keys):
+        h = ksynth.synth_register_history(
+            n_ops=per_key, n_procs=3, n_values=6,
+            info_prob=0.05, seed=rng.randrange(1 << 30), max_pending=4)
+        streams.append([{"type": o["type"], "process": o["process"] + j * 3,
+                         "f": o["f"], "value": [k, o.get("value")]}
+                        for o in h])
+    out = []
+    live = [iter(s) for s in streams]
+    while live:
+        nxt = []
+        for it in live:
+            o = next(it, None)
+            if o is None:
+                continue
+            if rng.random() < nemesis_p:
+                out.append({"type": "info", "process": "nemesis",
+                            "f": rng.choice(["kill", "heal"]),
+                            "value": None})
+            out.append(o)
+            nxt.append(it)
+        live = nxt
+    return [{**o, "index": i} for i, o in enumerate(out)]
+
+
+def test_fuzz_split_parity(tmp_path):
+    rng = random.Random(20260803)
+    for trial in range(12):
+        keys = rng.choice([
+            [0, 1, 2],
+            ["a", "b", "", "d"],
+            list(range(rng.randrange(1, 9))),
+            ["k1", 7, "k2", -3],
+        ])
+        if not keys:
+            keys = [0]
+        ops = lifted_register_history(
+            rng, keys, per_key=rng.choice([1, 6, 20]),
+            nemesis_p=rng.choice([0.0, 0.15]))
+        assert_split_parity(tmp_path, ops, f"fuzz{trial}")
+
+
+def test_fuzz_txn_histories_never_lift(tmp_path):
+    """Append/wr txn corpora (list-of-mops values) must not trip the
+    lift heuristic on either side."""
+    from test_fuzz_differential import rand_append_history
+
+    rng = random.Random(7)
+    for trial in range(4):
+        ops = rand_append_history(rng, 40, 6, 3)
+        subs = assert_split_parity(tmp_path, ops, f"txn{trial}")
+        assert subs == {}
