@@ -763,9 +763,9 @@ def bench_north_star(n_dev: int, devices) -> dict:
             # opt-in xplane capture of the timed sweep: ground truth
             # for the measured-MFU number when hardware is available
             import jax.profiler as _prof
-            tracer = _prof.trace(profile_dir)
+            prof_cm = _prof.trace(profile_dir)
         else:
-            tracer = contextlib.nullcontext()
+            prof_cm = contextlib.nullcontext()
         # Timed region = analyze-store's streaming pipeline, now
         # genuinely double-buffered: chunk N is DISPATCHED async
         # (check_bucketed_async — no blocking device_get), then chunk
@@ -791,12 +791,12 @@ def bench_north_star(n_dev: int, devices) -> dict:
             pv, pencs, ptd = pend_
             flags = pv.result(phases)
             dev_spans.append((ptd, time.monotonic()))
-            tr = time.perf_counter()
+            t_r = time.perf_counter()
             verdicts.extend(elle.render_verdict(e, c, prohibited)
                             for e, c in zip(pencs, flags))
-            parallel._acc_phase(phases, "render", tr)
+            parallel._acc_phase(phases, "render", t_r)
 
-        with tracer:
+        with prof_cm:
             t0 = time.perf_counter()
             it = iter(ingest.iter_encode_chunks(dirs, "append",
                                                 chunk=chunk,
@@ -831,6 +831,12 @@ def bench_north_star(n_dev: int, devices) -> dict:
                     break
                 pend = nxt
             t_sweep = time.perf_counter() - t0
+        # The phases dict IS the tracer view: every entry is the
+        # duration trace.phase() measured and recorded (parallel.
+        # _acc_phase adapts spans into it), scoped to exactly this
+        # timed region — tests/test_trace.py pins dict↔phase_totals
+        # parity. The round tracer (installed by run_benches) keeps
+        # the same spans for the exported trace.json.
         t_render = phases.get("render", 0.0)
 
         n_bad = sum(1 for v in verdicts if v["valid?"] is False)
@@ -890,8 +896,11 @@ def bench_north_star(n_dev: int, devices) -> dict:
             "sweep_secs": round(t_sweep, 3),
             "ingest_secs": round(t_ingest, 3),
             "check_secs": round(t_check, 3),
-            # Full attribution of sweep_secs: every main-thread second
-            # of the pipelined sweep lands in exactly one phase —
+            # Full attribution of sweep_secs via jepsen_tpu.trace
+            # phase spans (same keys and semantics as the pre-trace
+            # dict — _acc_phase adapts each measured span into it):
+            # every main-thread second of the pipelined sweep lands
+            # in exactly one phase —
             # parse (stall on the ingest pool), pack (bucket planning +
             # host tensor packing), h2d (device_put/sharding), dispatch
             # (async kernel enqueue), collect (block + D2H + flag
@@ -932,6 +941,13 @@ def run_benches() -> int:
     """The child-process body: probe-guarded device init, then every
     bench phase, one JSON line out. Any failure still reports."""
     from jepsen_tpu import devices as devmod
+    from jepsen_tpu import trace as jtrace
+
+    # One tracer for the WHOLE round, installed before any block, so
+    # the archived trace.json attributes every bench (elle, knossos,
+    # register sweep, …) — not just the north-star sweep, which diffs
+    # its own phase totals against a post-warmup snapshot.
+    jtrace.fresh_run("bench")
 
     try:
         from jepsen_tpu import parallel as _parallel
@@ -979,6 +995,17 @@ def run_benches() -> int:
             out[name] = fn(*args)
         except Exception as e:  # the elle metric must still report
             out[name] = {"error": repr(e)[:200]}
+    # Archive this round's own attribution: the round tracer exports
+    # as trace.json next to the BENCH_* artifact. BENCH_TRACE_PATH
+    # overrides the destination; JEPSEN_TPU_TRACE=0 skips the file.
+    try:
+        tcur = jtrace.get_current()
+        if getattr(tcur, "enabled", False):
+            tp = os.environ.get("BENCH_TRACE_PATH", "trace.json")
+            tcur.export(tp)
+            out["trace_path"] = tp
+    except Exception as e:
+        out["trace_error"] = repr(e)[:200]
     print(json.dumps(out))
     return 0
 

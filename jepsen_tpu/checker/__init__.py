@@ -53,9 +53,14 @@ class FnChecker(Checker):
 def check_safe(checker: Checker, test: dict, history: list,
                opts: dict | None = None) -> dict:
     """Like check, but returns exceptions as {"valid?": "unknown"} results
-    (checker.clj:77-88)."""
+    (checker.clj:77-88). Every check runs inside a trace span named
+    after the checker class, so composed checkers show up as one track
+    row each in the run's trace.json."""
+    from .. import trace
     try:
-        r = checker.check(test, history, opts or {})
+        with trace.span(f"check:{type(checker).__name__}",
+                        ops=len(history)):
+            r = checker.check(test, history, opts or {})
         return r if r is not None else {"valid?": True}
     except Exception:
         return {"valid?": "unknown", "error": traceback.format_exc()}

@@ -17,11 +17,12 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import sys
 from pathlib import Path
 from typing import Callable
 
-from . import core
+from . import core, trace
 from .store import Store
 
 log = logging.getLogger(__name__)
@@ -64,6 +65,52 @@ def add_test_opts(p: argparse.ArgumentParser) -> None:
                         "oracles (cpu), or pick by hardware (auto — "
                         "the default; the north star's :backend :tpu "
                         "is the production path when a chip is up)")
+    add_trace_opts(p)
+
+
+def add_trace_opts(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--trace", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="write trace.json (Chrome trace-event / "
+                        "Perfetto) + metrics.json into the run dir "
+                        "(default on; --no-trace or JEPSEN_TPU_TRACE=0 "
+                        "disables)")
+    p.add_argument("--jax-profile",
+                   action=argparse.BooleanOptionalAction, default=None,
+                   help="additionally capture a jax.profiler session "
+                        "of the run (sets JEPSEN_TPU_JAX_PROFILE; "
+                        "lands in <run-dir>/jax-profile; "
+                        "--no-jax-profile overrides an inherited env)")
+
+
+def apply_trace_opts(args: argparse.Namespace) -> None:
+    """Export --trace/--no-trace/--jax-profile to the env gates every
+    layer reads (JEPSEN_TPU_TRACE / JEPSEN_TPU_JAX_PROFILE), so
+    embedded callers and subprocesses see the same choice."""
+    if getattr(args, "trace", None) is not None:
+        os.environ["JEPSEN_TPU_TRACE"] = "1" if args.trace else "0"
+        trace.reset()
+    if getattr(args, "jax_profile", None) is not None:
+        os.environ["JEPSEN_TPU_JAX_PROFILE"] = \
+            "1" if args.jax_profile else "0"
+
+
+def _trace_path_of(test: dict) -> str | None:
+    """The run's written trace.json path (None when tracing is off)."""
+    try:
+        p = test["store"].test_dir(test) / "trace.json"
+        return str(p) if p.exists() else None
+    except Exception:
+        return None
+
+
+def _print_result_line(test: dict, line: dict) -> None:
+    """The one-line JSON result every run-style subcommand prints,
+    with the run's written trace.json path attached when one exists."""
+    tp = _trace_path_of(test)
+    if tp:
+        line["trace"] = tp
+    print(json.dumps(line))
 
 
 def test_map_from_args(args: argparse.Namespace) -> dict:
@@ -145,6 +192,7 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
                               "runs this checker already verdicted "
                               "(results.json naming the checker, or "
                               "the fallback's .sweep-* sidecar)")
+    add_trace_opts(p_batch)
 
     p_serve = sub.add_parser("serve", help="serve the store over HTTP")
     p_serve.add_argument("--port", type=int, default=8080)
@@ -163,8 +211,8 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
     # Every auto-backend checker constructed from here on resolves per
     # this process-wide choice (devices.resolve_backend).
     if getattr(args, "backend", None) and args.backend != "auto":
-        import os
         os.environ["JEPSEN_TPU_BACKEND"] = args.backend
+    apply_trace_opts(args)
 
     try:
         if args.command == "test":
@@ -172,9 +220,9 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
             for i in range(args.test_count):
                 test = test_fn(test_map_from_args(args), args)
                 test = core.run(test)
-                print(json.dumps(
-                    {"valid?": test["results"].get("valid?"),
-                     "dir": str(test["store"].test_dir(test))}))
+                _print_result_line(test, {
+                    "valid?": test["results"].get("valid?"),
+                    "dir": str(test["store"].test_dir(test))})
                 code = max(code, validity_exit_code(test.get("results")))
                 if code:
                     break
@@ -194,8 +242,12 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
             test["history"] = independent.relift_history(
                 stored["history"])
             test["store"] = store
-            test = core.analyze(test)
-            print(json.dumps({"valid?": test["results"].get("valid?")}))
+            trace.fresh_run(test.get("name"))
+            with trace.jax_profile_session(
+                    Path(run_dir) / "jax-profile"):
+                test = core.analyze(test)
+            _print_result_line(test,
+                               {"valid?": test["results"].get("valid?")})
             return validity_exit_code(test["results"])
         if args.command == "test-all":
             tests = (tests_fn(test_map_from_args(args), args)
@@ -206,10 +258,10 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
                 try:
                     test = core.run(test)
                     code = validity_exit_code(test.get("results"))
-                    print(json.dumps(
-                        {"name": test.get("name"),
-                         "valid?": test["results"].get("valid?"),
-                         "dir": str(test["store"].test_dir(test))}))
+                    _print_result_line(test, {
+                        "name": test.get("name"),
+                        "valid?": test["results"].get("valid?"),
+                        "dir": str(test["store"].test_dir(test))})
                 except Exception as e:
                     log.exception("test %s crashed", test.get("name"))
                     print(json.dumps({"name": test.get("name"),
@@ -235,6 +287,29 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
 def analyze_store(store: Store, checker: str = "append",
                   name: str | None = None,
                   resume: bool = False) -> int:
+    """`_analyze_store_impl` wrapped in a fresh sweep tracer: the whole
+    sweep's spans (ingest parse, pack/h2d/dispatch/collect phases,
+    device windows, per-checker fallbacks) export to
+    `<store>/trace.json` + `metrics.json` at exit, printing the path —
+    the sweep-level analogue of the per-run artifacts save_2 writes."""
+    tr = trace.fresh_run(f"analyze-store:{checker}", scope="sweep")
+    try:
+        with trace.jax_profile_session(store.base / "jax-profile"):
+            return _analyze_store_impl(store, checker=checker,
+                                       name=name, resume=resume)
+    finally:
+        if getattr(tr, "enabled", False) and store.base.is_dir():
+            try:
+                p = tr.export(store.base / "trace.json")
+                tr.export_metrics(store.base / "metrics.json")
+                print(f"trace written to {p}", file=sys.stderr)
+            except Exception:
+                log.warning("sweep trace export failed", exc_info=True)
+
+
+def _analyze_store_impl(store: Store, checker: str = "append",
+                        name: str | None = None,
+                        resume: bool = False) -> int:
     """Batch re-check every stored run — the north-star batch path
     (SURVEY.md §3.4, §7 stage 8): encodable histories are packed,
     length-bucketed, and dispatched across the device mesh in one sweep;
@@ -372,9 +447,10 @@ def analyze_store(store: Store, checker: str = "append",
         # the same loop). Verdicts persist PER CHUNK: an interrupted
         # sweep --resumes from the last chunk, not from zero (huge
         # runs defer to their own host-condensation pass below).
-        for chunk in ingest.iter_encode_chunks(run_dirs,
-                                               checker=checker,
-                                               processes=sweep_procs):
+        # Each main-thread stall on the ingest iterator lands as a
+        # "parse" phase span in the sweep tracer (bench semantics).
+        for chunk in _parse_timed(ingest.iter_encode_chunks(
+                run_dirs, checker=checker, processes=sweep_procs)):
             dense, dense_map = [], []
             for d, enc in chunk:
                 if not encodable(d, enc, fallback):
@@ -415,8 +491,8 @@ def analyze_store(store: Store, checker: str = "append",
     # overlaps pool parsing of the next chunk).
     prohibited = elle_wr.WrChecker().prohibited
     fallback = []
-    for chunk in ingest.iter_encode_chunks(run_dirs, checker=checker,
-                                           processes=sweep_procs):
+    for chunk in _parse_timed(ingest.iter_encode_chunks(
+            run_dirs, checker=checker, processes=sweep_procs)):
         good = [(d, enc) for d, enc in chunk
                 if encodable(d, enc, fallback)]
         if not good:
@@ -438,6 +514,23 @@ def analyze_store(store: Store, checker: str = "append",
     for d in fallback:
         worst = max(worst, _stored_fallback(d, stored_check, checker))
     return worst
+
+
+def _parse_timed(it):
+    """Re-yield an iterator, recording each main-thread stall on it as
+    a "parse" phase span in the current tracer — analyze-store sweeps
+    get the same parse/pack/h2d/dispatch/collect attribution as the
+    bench's north-star loop."""
+    import time
+
+    it = iter(it)
+    while True:
+        t0 = time.perf_counter()
+        chunk = next(it, None)
+        trace.get_current().phase("parse", t0)
+        if chunk is None:
+            return
+        yield chunk
 
 
 def _verdicted(d, checker: str) -> bool:

@@ -31,7 +31,7 @@ from typing import Any
 
 from . import checker as jchecker
 from . import client as jclient
-from . import control, db as jdb, history as jhistory, os_setup
+from . import control, db as jdb, history as jhistory, os_setup, trace
 from .generator import interpreter
 from .store import Store
 from .util import real_pmap, relative_time
@@ -127,9 +127,10 @@ def analyze(test: dict) -> dict:
     (analyze! core.clj:496-513)."""
     log.info("Analyzing...")
     test["history"] = jhistory.index(test.get("history", []))
-    results = jchecker.check_safe(
-        test.get("checker") or jchecker.unbridled_optimism(),
-        test, test["history"], {})
+    with trace.span("analyze", ops=len(test["history"])):
+        results = jchecker.check_safe(
+            test.get("checker") or jchecker.unbridled_optimism(),
+            test, test["history"], {})
     test["results"] = results
     store: Store = test.get("store") or Store()
     test["store"] = store
@@ -145,6 +146,13 @@ def run(test: dict) -> dict:
     store: Store = test.get("store") or Store()
     test["store"] = store
     log.info("Running test %s", test["name"])
+    # A fresh per-run tracer: trace.json/metrics.json written by
+    # save_2 cover exactly this run. JEPSEN_TPU_JAX_PROFILE=1
+    # (--jax-profile) additionally wraps the run in a jax.profiler
+    # capture landing in the run dir.
+    trace.fresh_run(test.get("name"))
+    profile_cm = trace.jax_profile_session(
+        store.test_dir(test) / "jax-profile")
 
     os_ = test["os"]
     db = test["db"]
@@ -153,18 +161,20 @@ def run(test: dict) -> dict:
         # L1: provision OS, then cycle the DB.
         control.on_nodes(test, os_.setup)
         try:
-            jdb.cycle(db, test)
+            with trace.span("db.cycle"):
+                jdb.cycle(db, test)
             try:
                 if nemesis is not None:
                     test["nemesis"] = nemesis = nemesis.setup(test)
                 setup_clients(test)
 
-                with relative_time():
-                    history = interpreter.run(test)
-                test["history"] = jhistory.index(history)
-                store.save_1(test)
+                with profile_cm:
+                    with relative_time(), trace.span("generator.run"):
+                        history = interpreter.run(test)
+                    test["history"] = jhistory.index(history)
+                    store.save_1(test)
 
-                analyze(test)
+                    analyze(test)
             finally:
                 try:
                     teardown_clients(test)

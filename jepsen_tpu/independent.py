@@ -23,6 +23,7 @@ from typing import Any, Callable, Iterable
 
 from . import generator as gen
 from . import history as h
+from . import trace
 from .checker import Checker, check_safe, merge_valid
 from .util import bounded_pmap
 
@@ -331,10 +332,15 @@ def subhistories_path(history: list, path, stats: dict | None = None) -> dict:
             if len(key_ids) == len(history):
                 if stats is not None:
                     stats["native"] = stats.get("native", 0) + 1
+                trace.counter("split.native").inc()
                 return _subhistories_from_ids(history, key_ids.tolist(),
                                               keys)
+            # benign: the caller loaded an edited/different file than
+            # the one on disk — a documented fallback, not a broken lib
+            native_lib.count_fallback("split_key_ids")
     if stats is not None:
         stats["python"] = stats.get("python", 0) + 1
+    trace.counter("split.python").inc()
     return subhistories(relift_history(history))
 
 
@@ -399,7 +405,8 @@ class IndependentChecker(Checker):
 
     def check(self, test, history, opts):
         opts = opts or {}
-        by_key = subhistories(history)
+        with trace.span("independent.split", ops=len(history)):
+            by_key = subhistories(history)
         ks = list(by_key)
         subs = [by_key[k] for k in ks]
         if hasattr(self.sub, "check_batch"):
@@ -407,15 +414,20 @@ class IndependentChecker(Checker):
             # per-key namespacing) and so must not write store artifacts
             # themselves; per-key results/history are persisted below.
             try:
-                results = self.sub.check_batch(test, subs, opts)
+                with trace.span("independent.check_batch", keys=len(ks)):
+                    results = self.sub.check_batch(test, subs, opts)
             except Exception:
                 results = [check_safe(self.sub, test, s, self._sub_opts(opts, k))
                            for k, s in zip(ks, subs)]
         else:
-            results = bounded_pmap(
-                lambda ks_: check_safe(self.sub, test, ks_[1],
-                                       self._sub_opts(opts, ks_[0])),
-                list(zip(ks, subs)))
+
+            def _one(ks_):
+                k, s = ks_
+                with trace.span("independent.key", key=str(k)):
+                    return check_safe(self.sub, test, s,
+                                      self._sub_opts(opts, k))
+
+            results = bounded_pmap(_one, list(zip(ks, subs)))
         # Batch-dispatched sub-checkers never see per-key opts, so any
         # per-failure artifact (e.g. linear.svg) is rendered here, where
         # the per-key subdirectory is known.

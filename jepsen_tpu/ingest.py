@@ -217,6 +217,14 @@ def iter_encode_chunks(run_dirs: Sequence[str | os.PathLike],
         info["parse_spans"] = []
     if not dirs:
         return
+    if checker in ("append", "wr") and native_ingest_enabled():
+        # Probe the native encoder in THIS process: pooled workers'
+        # fallback counters live in worker-local tracers that are never
+        # exported, so a missing .so would otherwise degrade the whole
+        # sweep's ingest with no signal in the sweep's metrics.json.
+        # _cached_lib counts + warns on a miss as a side effect.
+        from . import native_lib
+        native_lib.hist_lib()
     if processes is None:
         ncpu = os.cpu_count() or 1
         force = os.environ.get("JEPSEN_TPU_PIPELINE") == "1"
@@ -236,9 +244,16 @@ def iter_encode_chunks(run_dirs: Sequence[str | os.PathLike],
                                [(d, checker) for d in dirs],
                                chunksize=max(1, min(chunk // 4, 16)))
                 buf = []
+                from . import trace
+                tr = trace.get_current()
                 for d, (enc, t0, t1) in zip(dirs, it):
                     if info is not None:
                         info["parse_spans"].append((t0, t1))
+                    # the worker's parse window lands on its own trace
+                    # track (monotonic spans; the tracer converts), so
+                    # trace.json shows parse/device overlap directly
+                    tr.add_span("parse", t0, t1, track="ingest-pool",
+                                clock="monotonic")
                     buf.append((d, enc))
                     if len(buf) >= chunk:
                         yield buf

@@ -67,6 +67,35 @@ def _load_so(src: Path, so: Path) -> ctypes.CDLL | None:
 
 _cached: dict[str, ctypes.CDLL | None] = {}
 
+# Fallbacks already warned about (one line per degraded component per
+# process; the counter still counts every degraded call).
+_warned: set[str] = set()
+
+
+def count_fallback(what: str) -> None:
+    """Count a native→Python degrade in the current tracer's metrics
+    without the rebuild-advice warning — for benign per-file declines
+    (edited files, content the native pass can't replicate) where the
+    library itself is healthy."""
+    from . import trace
+    trace.counter("native_fallback").inc()
+    trace.counter(f"native_fallback.{what}").inc()
+
+
+def note_fallback(what: str, detail: str = "") -> None:
+    """Record a native→Python degrade: bump the `native_fallback`
+    metric (plus a per-component counter) and log ONE warning per
+    component per process. The native paths otherwise degrade
+    silently, which makes a missing/stale .so an invisible 3-9x perf
+    regression (ISSUE 2 satellite)."""
+    count_fallback(what)
+    if what not in _warned:
+        _warned.add(what)
+        log.warning(
+            "native %s unavailable%s; degrading to the Python path "
+            "(slower — build with `make -C native` or check g++)",
+            what, f" ({detail})" if detail else "")
+
 
 def _cached_lib(src_name: str, so_name: str, bind) -> ctypes.CDLL | None:
     """One home for the lazy build-load-bind-memoize dance all three
@@ -75,7 +104,14 @@ def _cached_lib(src_name: str, so_name: str, bind) -> ctypes.CDLL | None:
     predates the current ABI — it must degrade to the Python engines,
     not crash on missing symbols)."""
     if src_name in _cached:
-        return _cached[src_name]
+        L = _cached[src_name]
+        if L is None:
+            # the warning fired once at first probe, but tracers are
+            # per-run: every degraded call still counts, so a later
+            # run's metrics.json can't report native_fallback=0 while
+            # running fully degraded
+            count_fallback(src_name)
+        return L
     with _lock:
         if src_name in _cached:
             return _cached[src_name]
@@ -87,6 +123,12 @@ def _cached_lib(src_name: str, so_name: str, bind) -> ctypes.CDLL | None:
                     L = None
             except AttributeError:
                 L = None
+        if L is None:
+            note_fallback(
+                src_name,
+                "JEPSEN_TPU_NO_NATIVE set"
+                if os.environ.get("JEPSEN_TPU_NO_NATIVE")
+                else "build/load/ABI-bind failed")
         _cached[src_name] = L
         return L
 
@@ -255,6 +297,10 @@ def split_key_ids(path) -> tuple[list, np.ndarray] | None:
         return None
     h = L.jt_ks_split_file(os.fsencode(path))
     if not h:
+        # benign: the library is healthy, this file's lift semantics
+        # just aren't natively replicable — count, don't cry rebuild
+        count_fallback("split_key_ids")
+        log.debug("native split declined %s", path)
         return None
     try:
         dims = (ctypes.c_int64 * 4)()
@@ -269,6 +315,7 @@ def split_key_ids(path) -> tuple[list, np.ndarray] | None:
             L.jt_ks_key_names_json(h).decode("utf-8")) if json_len \
             else []
         if len(keys) != int(n_keys):
+            note_fallback("split_key_ids", "key-name/ids ABI drift")
             return None  # ABI drift: don't guess
         return keys, ids
     finally:

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import datetime
 import json
+import logging
 import os
 import shutil
 from pathlib import Path
@@ -28,6 +29,8 @@ from typing import Any, Iterable
 
 from . import edn, history as h
 from .util import chunk_vec, real_pmap
+
+log = logging.getLogger(__name__)
 
 # Keys that never serialize (functions, live connections...).
 # Reference: store.clj:160-168.
@@ -163,10 +166,36 @@ class Store:
         self.update_symlinks(test)
         return test
 
+    def write_trace(self, test: dict) -> Path | None:
+        """Persist the current run tracer's `trace.json` (Chrome
+        trace-event format, Perfetto-loadable) and `metrics.json` next
+        to history.edn — every run self-attributes, not just benches.
+        No-op (returns None) when tracing is disabled
+        (JEPSEN_TPU_TRACE=0 / --no-trace), or when the current tracer
+        is sweep-scoped (analyze-store fallbacks re-analyze runs under
+        the SWEEP's tracer; exporting it here would write the whole
+        sweep's events into each run dir, once per run)."""
+        from . import trace
+        t = trace.get_current()
+        if not getattr(t, "enabled", False) \
+                or getattr(t, "scope", "run") != "run":
+            return None
+        d = self.test_dir(test)
+        d.mkdir(parents=True, exist_ok=True)
+        p = t.export(d / "trace.json")
+        t.export_metrics(d / "metrics.json")
+        return p
+
     def save_2(self, test: dict) -> dict:
-        """Persist results (after analysis)."""
+        """Persist results (after analysis), plus the run's trace +
+        metrics artifacts (observability must never sink persistence,
+        so trace export failures degrade to a warning)."""
         self.write_test(test)
         self.write_results(test)
+        try:
+            self.write_trace(test)
+        except Exception:
+            log.warning("trace/metrics export failed", exc_info=True)
         self.update_symlinks(test)
         return test
 

@@ -67,3 +67,16 @@ def pytest_collection_modifyitems(config, items):
 @pytest.fixture(autouse=True)
 def _seed():
     random.seed(42)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer():
+    """Drop the process-global tracer around every test: tracing stays
+    on (the default-on paths are exercised for real), but one test's
+    span events never accumulate into the next — a session-long event
+    buffer would grow the gen2 GC scan under the deadline-sensitive
+    suite e2e tests."""
+    from jepsen_tpu import trace
+    trace.reset()
+    yield
+    trace.reset()

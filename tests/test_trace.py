@@ -1,0 +1,339 @@
+"""jepsen_tpu.trace: golden-shape Chrome trace-event export, the
+JEPSEN_TPU_TRACE=0 no-op contract (no file, sub-microsecond spans),
+phase parity between the tracer and the legacy `phases` dict,
+idempotent PendingVerdicts collection (the double-count hazard), and
+the store/CLI/native integration points."""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+
+import pytest
+
+from jepsen_tpu import parallel, trace
+from jepsen_tpu.checker.elle import encode as elle_encode
+from jepsen_tpu.checker.elle.synth import synth_append_history
+
+
+@pytest.fixture(autouse=True)
+def _fresh_trace():
+    """Each test gets (and leaves behind) a clean tracer slate."""
+    trace.reset()
+    yield
+    trace.reset()
+
+
+def make_encs(n=3, T=60):
+    return [elle_encode.encode_history(
+        synth_append_history(T=T + 30 * i, K=6, seed=i))
+        for i in range(n)]
+
+
+def _validate_chrome(obj):
+    """The golden shape: a Chrome trace-event JSON object whose timed
+    events are complete ("X") events with the required keys, sorted by
+    monotonic non-negative ts."""
+    assert "traceEvents" in obj
+    evs = obj["traceEvents"]
+    assert isinstance(evs, list) and evs
+    last_ts = None
+    for e in evs:
+        assert e["ph"] in ("X", "M"), e
+        assert isinstance(e["name"], str) and e["name"]
+        assert "pid" in e
+        if e["ph"] == "M":
+            assert "name" in e["args"]
+            continue
+        assert "tid" in e
+        assert e["ts"] >= 0 and e["dur"] >= 0, e
+        if last_ts is not None:
+            assert e["ts"] >= last_ts, "events must be ts-sorted"
+        last_ts = e["ts"]
+
+
+def test_trace_export_golden_shape(tmp_path):
+    tr = trace.fresh_run("unit")
+    with tr.span("outer", k=1):
+        with tr.span("inner"):
+            pass
+    t0 = time.perf_counter()
+    tr.phase("pack", t0)
+    tr.device_complete("bucket", t0, histories=2)
+    tr.counter("buckets_dispatched").inc(3)
+    tr.gauge("inflight_depth").set(2)
+    p = tr.export(tmp_path / "trace.json")
+    obj = json.loads(p.read_text())
+    _validate_chrome(obj)
+    names = {e["name"] for e in obj["traceEvents"]}
+    assert {"outer", "inner", "pack", "bucket"} <= names
+    # the device-timing event rides its own named track
+    dev = [e for e in obj["traceEvents"]
+           if e.get("tid") == trace.DEVICE_TID and e["ph"] == "X"]
+    assert dev and dev[0]["cat"] == "device"
+    track_names = {e["args"]["name"] for e in obj["traceEvents"]
+                   if e["ph"] == "M"}
+    assert "device" in track_names
+    m = json.loads(
+        tr.export_metrics(tmp_path / "metrics.json").read_text())
+    assert m["counters"]["buckets_dispatched"] == 3
+    assert m["gauges"]["inflight_depth"] == 2
+    assert m["histograms"]["phase.pack"]["count"] == 1
+
+
+def test_sweep_phases_match_tracer_and_metrics():
+    """The bench-parity contract: the legacy `phases` dict and the
+    tracer-derived totals are the same numbers (within 1%; identical
+    by construction since _acc_phase records once), and a sweep leaves
+    the dispatch metrics + at least one device event behind."""
+    tr = trace.fresh_run("sweep")
+    encs = make_encs()
+    phases: dict = {}
+    pv = parallel.check_bucketed_async(encs, phases=phases)
+    out = pv.result(phases)
+    assert all(o == {} for o in out)
+    totals = tr.phase_totals()
+    for k in ("pack", "h2d", "dispatch", "collect"):
+        assert k in phases, phases
+        assert totals.get(k, 0.0) == pytest.approx(phases[k], rel=0.01)
+    md = tr.metrics_dict()
+    assert md["counters"]["buckets_dispatched"] >= 1
+    assert md["gauges"]["inflight_depth"] is not None
+    assert md["counters"].get("pad_waste_cells", 0) >= 0
+    assert any(e.get("cat") == "device" for e in tr.chrome_events())
+
+
+def test_pending_verdicts_result_idempotent():
+    """Regression for the PR-1 double-count hazard: result(phases) a
+    second time must return the SAME verdicts (not all-Nones) and must
+    not re-accumulate the collect phase."""
+    encs = make_encs(4)
+    phases: dict = {}
+    pv = parallel.check_bucketed_async(encs, phases=phases)
+    first = pv.result(phases)
+    collect1 = phases.get("collect", 0.0)
+    second = pv.result(phases)
+    assert second is first
+    assert None not in second
+    assert phases.get("collect", 0.0) == collect1
+
+
+def test_disabled_tracer_no_file_and_cheap(tmp_path, monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_TRACE", "0")
+    trace.reset()
+    tr = trace.get_current()
+    assert isinstance(tr, trace.NullTracer)
+    assert tr.export(tmp_path / "t.json") is None
+    assert not (tmp_path / "t.json").exists()
+    # tight-loop smoke: the no-op span must stay ~1µs (10x CI headroom)
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with trace.span("hot"):
+            pass
+    dt = time.perf_counter() - t0
+    assert dt / n < 10e-6, f"{dt / n * 1e6:.2f}µs per disabled span"
+    # phases-dict accounting stays exact with tracing off
+    phases: dict = {}
+    t0 = time.perf_counter()
+    parallel._acc_phase(phases, "pack", t0)
+    assert phases["pack"] >= 0
+    assert tr.phase_totals() == {}
+
+
+def _tiny_test_map(tmp_path, n_ops=10):
+    from jepsen_tpu import checker as c
+    from jepsen_tpu import generator as gen
+    from jepsen_tpu import net as jnet
+    from jepsen_tpu import workloads
+    from jepsen_tpu.store import Store
+
+    db, client = workloads.atom_fixtures()
+    return {
+        "name": "traced", "nodes": ["n1"], "concurrency": 2,
+        "ssh": {"dummy": True}, "net": jnet.noop(), "db": db,
+        "client": client, "store": Store(tmp_path / "store"),
+        "generator": gen.clients(gen.limit(
+            n_ops, gen.repeat_gen({"f": "read"}))),
+        "checker": c.stats(),
+    }
+
+
+def test_core_run_writes_trace_artifacts(tmp_path):
+    from jepsen_tpu import core
+
+    test = core.run(_tiny_test_map(tmp_path))
+    d = test["store"].test_dir(test)
+    obj = json.loads((d / "trace.json").read_text())
+    _validate_chrome(obj)
+    names = {e["name"] for e in obj["traceEvents"]}
+    assert "analyze" in names and "generator.run" in names
+    assert any(n.startswith("check:") for n in names)
+    m = json.loads((d / "metrics.json").read_text())
+    assert "counters" in m and "phase_totals_secs" in m
+
+
+def test_core_run_no_artifacts_when_disabled(tmp_path, monkeypatch):
+    from jepsen_tpu import core
+
+    monkeypatch.setenv("JEPSEN_TPU_TRACE", "0")
+    trace.reset()
+    test = core.run(_tiny_test_map(tmp_path))
+    d = test["store"].test_dir(test)
+    assert (d / "results.json").exists()
+    assert not (d / "trace.json").exists()
+    assert not (d / "metrics.json").exists()
+
+
+def test_analyze_store_writes_sweep_trace(tmp_path):
+    from jepsen_tpu import cli
+    from jepsen_tpu.history import history_to_edn
+    from jepsen_tpu.store import Store
+
+    store = Store(tmp_path / "store")
+    for i in range(2):
+        d = store.base / "t" / f"2020010{1 + i}T000000"
+        d.mkdir(parents=True)
+        (d / "history.edn").write_text(
+            history_to_edn(synth_append_history(T=40, K=4, seed=3 + i)))
+    rc = cli.analyze_store(store, checker="append")
+    assert rc == 0
+    obj = json.loads((store.base / "trace.json").read_text())
+    _validate_chrome(obj)
+    names = {e["name"] for e in obj["traceEvents"]}
+    # the acceptance span set: the sweep attributes every phase and
+    # records at least one device-timing event
+    assert {"parse", "pack", "h2d", "dispatch", "collect"} <= names
+    assert any(e.get("cat") == "device" for e in obj["traceEvents"]
+               if e["ph"] == "X")
+    assert (store.base / "metrics.json").exists()
+
+
+def test_stored_fallback_does_not_export_sweep_trace_per_run(tmp_path):
+    """analyze-store fallbacks re-analyze runs (core.analyze -> save_2)
+    under the SWEEP's tracer; per-run dirs must not each receive a copy
+    of the whole sweep's trace — only the store-level artifact."""
+    from jepsen_tpu import cli
+    from jepsen_tpu.store import Store
+
+    store = Store(tmp_path / "store")
+    hist = [{"type": "invoke", "process": 0, "f": "read", "value": None},
+            {"type": "ok", "process": 0, "f": "read", "value": 1}]
+    d = store.base / "x" / "20200101T000000"
+    d.mkdir(parents=True)
+    (d / "history.jsonl").write_text(
+        "\n".join(json.dumps(o) for o in hist) + "\n")
+    (d / "test.json").write_text(json.dumps({"name": "x"}))
+    rc = cli.analyze_store(store, checker="stored")
+    assert rc == 0
+    assert not (d / "trace.json").exists()
+    assert (store.base / "trace.json").exists()
+
+
+def test_cli_trace_flags(tmp_path, capsys, monkeypatch):
+    from jepsen_tpu import cli
+
+    # monkeypatch records the pre-test value; apply_trace_opts's env
+    # writes are rolled back at teardown
+    monkeypatch.setenv("JEPSEN_TPU_TRACE", "1")
+
+    def tf(tmap, args):
+        return {**_tiny_test_map(tmp_path), **{
+            k: v for k, v in tmap.items() if k == "store"}}
+
+    rc = cli.run_cli(tf, argv=[
+        "test", "--dummy", "-n", "n1",
+        "--store", str(tmp_path / "store")])
+    assert rc == 0
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["trace"].endswith("trace.json")
+
+    rc = cli.run_cli(tf, argv=[
+        "test", "--dummy", "-n", "n1", "--no-trace",
+        "--store", str(tmp_path / "store")])
+    assert rc == 0
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "trace" not in line
+
+
+def test_native_fallback_counter_and_one_time_warning(caplog):
+    from jepsen_tpu import native_lib
+
+    tr = trace.fresh_run("native")
+    native_lib._warned.discard("unit-test")
+    with caplog.at_level(logging.WARNING, logger="jepsen_tpu.native_lib"):
+        native_lib.note_fallback("unit-test", "forced by test")
+        native_lib.note_fallback("unit-test", "forced by test")
+    counters = tr.metrics_dict()["counters"]
+    assert counters["native_fallback"] == 2
+    assert counters["native_fallback.unit-test"] == 2
+    warned = [r for r in caplog.records if "unit-test" in r.getMessage()]
+    assert len(warned) == 1  # one line per component per process
+    native_lib._warned.discard("unit-test")
+
+
+def test_overlapping_device_windows_spill_to_lanes(tmp_path):
+    """Two in-flight buckets (max_inflight=2) produce overlapping
+    device windows; they must land on separate lanes — a single tid
+    carrying partially-overlapping X events renders wrong in
+    Perfetto/chrome://tracing."""
+    tr = trace.fresh_run("lanes")
+    t0 = time.perf_counter()
+    tr.device_complete("bucket", t0, t0 + 0.010)
+    tr.device_complete("bucket", t0 + 0.002, t0 + 0.008)  # overlaps
+    tr.device_complete("bucket", t0 + 0.020, t0 + 0.021)  # lane 0 free
+    obj = json.loads(tr.export(tmp_path / "t.json").read_text())
+    by_tid: dict = {}
+    for e in obj["traceEvents"]:
+        if e["ph"] == "X":
+            by_tid.setdefault(e["tid"], []).append(
+                (e["ts"], e["ts"] + e["dur"]))
+    assert len(by_tid) == 2  # lane 0 ("device") + one spill lane
+    for spans in by_tid.values():
+        spans.sort()
+        for (_, e1), (s2, _) in zip(spans, spans[1:]):
+            assert s2 >= e1, "partial overlap within one tid"
+    track_names = {e["args"]["name"] for e in obj["traceEvents"]
+                   if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert {"device", "device-2"} <= track_names
+
+
+def test_native_fallback_counted_in_every_run(monkeypatch):
+    """The one-time warning is per process, but the counter must land
+    in EVERY run's tracer — a later run's metrics.json reporting
+    native_fallback=0 while fully degraded would hide the regression
+    the counter exists to expose."""
+    from jepsen_tpu import native_lib
+
+    monkeypatch.setitem(native_lib._cached, "fake-lib.cc", None)
+    tr1 = trace.fresh_run("run-1")
+    assert native_lib._cached_lib("fake-lib.cc", "x.so",
+                                  lambda L: True) is None
+    assert tr1.metrics_dict()["counters"]["native_fallback"] == 1
+    tr2 = trace.fresh_run("run-2")
+    native_lib._cached_lib("fake-lib.cc", "x.so", lambda L: True)
+    assert tr2.metrics_dict()["counters"]["native_fallback"] == 1
+
+
+def test_nested_spans_and_thread_tracks(tmp_path):
+    import threading
+
+    tr = trace.fresh_run("threads")
+
+    def work():
+        with tr.span("worker-span"):
+            time.sleep(0.001)
+
+    t = threading.Thread(target=work, name="span-worker")
+    with tr.span("main-span"):
+        t.start()
+        t.join()
+    obj = json.loads(tr.export(tmp_path / "t.json").read_text())
+    _validate_chrome(obj)
+    xs = [e for e in obj["traceEvents"] if e["ph"] == "X"]
+    tids = {e["tid"] for e in xs}
+    assert len(tids) == 2  # one track per thread
+    thread_names = {e["args"]["name"] for e in obj["traceEvents"]
+                    if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert "span-worker" in thread_names
