@@ -726,11 +726,20 @@ def bench_north_star(n_dev: int, devices) -> dict:
                                    min(100, max(2, B // 6))))
 
     root = Path(tempfile.mkdtemp(prefix="bench-ns-"))
+    _cache_prev = os.environ.get("JEPSEN_TPU_ENCODE_CACHE")
     try:
         dirs = _write_synth_store(root, B, T, K, bad_every)
         mesh = parallel.make_mesh(devices) if n_dev > 1 else None
         prohibited = elle.AppendChecker().prohibited
 
+        # The pre-stages (cold ingest timing, compile warmups, pure
+        # device sweep) run with the encoded cache OFF so they neither
+        # pre-populate sidecars (which would silently warm the timed
+        # "cold" sweep) nor pay sidecar writes inside t_ingest. The
+        # timed sweep itself runs cache-on (cold: every run misses and
+        # writes), and the cache_warm block re-sweeps the same store
+        # to measure the hit path.
+        os.environ["JEPSEN_TPU_ENCODE_CACHE"] = "0"
         t0 = time.perf_counter()
         encs = ingest.parallel_encode(dirs, checker="append")
         t_ingest = time.perf_counter() - t0
@@ -756,8 +765,15 @@ def bench_north_star(n_dev: int, devices) -> dict:
             parallel.check_bucketed(encs[i:i + chunk], mesh,
                                     budget_cells=budget)
         t_check = time.perf_counter() - t0
+        if _cache_prev is None:
+            os.environ.pop("JEPSEN_TPU_ENCODE_CACHE", None)
+        else:
+            os.environ["JEPSEN_TPU_ENCODE_CACHE"] = _cache_prev
 
         import contextlib
+
+        from jepsen_tpu import trace as jtrace
+
         profile_dir = os.environ.get("BENCH_PROFILE_DIR")
         if profile_dir:
             # opt-in xplane capture of the timed sweep: ground truth
@@ -766,37 +782,55 @@ def bench_north_star(n_dev: int, devices) -> dict:
             prof_cm = _prof.trace(profile_dir)
         else:
             prof_cm = contextlib.nullcontext()
-        # Timed region = analyze-store's streaming pipeline, now
-        # genuinely double-buffered: chunk N is DISPATCHED async
-        # (check_bucketed_async — no blocking device_get), then chunk
-        # N-1's flags are collected and rendered while N computes, and
-        # the pool parses chunk N+1 in the background throughout.
-        # Every host second lands in a named phase: parse (main-thread
-        # stall on the ingest pool), pack / h2d / dispatch (inside
-        # check_bucketed_async), collect (block + D2H), render.
         # Pipelining decision passed down as a parameter (the same
         # cleanup cli.py got): a worker pays off on a 1-core host only
         # when a real device runs the checks.
         procs = max(1, os.cpu_count() or 1) if accel else None
-        pipe_info: dict = {}
-        dev_spans: list = []   # wall-clock device-in-flight windows
-        phases: dict = {}
-        verdicts: list = []
-        pend = None            # (PendingVerdicts, chunk encs, t_dispatch)
 
-        def collect(pend_):
-            """Resolve one in-flight chunk: close its device window
-            (dispatch-enqueued -> flags materialized, monotonic — the
-            same clock as the workers' parse spans) and render."""
-            pv, pencs, ptd = pend_
-            flags = pv.result(phases)
-            dev_spans.append((ptd, time.monotonic()))
-            t_r = time.perf_counter()
-            verdicts.extend(elle.render_verdict(e, c, prohibited)
-                            for e, c in zip(pencs, flags))
-            parallel._acc_phase(phases, "render", t_r)
+        _tr = jtrace.get_current()
 
-        with prof_cm:
+        def _ctr(name: str) -> int:
+            return getattr(_tr.counter(name), "value", 0) or 0
+
+        _CTRS = ("shm_bytes", "cache_hits", "cache_misses")
+
+        def run_sweep() -> dict:
+            """One streaming store->verdict sweep (analyze-store
+            semantics), genuinely double-buffered: chunk N is
+            DISPATCHED async (check_bucketed_async — no blocking
+            device_get), then chunk N-1's flags are collected and
+            rendered while N computes, and the pool parses chunk N+1
+            in the background throughout. Phase attribution: the MAIN
+            thread's seconds partition into parse (stall on the
+            ingest pool), feed (stall on the pack-h2d thread),
+            dispatch, collect (block + D2H) and render; pack and h2d
+            accrue on the pack-h2d thread and OVERLAP the main
+            thread's phases by design (phases_sum_secs can therefore
+            exceed sweep_secs — it sums host work, not wall clock;
+            with JEPSEN_TPU_PACK_THREAD=0 everything is main-thread
+            and the old partition holds). Returns the timings plus
+            the tracer-counter deltas (shm_bytes, cache hits/misses)
+            this sweep produced."""
+            pipe_info: dict = {}
+            dev_spans: list = []   # wall-clock device-in-flight windows
+            phases: dict = {}
+            verdicts: list = []
+            pend = None        # (PendingVerdicts, chunk encs, t_disp)
+            ctr0 = {c: _ctr(c) for c in _CTRS}
+
+            def collect(pend_):
+                """Resolve one in-flight chunk: close its device
+                window (dispatch-enqueued -> flags materialized,
+                monotonic — the same clock as the workers' parse
+                spans) and render."""
+                pv, pencs, ptd = pend_
+                flags = pv.result(phases)
+                dev_spans.append((ptd, time.monotonic()))
+                t_r = time.perf_counter()
+                verdicts.extend(elle.render_verdict(e, c, prohibited)
+                                for e, c in zip(pencs, flags))
+                parallel._acc_phase(phases, "render", t_r)
+
             t0 = time.perf_counter()
             it = iter(ingest.iter_encode_chunks(dirs, "append",
                                                 chunk=chunk,
@@ -830,7 +864,22 @@ def bench_north_star(n_dev: int, devices) -> dict:
                 if part is None:
                     break
                 pend = nxt
-            t_sweep = time.perf_counter() - t0
+            return {
+                "t_sweep": time.perf_counter() - t0,
+                "phases": phases, "pipe_info": pipe_info,
+                "dev_spans": dev_spans, "verdicts": verdicts,
+                "counters": {c: _ctr(c) - ctr0[c] for c in _CTRS},
+            }
+
+        # Timed region = the COLD streaming sweep: every run dir
+        # misses the encoded cache, parses, and leaves a sidecar.
+        with prof_cm:
+            cold = run_sweep()
+        t_sweep = cold["t_sweep"]
+        phases = cold["phases"]
+        pipe_info = cold["pipe_info"]
+        dev_spans = cold["dev_spans"]
+        verdicts = cold["verdicts"]
         # The phases dict IS the tracer view: every entry is the
         # duration trace.phase() measured and recorded (parallel.
         # _acc_phase adapts spans into it), scoped to exactly this
@@ -844,6 +893,41 @@ def bench_north_star(n_dev: int, devices) -> dict:
         assert n_bad == expect_bad, (n_bad, expect_bad)
         assert all("G1c" in v["anomaly-types"] for v in verdicts
                    if v["valid?"] is False)
+
+        # cache_warm variant: the SECOND sweep over the same store —
+        # every run dir now hits its encoded.v1 sidecar, so ingest is
+        # an mmap + key check instead of a parse. warm ingest_secs is
+        # measured SERIALLY (processes=0): a cache hit costs an mmap,
+        # not a parse, so paying the pool's spawn floor to "speed it
+        # up" would just measure process startup; the cold t_ingest
+        # keeps the pool because cold ingest is parse-bound. Skipped
+        # entirely when the user's env disables the cache — a second
+        # full re-parse would be published as "warm" evidence of a
+        # cache that never ran.
+        from jepsen_tpu import store as jstore
+        if jstore.encode_cache_enabled():
+            t0 = time.perf_counter()
+            encs_w = ingest.parallel_encode(dirs, checker="append",
+                                            processes=0)
+            warm_ingest = time.perf_counter() - t0
+            assert not any(isinstance(e, Exception) for e in encs_w)
+            warm = run_sweep()
+            warm_bad = sum(1 for v in warm["verdicts"]
+                           if v["valid?"] is False)
+            assert warm_bad == n_bad, (warm_bad, n_bad)
+            cache_warm = {
+                "value": round(B / warm["t_sweep"], 2),
+                "sweep_secs": round(warm["t_sweep"], 3),
+                "ingest_secs": round(warm_ingest, 3),
+                "ingest_speedup_vs_cold": round(
+                    t_ingest / max(warm_ingest, 1e-9), 2),
+                "phases": {k: round(warm["phases"].get(k, 0.0), 3)
+                           for k in ("parse", "feed", "pack", "h2d",
+                                     "dispatch", "collect", "render")},
+                **warm["counters"],
+            }
+        else:
+            cache_warm = {"skipped": "JEPSEN_TPU_ENCODE_CACHE=0"}
 
         # store->verdict wall clock: the double-buffered sweep, with
         # rendering overlapped inside it (the render phase rides the
@@ -884,8 +968,8 @@ def bench_north_star(n_dev: int, devices) -> dict:
         formulation = (("pallas" if use_pallas_f else "xla")
                        + ("-int8" if use_int8_f else "-bf16"))
         phase_out = {k: round(phases.get(k, 0.0), 3)
-                     for k in ("parse", "pack", "h2d", "dispatch",
-                               "collect", "render")}
+                     for k in ("parse", "feed", "pack", "h2d",
+                               "dispatch", "collect", "render")}
         return {
             "metric": f"north-star store->verdict histories/sec "
                       f"({B}x{T}-txn, {n_dev} dev)",
@@ -896,16 +980,18 @@ def bench_north_star(n_dev: int, devices) -> dict:
             "sweep_secs": round(t_sweep, 3),
             "ingest_secs": round(t_ingest, 3),
             "check_secs": round(t_check, 3),
-            # Full attribution of sweep_secs via jepsen_tpu.trace
-            # phase spans (same keys and semantics as the pre-trace
-            # dict — _acc_phase adapts each measured span into it):
-            # every main-thread second of the pipelined sweep lands
-            # in exactly one phase —
-            # parse (stall on the ingest pool), pack (bucket planning +
-            # host tensor packing), h2d (device_put/sharding), dispatch
-            # (async kernel enqueue), collect (block + D2H + flag
-            # decode), render (verdict rendering). Their sum tracks
-            # sweep_secs up to loop glue.
+            # Host-phase attribution via jepsen_tpu.trace phase spans
+            # (_acc_phase adapts each measured span into the dict).
+            # MAIN-thread seconds partition into parse (stall on the
+            # ingest pool), feed (stall on the pack-h2d thread),
+            # dispatch (async kernel enqueue), collect (block + D2H +
+            # flag decode) and render (verdict rendering); pack
+            # (bucket planning + host tensor packing) and h2d
+            # (device_put/sharding) run on the dedicated pack-h2d
+            # thread and OVERLAP the main thread, so phases_sum_secs
+            # sums host WORK and may exceed sweep_secs. With
+            # JEPSEN_TPU_PACK_THREAD=0 every phase is main-thread and
+            # the sum tracks sweep_secs up to loop glue.
             "phases": phase_out,
             "phases_sum_secs": round(sum(phase_out.values()), 3),
             # THE overlap number (one field, measured, replacing the
@@ -922,6 +1008,17 @@ def bench_north_star(n_dev: int, devices) -> dict:
             # whether the C++ jsonl->tensor path (native/hist_encode.cc)
             # carried the ingest, vs the Python encoder
             "native_ingest": _native_ingest_active(),
+            # zero-copy transport + encoded-cache evidence for THIS
+            # (cold) sweep, from the tracer counters that also land in
+            # metrics.json: bytes moved through shared memory instead
+            # of the pickle pipe, and the cold sweep's cache activity
+            # (all misses + sidecar writes on a fresh store)
+            "shm_bytes": cold["counters"]["shm_bytes"],
+            "cache": {"hits": cold["counters"]["cache_hits"],
+                      "misses": cold["counters"]["cache_misses"]},
+            # the second sweep over the same store: every run hits its
+            # encoded.v1 sidecar (ingest ~ mmap + key check)
+            "cache_warm": cache_warm,
             "render_secs": round(t_render, 3),
             "invalid_found": n_bad,
             "closure_rounds": rounds,
@@ -934,6 +1031,10 @@ def bench_north_star(n_dev: int, devices) -> dict:
                          f"{'TOPS' if use_int8_f else 'TFLOPS'}/chip",
         }
     finally:
+        if _cache_prev is None:
+            os.environ.pop("JEPSEN_TPU_ENCODE_CACHE", None)
+        else:
+            os.environ["JEPSEN_TPU_ENCODE_CACHE"] = _cache_prev
         shutil.rmtree(root, ignore_errors=True)
 
 
@@ -1004,6 +1105,13 @@ def run_benches() -> int:
             tp = os.environ.get("BENCH_TRACE_PATH", "trace.json")
             tcur.export(tp)
             out["trace_path"] = tp
+            # the counter/gauge/histogram registry (shm_bytes,
+            # cache_hits/misses, reorder_depth, bucket_cells, ...)
+            # archives next to the trace so BENCH rounds can diff
+            # ingest behavior without re-running
+            mpth = os.environ.get("BENCH_METRICS_PATH", "metrics.json")
+            tcur.export_metrics(mpth)
+            out["metrics_path"] = mpth
     except Exception as e:
         out["trace_error"] = repr(e)[:200]
     print(json.dumps(out))

@@ -71,9 +71,26 @@ def _witness(code: int, f0: int, f1: int, f2: int, f3: int,
     return {"key": key, "value": f1}    # phantom-read
 
 
-def encode_history_file(path: str | os.PathLike) -> EncodedHistory | None:
+def _write_sidecar(L, h, hist_path: Path, sidecar_path) -> None:
+    """Persist the encoded.v1 sidecar straight from the native
+    handle's buffers (store.py's flat layout, no Python round-trip).
+    Best-effort: a 0 return just leaves the run uncached."""
+    if sidecar_path is None:
+        return
+    try:
+        L.jt_ha_write_sidecar(h, os.fsencode(str(hist_path)),
+                              os.fsencode(str(sidecar_path)))
+    except Exception:
+        pass
+
+
+def encode_history_file(path: str | os.PathLike,
+                        sidecar_path: str | os.PathLike | None = None
+                        ) -> EncodedHistory | None:
     """Encode one history.jsonl natively; None means "use the Python
-    path" (lib unavailable, file absent, or unrepresentable content)."""
+    path" (lib unavailable, file absent, or unrepresentable content).
+    `sidecar_path`, when given, also writes the encoded.v1 cache
+    sidecar from the native buffers."""
     L = native_lib.hist_lib()
     if L is None:
         return None
@@ -84,6 +101,7 @@ def encode_history_file(path: str | os.PathLike) -> EncodedHistory | None:
     if not h:
         return None
     try:
+        _write_sidecar(L, h, p, sidecar_path)
         dims = (ctypes.c_int64 * 8)()
         L.jt_ha_dims(h, dims)
         n, n_keys, max_pos, n_app, n_rd, n_anom, json_len, _n_pre = dims
@@ -118,10 +136,11 @@ def encode_history_file(path: str | os.PathLike) -> EncodedHistory | None:
         L.jt_ha_free(h)
 
 
-def encode_wr_history_file(path: str | os.PathLike):
+def encode_wr_history_file(path: str | os.PathLike,
+                           sidecar_path: str | os.PathLike | None = None):
     """Native sibling of wr.encode_wr_history with DEFAULT version-order
     flags (the analyze-store wr sweep's configuration); None means "use
-    the Python path"."""
+    the Python path". `sidecar_path` as in encode_history_file."""
     from .wr import WrEncoded
     L = native_lib.hist_lib()
     if L is None:
@@ -133,6 +152,7 @@ def encode_wr_history_file(path: str | os.PathLike):
     if not h:
         return None
     try:
+        _write_sidecar(L, h, p, sidecar_path)
         dims = (ctypes.c_int64 * 8)()
         L.jt_ha_dims(h, dims)
         n, key_count, _mp, _n_app, _n_rd, n_anom, json_len, n_edges = dims

@@ -40,12 +40,33 @@ def native_ingest_enabled() -> bool:
 
 
 def encode_run_dir(run_dir: str | os.PathLike, checker: str = "append",
-                   lean: bool = True):
+                   lean: bool = True, info: dict | None = None):
     """Load + encode one run dir. With lean=True the per-row completion
     ops are dropped so only arrays cross process boundaries (witness
     rendering then reports txn row numbers instead of full ops — the
-    batch sweep's flags don't carry witnesses anyway)."""
-    if checker in ("append", "wr") and lean and native_ingest_enabled():
+    batch sweep's flags don't carry witnesses anyway).
+
+    `info`, when given, gets info["cache"] set to "hit"/"miss" (None
+    when the encoded sidecar cache didn't apply) so pooled callers can
+    aggregate cache counters in the PARENT tracer — pool workers'
+    tracers are process-local and never exported."""
+    from . import trace
+    cacheable = lean and checker in ("append", "wr")
+    if info is not None:
+        info["cache"] = None
+    if cacheable:
+        from . import store as _store
+        if _store.encode_cache_enabled():
+            enc = _store.load_encoded(run_dir, checker)
+            if enc is not None:
+                trace.counter("cache_hits").inc()
+                if info is not None:
+                    info["cache"] = "hit"
+                return enc
+            trace.counter("cache_misses").inc()
+            if info is not None:
+                info["cache"] = "miss"
+    if cacheable and native_ingest_enabled():
         # C++ fast path: history.jsonl -> tensors/edges with no Python
         # dicts (native/hist_encode.cc). None -> fall through to the
         # Python encoder; the native side only accepts inputs it can
@@ -53,12 +74,21 @@ def encode_run_dir(run_dir: str | os.PathLike, checker: str = "append",
         # the lean int shape, which the Python branches below
         # canonicalize to as well (encode.lean_anomalies /
         # wr.lean_wr_anomalies) so persisted artifacts don't depend on
-        # which encoder ran.
+        # which encoder ran. The native encoder also writes the
+        # encoded.v1 sidecar straight from its own buffers (no Python
+        # round-trip) when cache writes are on.
         jl = Path(run_dir) / "history.jsonl"
         if jl.is_file():
+            from . import store as _store
             from .checker.elle import native_encode as ne
-            enc = (ne.encode_history_file(jl) if checker == "append"
-                   else ne.encode_wr_history_file(jl))
+            sidecar = None
+            if _store.encode_cache_enabled() \
+                    and _store.encode_cache_write_enabled():
+                sidecar = _store.encoded_cache_path(run_dir, checker)
+            enc = (ne.encode_history_file(jl, sidecar_path=sidecar)
+                   if checker == "append"
+                   else ne.encode_wr_history_file(jl,
+                                                  sidecar_path=sidecar))
             if enc is not None:
                 return enc
     hist = load_history_dir(run_dir)
@@ -76,6 +106,9 @@ def encode_run_dir(run_dir: str | os.PathLike, checker: str = "append",
         raise ValueError(f"unknown checker {checker!r}")
     if lean:
         enc.txn_ops = []
+        if cacheable:
+            from . import store as _store
+            _store.save_encoded(run_dir, checker, enc)
     return enc
 
 
@@ -85,17 +118,6 @@ def _worker(args):
         return encode_run_dir(run_dir, checker)
     except Exception as e:
         return e
-
-
-def _timed_worker(args):
-    """_worker plus the clock span the parse occupied, so the
-    pipelined sweep can MEASURE host/device overlap (span intersection)
-    instead of inferring it from noisy end-to-end subtraction.
-    time.monotonic: CLOCK_MONOTONIC is system-wide on Linux, so spans
-    compare across processes and an NTP step can't corrupt them."""
-    t0 = time.monotonic()
-    out = _worker(args)
-    return out, t0, time.monotonic()
 
 
 def overlap_seconds(spans_a: list, spans_b: list) -> float:
@@ -123,6 +145,30 @@ def overlap_seconds(spans_a: list, spans_b: list) -> float:
             total += max(0.0, min(e, b[j][1]) - max(s, b[j][0]))
             j += 1
     return total
+
+
+def _stream_worker(args):
+    """Pool worker for the streaming pipeline: encode one run dir and
+    move the arrays through shared memory when a segment name was
+    assigned (jepsen_tpu.shm), or fall back to pickling the encoding.
+    Returns (idx, payload, encode-info, t0, t1); payload is a shm
+    descriptor, the encoding itself, or the per-run Exception. The
+    (t0, t1) parse span uses time.monotonic: CLOCK_MONOTONIC is
+    system-wide on Linux, so spans compare across processes (the
+    measured-overlap contract) and an NTP step can't corrupt them."""
+    idx, run_dir, checker, seg_name = args
+    t0 = time.monotonic()
+    einfo: dict = {}
+    try:
+        enc = encode_run_dir(run_dir, checker, info=einfo)
+        if seg_name is not None:
+            from . import shm
+            payload = shm.export(enc, seg_name, checker)
+        else:
+            payload = enc
+    except Exception as e:
+        payload = e
+    return idx, payload, einfo, t0, time.monotonic()
 
 
 def _load_worker(run_dir):
@@ -209,8 +255,22 @@ def iter_encode_chunks(run_dirs: Sequence[str | os.PathLike],
     workers actually ran, and info["parse_spans"] filled with each
     worker parse's (start, end) wall-clock pair — intersect those with
     the caller's own device-dispatch spans (`overlap_seconds`) for a
-    measured, not inferred, pipeline-overlap number. Callers reporting
-    overlap must not claim pipelining for the strictly serial path."""
+    measured, not inferred, pipeline-overlap number. Spans are
+    appended when their items are YIELDED (not when the pool delivers
+    them), so a mid-stream pool failure can never leave spans for
+    items the caller never saw — the measured overlap only ever counts
+    parses whose results reached the device loop. Callers reporting
+    overlap must not claim pipelining for the strictly serial path.
+
+    Transport: pool results ride shared memory (jepsen_tpu.shm) —
+    workers send only (name, offset, shape, dtype) descriptors and the
+    parent wraps zero-copy views over the same pages — unless
+    JEPSEN_TPU_SHM_INGEST=0 or /dev/shm is unusable, in which case the
+    arrays are pickled per item exactly as before. Either way results
+    arrive via imap_unordered and a reorder buffer restores run-dir
+    order per chunk, so one slow run dir delays only its own chunk
+    instead of head-of-line-blocking every later worker's delivery
+    (`reorder_depth` gauge = the deepest the buffer got)."""
     dirs = list(run_dirs)
     if info is not None:
         info["pooled"] = False
@@ -235,37 +295,71 @@ def iter_encode_chunks(run_dirs: Sequence[str | os.PathLike],
     done = 0   # dirs fully yielded: a mid-stream pool failure resumes
     #            serially from here instead of double-yielding
     if processes and processes > 0 and len(dirs) > 1 and _spawn_safe():
+        from . import shm, trace
+        use_shm = shm.enabled() and shm.available()
+        names = [shm.gen_name() if use_shm else None for _ in dirs]
+        consumed = [name is None for name in names]
         ctx = mp.get_context("spawn")
         try:
             with ctx.Pool(processes=processes) as pool:
                 if info is not None:
                     info["pooled"] = True
-                it = pool.imap(_timed_worker,
-                               [(d, checker) for d in dirs],
-                               chunksize=max(1, min(chunk // 4, 16)))
-                buf = []
-                from . import trace
                 tr = trace.get_current()
-                for d, (enc, t0, t1) in zip(dirs, it):
-                    if info is not None:
-                        info["parse_spans"].append((t0, t1))
+                it = pool.imap_unordered(
+                    _stream_worker,
+                    [(i, d, checker, names[i])
+                     for i, d in enumerate(dirs)],
+                    chunksize=1)
+                pending: dict = {}   # idx -> ((dir, enc), span)
+                frontier = 0         # next idx to yield
+                buf, span_buf = [], []
+                for idx, payload, einfo, t0, t1 in it:
+                    if shm.is_descriptor(payload):
+                        tr.counter("shm_bytes").inc(payload["nbytes"])
+                        payload = shm.materialize(payload)
+                    consumed[idx] = True
+                    if einfo.get("cache") == "hit":
+                        tr.counter("cache_hits").inc()
+                    elif einfo.get("cache") == "miss":
+                        tr.counter("cache_misses").inc()
                     # the worker's parse window lands on its own trace
                     # track (monotonic spans; the tracer converts), so
                     # trace.json shows parse/device overlap directly
                     tr.add_span("parse", t0, t1, track="ingest-pool",
                                 clock="monotonic")
-                    buf.append((d, enc))
-                    if len(buf) >= chunk:
-                        yield buf
-                        done += len(buf)
-                        buf = []
+                    pending[idx] = ((dirs[idx], payload), (t0, t1))
+                    if len(pending) > 1:
+                        g = tr.gauge("reorder_depth")
+                        g.set(max(getattr(g, "value", 0) or 0,
+                                  len(pending)))
+                    while frontier in pending:
+                        item, span = pending.pop(frontier)
+                        buf.append(item)
+                        span_buf.append(span)
+                        frontier += 1
+                        if len(buf) >= chunk:
+                            if info is not None:
+                                info["parse_spans"].extend(span_buf)
+                            yield buf
+                            done += len(buf)
+                            buf, span_buf = [], []
                 if buf:
+                    if info is not None:
+                        info["parse_spans"].extend(span_buf)
                     yield buf
                     done += len(buf)
                 return
         except Exception:
             log.warning("pipelined encode pool failed; falling back "
                         "to serial", exc_info=True)
+        finally:
+            # Exception-path sweep: any segment a worker created but
+            # the parent never mapped must not outlive the pool. The
+            # happy path unlinks at materialize time, so this only
+            # fires for crashed/abandoned items.
+            for name, ok in zip(names, consumed):
+                if not ok:
+                    shm.unlink_stale(name)
     for i in range(done, len(dirs), chunk):
         yield [(d, _worker((d, checker)))
                for d in dirs[i:i + chunk]]

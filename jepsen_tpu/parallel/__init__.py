@@ -19,6 +19,9 @@ from __future__ import annotations
 
 import functools
 import math
+import os
+import queue as _queue
+import threading
 import time
 from typing import Sequence
 
@@ -323,6 +326,74 @@ class PendingVerdicts:
         return out  # type: ignore[return-value]
 
 
+def pack_thread_enabled() -> bool:
+    """One home for the JEPSEN_TPU_PACK_THREAD gate (default on):
+    check_bucketed_async moves bucket packing + device_put onto a
+    dedicated worker thread so the parent's critical path is only the
+    async kernel enqueue and h2d overlaps device compute. 0 keeps
+    everything inline on the calling thread."""
+    return os.environ.get("JEPSEN_TPU_PACK_THREAD", "1") != "0"
+
+
+def _est_cells(encs: Sequence, bucket: list[int], dp: int) -> int:
+    """The padded footprint bucket_by_length budgeted for this bucket
+    (B rounded to a dp multiple x T_pad²) — computable before packing,
+    so the dispatcher can spot buckets that exceed the per-slot budget
+    (only possible for a single history too big to subdivide)."""
+    tpad = max(K.pad_to(max(_size_of(encs[i]) for i in bucket), 128), 1)
+    return -(-len(bucket) // dp) * dp * tpad * tpad
+
+
+def _prep_bucket(encs: Sequence, bucket: list[int], mesh: Mesh | None,
+                 dp: int, budget_cells: int, tr,
+                 phases: dict | None) -> tuple:
+    """Host-side packing of one bucket (pack phase): group selection,
+    dp-replica padding, BatchShape planning and tensor packing. Runs on
+    the packer thread when pack_thread_enabled(), inline otherwise —
+    the tracer span lands on whichever thread did the work (its own
+    track in trace.json)."""
+    t0 = time.perf_counter()
+    group = [encs[i] for i in bucket]
+    bucket_mesh = mesh
+    if mesh is not None:
+        # Pad ragged buckets to a dp multiple by replicating the
+        # last history (results dropped at collect) so the dispatch
+        # still shards across the mesh instead of falling to one
+        # device — unless the padding itself would blow the budget
+        # (a single history bigger than budget/dp), in which case
+        # dispatch unsharded rather than 8x over budget.
+        tpad = max(K.pad_to(max(e.n for e in group), 128), 1)
+        padded = pad_to_multiple(group, dp)
+        if len(padded) * tpad * tpad <= budget_cells:
+            group = padded
+        else:
+            bucket_mesh = None
+    shape = K.BatchShape.plan(group)
+    packed = K.pack_batch(group, shape)
+    if tr.enabled:
+        # padding waste this dispatch pays: B_pad·T_pad² minus the
+        # ORIGINAL bucket's own cells, so dp-replica padding (group
+        # may hold replicated histories) counts as waste too
+        cells = len(group) * shape.n_txns * shape.n_txns
+        tr.counter("pad_waste_cells").inc(
+            cells - sum(max(_size_of(encs[i]), 1) ** 2 for i in bucket))
+        # per-dispatch device-resident footprint, in closure cells —
+        # the HBM-envelope invariant (max over dispatches x
+        # max_inflight <= budget_cells) is asserted against this
+        tr.histogram("bucket_cells").observe(cells)
+    _acc_phase(phases, "pack", t0)
+    return bucket, bucket_mesh, shape, packed
+
+
+def _h2d_bucket(item: tuple, phases: dict | None) -> tuple:
+    """device_put / sharding of one packed bucket (h2d phase)."""
+    bucket, bucket_mesh, shape, packed = item
+    t0 = time.perf_counter()
+    args = shard_batch(bucket_mesh, packed)
+    _acc_phase(phases, "h2d", t0)
+    return bucket, bucket_mesh, shape, args
+
+
 def check_bucketed_async(encs: Sequence, mesh: Mesh | None = None, *,
                          classify: bool = True, realtime: bool = False,
                          process_order: bool = False,
@@ -338,11 +409,32 @@ def check_bucketed_async(encs: Sequence, mesh: Mesh | None = None, *,
 
     `max_inflight` bounds how many buckets' packed tensors are resident
     at once: once more than that many dispatches are outstanding, the
-    oldest is resolved to host flags before the next bucket packs —
+    oldest is resolved to host flags before the next bucket transfers —
     host packing far outruns the O(T^3) closure, so an unbounded queue
-    would accumulate every bucket's ~budget_cells input tensors in
-    device/host memory (exactly what budget_cells exists to prevent).
-    Double-buffering only needs depth 2.
+    would accumulate every bucket's input tensors in device/host memory
+    (exactly what budget_cells exists to prevent). Double-buffering
+    only needs depth 2.
+
+    HBM envelope: `budget_cells` bounds the TOTAL device-resident
+    footprint, not one bucket's — the bucketer is therefore sized at
+    budget_cells // max_inflight per bucket, so max_inflight resident
+    buckets can never exceed the envelope the caller budgeted
+    (ROADMAP's PR-1 open item, resolved on the halve-the-bucket side:
+    the sync wrapper keeps its depth-2 pipelining and the footprint
+    guarantee instead of giving up the overlap with max_inflight=1).
+    A single history too long to fit the per-slot budget can't be
+    subdivided; such singleton buckets are dispatched LAST and strictly
+    alone (everything else resolved first, nothing pipelined next to
+    them), so the envelope degrades to one such history's own
+    unavoidable footprint, never that plus a pipeline's worth.
+
+    With pack_thread_enabled() (default) a dedicated "pack-h2d" thread
+    packs bucket N+1 and device_puts it while the calling thread
+    dispatches/collects bucket N, so the parent's critical path is
+    only the async kernel enqueue and the h2d copy overlaps device
+    compute; a Semaphore caps packed-and-transferred-but-unresolved
+    buckets at max_inflight so the thread can never outrun the
+    envelope.
 
     `phases` (optional dict) accumulates per-phase host wall-clock:
     "pack" (bucket planning + host tensor packing), "h2d" (device_put /
@@ -351,59 +443,112 @@ def check_bucketed_async(encs: Sequence, mesh: Mesh | None = None, *,
     flag rendering)."""
     parts: list = []
     inflight: list[int] = []    # indices into parts, oldest first
+    depth = max(1, max_inflight)
     dp = mesh.devices.shape[0] if mesh is not None else 1
     tr = trace.get_current()
     t0 = time.perf_counter()
-    buckets = bucket_by_length(encs, budget_cells=budget_cells, dp=dp)
+    eff_budget = max(1, budget_cells // depth)
+    buckets = bucket_by_length(encs, budget_cells=eff_budget, dp=dp)
+    # Singleton buckets whose one history alone exceeds the per-slot
+    # budget cannot honor depth-sharing: peel them off to dispatch
+    # strictly alone after the pipelined buckets drain.
+    oversized = [b for b in buckets
+                 if _est_cells(encs, b, dp) > eff_budget]
+    buckets = [b for b in buckets
+               if _est_cells(encs, b, dp) <= eff_budget]
     _acc_phase(phases, "pack", t0)
-    for bucket in buckets:
-        while len(inflight) >= max(1, max_inflight):
-            j = inflight.pop(0)
-            t0 = time.perf_counter()
-            idx, flags, t_disp = parts[j]
-            parts[j] = (idx, np.asarray(jax.block_until_ready(flags)),
-                        None)
-            tr.device_complete("bucket", t_disp, histories=len(idx))
-            tr.gauge("inflight_depth").set(len(inflight))
-            _acc_phase(phases, "collect", t0)
+
+    def resolve_oldest():
+        j = inflight.pop(0)
         t0 = time.perf_counter()
-        group = [encs[i] for i in bucket]
-        bucket_mesh = mesh
-        if mesh is not None:
-            # Pad ragged buckets to a dp multiple by replicating the
-            # last history (results dropped at collect) so the dispatch
-            # still shards across the mesh instead of falling to one
-            # device — unless the padding itself would blow the budget
-            # (a single history bigger than budget/dp), in which case
-            # dispatch unsharded rather than 8x over budget.
-            tpad = max(K.pad_to(max(e.n for e in group), 128), 1)
-            padded = pad_to_multiple(group, dp)
-            if len(padded) * tpad * tpad <= budget_cells:
-                group = padded
-            else:
-                bucket_mesh = None
-        shape = K.BatchShape.plan(group)
-        packed = K.pack_batch(group, shape)
-        if tr.enabled:
-            # padding waste this dispatch pays: B_pad·T_pad² minus the
-            # ORIGINAL bucket's own cells, so dp-replica padding (group
-            # may hold replicated histories) counts as waste too
-            tr.counter("pad_waste_cells").inc(
-                len(group) * shape.n_txns * shape.n_txns
-                - sum(max(_size_of(encs[i]), 1) ** 2 for i in bucket))
-        _acc_phase(phases, "pack", t0)
+        idx, flags, t_disp = parts[j]
+        parts[j] = (idx, np.asarray(jax.block_until_ready(flags)),
+                    None)
+        tr.device_complete("bucket", t_disp, histories=len(idx))
+        tr.gauge("inflight_depth").set(len(inflight))
+        _acc_phase(phases, "collect", t0)
+
+    def dispatch(item):
+        bucket, bucket_mesh, shape, args = item
         t0 = time.perf_counter()
         fn = sharded_check_fn(bucket_mesh, shape, classify=classify,
                               realtime=realtime,
                               process_order=process_order, fused=fused)
-        args = shard_batch(bucket_mesh, packed)
-        _acc_phase(phases, "h2d", t0)
-        t0 = time.perf_counter()
         parts.append((bucket, fn(*args), time.perf_counter()))
         inflight.append(len(parts) - 1)
         tr.counter("buckets_dispatched").inc()
         tr.gauge("inflight_depth").set(len(inflight))
         _acc_phase(phases, "dispatch", t0)
+
+    if pack_thread_enabled() and len(buckets) > 1:
+        # Staged pipeline: the packer thread owns pack + h2d; `sem`
+        # counts device-resident buckets (transferred, not yet
+        # resolved) so pack can run one bucket ahead while h2d waits
+        # for an envelope slot.
+        out: _queue.Queue = _queue.Queue()
+        sem = threading.Semaphore(depth)
+        stop = threading.Event()
+        _DONE = object()
+
+        def producer():
+            try:
+                for b in buckets:
+                    item = _prep_bucket(encs, b, mesh, dp, eff_budget,
+                                        tr, phases)
+                    sem.acquire()
+                    if stop.is_set():
+                        return
+                    out.put(_h2d_bucket(item, phases))
+                out.put(_DONE)
+            except BaseException as e:   # surfaced on the caller
+                out.put(e)
+
+        th = threading.Thread(target=producer, name="pack-h2d",
+                              daemon=True)
+        th.start()
+        try:
+            while True:
+                # a main-thread stall on the producer is its own phase
+                # ("feed"): with pack/h2d accruing on their own thread,
+                # the main thread's wall clock partitions into
+                # feed/dispatch/collect instead
+                t0 = time.perf_counter()
+                item = out.get()
+                _acc_phase(phases, "feed", t0)
+                if item is _DONE:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                dispatch(item)
+                # release an envelope slot as soon as the pipeline is
+                # full: the producer's h2d for bucket N+depth waits on
+                # this resolve, which itself overlaps bucket N+1's
+                # compute
+                if len(inflight) >= depth:
+                    resolve_oldest()
+                    sem.release()
+        finally:
+            stop.set()
+            for _ in buckets:   # unblock a producer parked on sem
+                sem.release()
+            th.join(timeout=30)
+    else:
+        for bucket in buckets:
+            while len(inflight) >= depth:
+                resolve_oldest()
+            item = _prep_bucket(encs, bucket, mesh, dp, eff_budget,
+                                tr, phases)
+            dispatch(_h2d_bucket(item, phases))
+    for bucket in oversized:
+        # strictly-alone dispatch: drain EVERYTHING first so this
+        # history's unavoidable footprint is the only thing resident
+        # (the mesh-padding check may use the full budget — nothing
+        # shares the envelope with it)
+        while inflight:
+            resolve_oldest()
+        item = _prep_bucket(encs, bucket, mesh, dp, budget_cells,
+                            tr, phases)
+        dispatch(_h2d_bucket(item, phases))
     return PendingVerdicts(len(encs), parts)
 
 

@@ -288,6 +288,356 @@ class Store:
             shutil.rmtree(target)
 
 
+# ---------------------------------------------------------------------------
+# Persistent encoded cache: encoded.v1.bin sidecars.
+#
+# Re-analysis sweeps (analyze-store --resume, repeated benches, CI) pay
+# the full history parse every time even though a run dir's history is
+# immutable once written. Each successful lean encode therefore leaves
+# a flat binary sidecar next to history.jsonl — tensors laid out raw so
+# a warm sweep mmaps them back as zero-copy numpy views, skipping
+# json/dict parsing entirely. The cache key is the history file's
+# (size, mtime_ns, xxh64-over-first+last-64KiB): any byte growth,
+# rewrite, or touch invalidates (the sidecar is then ignored and
+# overwritten on the next encode). The native encoder
+# (native/hist_encode.cc, jt_ha_write_sidecar) writes the SAME layout
+# straight from its own buffers, so the C++ fast path never
+# round-trips through Python to populate the cache.
+# ---------------------------------------------------------------------------
+
+ENCODED_MAGIC = b"JTENC01\n"
+
+# Per-checker array fields of a lean encoding, in canonical layout
+# order — the ONE list the shm transport (jepsen_tpu/shm.py) and the
+# sidecar writer below both serialize and both rebuild from (the C++
+# sidecar writer mirrors it in hist_encode.cc's write_sidecar).
+ENCODED_FIELDS = {
+    "append": ("appends", "reads", "status", "process",
+               "invoke_index", "complete_index"),
+    "wr": ("edges", "status", "process", "invoke_index",
+           "complete_index"),
+}
+
+
+def encoded_arrays(enc, checker: str) -> list:
+    """[(field, contiguous ndarray)] for a lean encoding, in
+    ENCODED_FIELDS order (WrEncoded.edges — a list of 3-tuples — is
+    densified to int32 [E,3])."""
+    import numpy as np
+    out = []
+    for f in ENCODED_FIELDS[checker]:
+        v = getattr(enc, f)
+        if f == "edges":
+            v = np.asarray(v or np.zeros((0, 3)),
+                           np.int32).reshape(-1, 3)
+        out.append((f, np.ascontiguousarray(v)))
+    return out
+
+
+def rebuild_encoded(checker: str, arrays: dict, meta: dict):
+    """The single (arrays + scalars) -> EncodedHistory/WrEncoded
+    reconstruction, shared by the shm transport's materialize and the
+    sidecar cache loader — one place owns the op_index aliasing and
+    the edges re-tupling, so the two zero-copy paths can't drift."""
+    if checker == "wr":
+        from .checker.elle.wr import WrEncoded
+        enc = WrEncoded()
+        enc.n = int(meta["n"])
+        enc.key_count = int(meta["key_count"])
+        enc.edges = [tuple(r) for r in arrays["edges"].tolist()]
+    else:
+        from .checker.elle.encode import EncodedHistory
+        enc = EncodedHistory()
+        enc.n = int(meta["n"])
+        enc.n_keys = int(meta["n_keys"])
+        enc.max_pos = int(meta["max_pos"])
+        enc.key_names = meta["key_names"]
+        enc.appends = arrays["appends"]
+        enc.reads = arrays["reads"]
+        enc.op_index = arrays["complete_index"]
+    enc.status = arrays["status"]
+    enc.process = arrays["process"]
+    enc.invoke_index = arrays["invoke_index"]
+    enc.complete_index = arrays["complete_index"]
+    enc.anomalies = meta["anomalies"]
+    enc.txn_ops = []
+    return enc
+
+# Bounded content hash: first + last 64KiB (whole file when smaller).
+# Histories are append-only artifacts — corruption or rewrite shows up
+# at one end — and an unbounded hash would put a full file read back on
+# the path the cache exists to remove.
+_HASH_SPAN = 64 * 1024
+
+_X1 = 0x9E3779B185EBCA87
+_X2 = 0xC2B2AE3D27D4EB4F
+_X3 = 0x165667B19E3779F9
+_X4 = 0x85EBCA77C2B2AE63
+_X5 = 0x27D4EB2F165667C5
+_M64 = (1 << 64) - 1
+
+
+def _rotl(x: int, r: int) -> int:
+    return ((x << r) | (x >> (64 - r))) & _M64
+
+
+def xxh64(data: bytes, seed: int = 0) -> int:
+    """Pure-Python XXH64 (bit-exact with the reference algorithm and
+    with native/hist_encode.cc's jt_xxh64_buf — parity is
+    differentially tested). This is the FALLBACK and parity oracle:
+    _buf_xxh64 routes cache keying through the native hasher when the
+    .so is loaded (the Python loop costs ~30ms per 128KiB window —
+    real money on the warm path this hash gates)."""
+    n = len(data)
+    i = 0
+    if n >= 32:
+        v1 = (seed + _X1 + _X2) & _M64
+        v2 = (seed + _X2) & _M64
+        v3 = seed & _M64
+        v4 = (seed - _X1) & _M64
+        while i + 32 <= n:
+            for off, v in ((0, v1), (8, v2), (16, v3), (24, v4)):
+                lane = int.from_bytes(data[i + off:i + off + 8],
+                                      "little")
+                v = (_rotl((v + lane * _X2) & _M64, 31) * _X1) & _M64
+                if off == 0:
+                    v1 = v
+                elif off == 8:
+                    v2 = v
+                elif off == 16:
+                    v3 = v
+                else:
+                    v4 = v
+            i += 32
+        h = (_rotl(v1, 1) + _rotl(v2, 7) + _rotl(v3, 12)
+             + _rotl(v4, 18)) & _M64
+        for v in (v1, v2, v3, v4):
+            h ^= (_rotl((v * _X2) & _M64, 31) * _X1) & _M64
+            h = (h * _X1 + _X4) & _M64
+    else:
+        h = (seed + _X5) & _M64
+    h = (h + n) & _M64
+    while i + 8 <= n:
+        k = (int.from_bytes(data[i:i + 8], "little") * _X2) & _M64
+        h ^= (_rotl(k, 31) * _X1) & _M64
+        h = (_rotl(h, 27) * _X1 + _X4) & _M64
+        i += 8
+    if i + 4 <= n:
+        h ^= (int.from_bytes(data[i:i + 4], "little") * _X1) & _M64
+        h = (_rotl(h, 23) * _X2 + _X3) & _M64
+        i += 4
+    while i < n:
+        h ^= (data[i] * _X5) & _M64
+        h = (_rotl(h, 11) * _X1) & _M64
+        i += 1
+    h ^= h >> 33
+    h = (h * _X2) & _M64
+    h ^= h >> 29
+    h = (h * _X3) & _M64
+    h ^= h >> 32
+    return h
+
+
+def _buf_xxh64(data: bytes) -> int:
+    """XXH64 via the native library when loaded (one C call), pure
+    Python otherwise — both bit-identical, so cache keys don't depend
+    on which side hashed."""
+    try:
+        from . import native_lib
+        L = native_lib.hist_lib()
+        if L is not None:
+            return L.jt_xxh64_buf(data, len(data), 0)
+    except Exception:
+        pass
+    return xxh64(data)
+
+
+def bounded_file_xxh64(path: Path, size: int) -> int:
+    """xxh64 over the first + last _HASH_SPAN bytes (whole file when
+    it fits in one window pair) — the content part of the cache key.
+    Must stay byte-identical to the C++ side's file_cache_key()."""
+    with open(path, "rb") as f:
+        if size <= 2 * _HASH_SPAN:
+            data = f.read()
+        else:
+            head = f.read(_HASH_SPAN)
+            f.seek(size - _HASH_SPAN)
+            data = head + f.read(_HASH_SPAN)
+    return _buf_xxh64(data)
+
+
+def encode_cache_enabled() -> bool:
+    """The JEPSEN_TPU_ENCODE_CACHE master gate (default on)."""
+    return os.environ.get("JEPSEN_TPU_ENCODE_CACHE", "1") != "0"
+
+
+def encode_cache_write_enabled() -> bool:
+    """JEPSEN_TPU_ENCODE_CACHE_WRITE=0 makes the cache read-only
+    (e.g. sweeping a store on a read-only mount)."""
+    return os.environ.get("JEPSEN_TPU_ENCODE_CACHE_WRITE", "1") != "0"
+
+
+def encoded_cache_path(run_dir: str | os.PathLike, checker: str) -> Path:
+    """The per-checker sidecar path: append and wr digests of the same
+    history are different tensors, so they cache separately."""
+    name = "encoded.v1.bin" if checker == "append" \
+        else f"encoded-{checker}.v1.bin"
+    return Path(run_dir) / name
+
+
+def _history_source(run_dir: Path) -> Path | None:
+    """The file the cache key covers — the same preference order as
+    load_history_dir, so the cache can never validate against a file
+    the encode wouldn't have read."""
+    jl = run_dir / "history.jsonl"
+    if jl.is_file():
+        return jl
+    ed = run_dir / "history.edn"
+    return ed if ed.is_file() else None
+
+
+def _cache_key(src: Path) -> dict:
+    st = src.stat()
+    return {"size": st.st_size, "mtime_ns": st.st_mtime_ns,
+            "xxh64": f"{bounded_file_xxh64(src, st.st_size):016x}"}
+
+
+def _align64(n: int) -> int:
+    return (n + 63) & ~63
+
+
+def save_encoded(run_dir: str | os.PathLike, checker: str,
+                 enc) -> Path | None:
+    """Write the flat encoded sidecar for a LEAN encoding. Best-effort:
+    any failure (non-JSON-able keys, read-only dir) returns None and
+    the run simply stays uncached. Layout — magic, int64 header length,
+    JSON header, zero pad to 64, then each tensor raw at the
+    64-aligned offset its header entry records (relative to the data
+    start, itself align64(16 + header_len))."""
+    if not (encode_cache_enabled() and encode_cache_write_enabled()):
+        return None
+    d = Path(run_dir)
+    src = _history_source(d)
+    if src is None:
+        return None
+    tmp = None
+    try:
+        arrays = encoded_arrays(enc, checker)
+        if checker == "wr":
+            meta = {"n": enc.n, "key_count": enc.key_count}
+        else:
+            meta = {"n": enc.n, "n_keys": enc.n_keys,
+                    "max_pos": enc.max_pos,
+                    "key_names": list(enc.key_names)}
+        off = 0
+        entries = {}
+        for name, a in arrays:
+            off = _align64(off)
+            entries[name] = [off, list(a.shape), a.dtype.str]
+            off += a.nbytes
+        header = {"v": 1, "checker": checker, "src": src.name,
+                  "key": _cache_key(src), "arrays": entries,
+                  "anomalies": enc.anomalies, **meta}
+        hj = json.dumps(header).encode()
+        data_start = _align64(len(ENCODED_MAGIC) + 8 + len(hj))
+        out = encoded_cache_path(d, checker)
+        tmp = out.with_name(f".{out.name}.{os.getpid()}.tmp")
+        with open(tmp, "wb") as f:
+            f.write(ENCODED_MAGIC)
+            f.write(len(hj).to_bytes(8, "little"))
+            f.write(hj)
+            f.write(b"\0" * (data_start - len(ENCODED_MAGIC) - 8
+                             - len(hj)))
+            pos = 0
+            for name, a in arrays:
+                aligned = _align64(pos)
+                f.write(b"\0" * (aligned - pos))
+                f.write(memoryview(a).cast("B") if a.nbytes else b"")
+                pos = aligned + a.nbytes
+        os.replace(tmp, out)
+        return out
+    except Exception:
+        log.debug("encoded-cache write failed for %s", d, exc_info=True)
+        try:
+            if tmp is not None:
+                tmp.unlink(missing_ok=True)
+        except Exception:
+            pass
+        return None
+
+
+def load_encoded(run_dir: str | os.PathLike, checker: str):
+    """mmap the encoded sidecar back into an EncodedHistory/WrEncoded
+    (zero-copy views over the mapped pages), or None on miss: no
+    sidecar, stale key (history changed), wrong checker, or any parse
+    failure. Handles both writer dialects — the Python writer embeds
+    lean anomalies as JSON; the native writer stores raw anomaly rows
+    + the pre-key name table, decoded here with the exact `_witness`
+    mapping the in-process native path uses."""
+    if not encode_cache_enabled():
+        return None
+    d = Path(run_dir)
+    p = encoded_cache_path(d, checker)
+    if not p.is_file():
+        return None
+    try:
+        import mmap as _mmap
+
+        import numpy as np
+        src = _history_source(d)
+        if src is None:
+            return None
+        with open(p, "rb") as f:
+            mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+        if mm[:len(ENCODED_MAGIC)] != ENCODED_MAGIC:
+            return None
+        hlen = int.from_bytes(
+            mm[len(ENCODED_MAGIC):len(ENCODED_MAGIC) + 8], "little")
+        header = json.loads(
+            mm[len(ENCODED_MAGIC) + 8:len(ENCODED_MAGIC) + 8 + hlen])
+        if header.get("v") != 1 or header.get("checker") != checker \
+                or header.get("src") != src.name:
+            return None
+        if header.get("key") != _cache_key(src):
+            return None
+        data_start = _align64(len(ENCODED_MAGIC) + 8 + hlen)
+        arrays = {}
+        for name, (off, shape, dt) in header["arrays"].items():
+            n = 1
+            for s in shape:
+                n *= s
+            arrays[name] = np.frombuffer(
+                mm, dtype=np.dtype(dt), count=n,
+                offset=data_start + off).reshape(shape)
+        pre_names = header.get("pre_names", [])
+        if "anomalies" in header:
+            anomalies = header["anomalies"]
+        else:
+            # native-written sidecar: raw anomaly rows, decoded with
+            # the exact _witness mapping the in-process native path
+            # uses, so cache-loaded == freshly-encoded
+            from .checker.elle.native_encode import _CODES, _witness
+            anomalies = {}
+            for code, f0, f1, f2, f3 in arrays.pop("anom").tolist():
+                name = _CODES.get(int(code))
+                if name is None:    # ABI drift: don't guess
+                    return None
+                anomalies.setdefault(name, []).append(
+                    _witness(int(code), int(f0), int(f1), int(f2),
+                             int(f3), pre_names, wr=checker == "wr"))
+        meta = {k: header[k] for k in ("n", "n_keys", "max_pos",
+                                       "key_count") if k in header}
+        meta["anomalies"] = anomalies
+        if checker != "wr":
+            meta["key_names"] = header["key_names"] \
+                if "key_names" in header else \
+                [pre_names[i] for i in arrays.pop("kid_to_pre").tolist()]
+        return rebuild_encoded(checker, arrays, meta)
+    except Exception:
+        log.debug("encoded-cache load failed for %s", p, exc_info=True)
+        return None
+
+
 def _results_to_edn(v: Any) -> Any:
     """Convert a results dict (string keys) to EDN with keyword keys."""
     if isinstance(v, dict):

@@ -95,6 +95,9 @@
 #include <memory>
 #include <array>
 
+#include <sys/stat.h>
+#include <unistd.h>
+
 namespace {
 
 // ---------------------------------------------------------------- values
@@ -439,6 +442,7 @@ struct Handle {
   std::vector<int32_t> kid_to_pre;
   std::string pre_names_json;
   int64_t n = 0, n_keys = 0, max_pos = 0;
+  bool wr = false;                     // encode_wr() product
 };
 
 struct Encoder {
@@ -1318,6 +1322,7 @@ struct Encoder {
 
     auto h = std::make_unique<Handle>();
     h->n = n;
+    h->wr = true;
     auto note = [&](int64_t code, int64_t f0, int64_t f1, int64_t f2,
                     int64_t f3 = 0) {
       note_row(h.get(), code, f0, f1, f2, f3);
@@ -1771,9 +1776,261 @@ struct Splitter {
 
 }  // namespace
 
+// ------------------------------------------------- encoded.v1 sidecar
+//
+// Flat persistent cache of one encode (jepsen_tpu/store.py's
+// save_encoded/load_encoded layout): magic "JTENC01\n", int64 LE
+// header length, JSON header, zero pad to 64, then each tensor raw at
+// the 64-aligned offset its header entry records (relative to
+// align64(16 + header_len)). The key is the history file's
+// (size, mtime_ns, xxh64 over first+last 64KiB) — identical to the
+// Python side's bounded_file_xxh64, so either writer's sidecar
+// validates under either reader. Anomalies are stored as raw
+// (code,f0..f3) rows + the pre-key name table; the Python loader
+// rebuilds lean witnesses with the same _witness mapping the
+// in-process native path uses, so cache-loaded and freshly-encoded
+// anomalies are identical by construction.
+
+static constexpr uint64_t XP1 = 0x9E3779B185EBCA87ULL;
+static constexpr uint64_t XP2 = 0xC2B2AE3D27D4EB4FULL;
+static constexpr uint64_t XP3 = 0x165667B19E3779F9ULL;
+static constexpr uint64_t XP4 = 0x85EBCA77C2B2AE63ULL;
+static constexpr uint64_t XP5 = 0x27D4EB2F165667C5ULL;
+
+static inline uint64_t xrotl(uint64_t x, int r) {
+  return (x << r) | (x >> (64 - r));
+}
+
+static inline uint64_t xread64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;   // little-endian hosts only (same as the tensor ABI)
+}
+
+static inline uint64_t xread32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+uint64_t xxh64(const uint8_t* p, size_t n, uint64_t seed) {
+  const uint8_t* end = p + n;
+  uint64_t h;
+  if (n >= 32) {
+    uint64_t v1 = seed + XP1 + XP2, v2 = seed + XP2, v3 = seed,
+             v4 = seed - XP1;
+    const uint8_t* lim = end - 32;
+    do {
+      v1 = xrotl(v1 + xread64(p) * XP2, 31) * XP1; p += 8;
+      v2 = xrotl(v2 + xread64(p) * XP2, 31) * XP1; p += 8;
+      v3 = xrotl(v3 + xread64(p) * XP2, 31) * XP1; p += 8;
+      v4 = xrotl(v4 + xread64(p) * XP2, 31) * XP1; p += 8;
+    } while (p <= lim);
+    h = xrotl(v1, 1) + xrotl(v2, 7) + xrotl(v3, 12) + xrotl(v4, 18);
+    for (uint64_t v : {v1, v2, v3, v4})
+      h = (h ^ (xrotl(v * XP2, 31) * XP1)) * XP1 + XP4;
+  } else {
+    h = seed + XP5;
+  }
+  h += (uint64_t)n;
+  while (p + 8 <= end) {
+    h ^= xrotl(xread64(p) * XP2, 31) * XP1;
+    h = xrotl(h, 27) * XP1 + XP4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    h ^= xread32(p) * XP1;
+    h = xrotl(h, 23) * XP2 + XP3;
+    p += 4;
+  }
+  while (p < end) {
+    h ^= (*p++) * XP5;
+    h = xrotl(h, 11) * XP1;
+  }
+  h ^= h >> 33; h *= XP2;
+  h ^= h >> 29; h *= XP3;
+  h ^= h >> 32;
+  return h;
+}
+
+static constexpr int64_t HASH_SPAN = 64 * 1024;  // store.py's _HASH_SPAN
+
+// (size, mtime_ns, bounded xxh64) of one file; false if unreadable.
+static bool file_cache_key(const char* path, int64_t& size,
+                           int64_t& mtime_ns, uint64_t& hash) {
+  struct stat st;
+  if (stat(path, &st) != 0) return false;
+  size = (int64_t)st.st_size;
+  mtime_ns = (int64_t)st.st_mtim.tv_sec * 1000000000LL
+      + (int64_t)st.st_mtim.tv_nsec;
+  FILE* f = fopen(path, "rb");
+  if (!f) return false;
+  std::vector<uint8_t> buf;
+  bool ok = true;
+  if (size <= 2 * HASH_SPAN) {
+    buf.resize((size_t)size);
+    ok = size == 0 || fread(buf.data(), 1, (size_t)size, f)
+        == (size_t)size;
+  } else {
+    buf.resize((size_t)(2 * HASH_SPAN));
+    ok = fread(buf.data(), 1, (size_t)HASH_SPAN, f)
+        == (size_t)HASH_SPAN
+        && fseek(f, (long)(size - HASH_SPAN), SEEK_SET) == 0
+        && fread(buf.data() + HASH_SPAN, 1, (size_t)HASH_SPAN, f)
+        == (size_t)HASH_SPAN;
+  }
+  fclose(f);
+  if (!ok) return false;
+  hash = xxh64(buf.data(), buf.size(), 0);
+  return true;
+}
+
+static inline int64_t align64(int64_t n) { return (n + 63) & ~63LL; }
+
+struct SidecarArray {
+  const char* name;
+  const void* data;
+  int64_t rows, cols;      // cols 0 => 1-D [rows]
+  int elem;                // bytes per element (4 or 8)
+};
+
+static void sc_entry(std::string& js, const SidecarArray& a,
+                     int64_t off) {
+  js += '"'; js += a.name; js += "\":[";
+  js += std::to_string(off);
+  js += ",[";
+  js += std::to_string(a.rows);
+  if (a.cols) { js += ','; js += std::to_string(a.cols); }
+  js += "],\"";
+  js += a.elem == 4 ? "<i4" : "<i8";
+  js += "\"]";
+}
+
+static bool write_sidecar(Handle* h, const char* hist_path,
+                          const char* out_path) {
+  int64_t size, mtime_ns;
+  uint64_t hash;
+  if (!file_cache_key(hist_path, size, mtime_ns, hash)) return false;
+  const char* base = strrchr(hist_path, '/');
+  base = base ? base + 1 : hist_path;
+
+  std::vector<SidecarArray> arrays;
+  if (h->wr) {
+    arrays.push_back({"edges", h->edges.data(),
+                      (int64_t)(h->edges.size() / 3), 3, 4});
+  } else {
+    arrays.push_back({"appends", h->appends.data(),
+                      (int64_t)(h->appends.size() / 3), 3, 4});
+    arrays.push_back({"reads", h->reads.data(),
+                      (int64_t)(h->reads.size() / 3), 3, 4});
+  }
+  arrays.push_back({"status", h->status.data(),
+                    (int64_t)h->status.size(), 0, 4});
+  arrays.push_back({"process", h->process.data(),
+                    (int64_t)h->process.size(), 0, 4});
+  arrays.push_back({"invoke_index", h->invoke_index.data(),
+                    (int64_t)h->invoke_index.size(), 0, 8});
+  arrays.push_back({"complete_index", h->complete_index.data(),
+                    (int64_t)h->complete_index.size(), 0, 8});
+  arrays.push_back({"anom", h->anomalies.data(),
+                    (int64_t)(h->anomalies.size() / 5), 5, 8});
+  if (!h->wr)
+    arrays.push_back({"kid_to_pre", h->kid_to_pre.data(),
+                      (int64_t)h->kid_to_pre.size(), 0, 4});
+
+  std::vector<int64_t> offs(arrays.size());
+  int64_t off = 0;
+  for (size_t i = 0; i < arrays.size(); ++i) {
+    off = align64(off);
+    offs[i] = off;
+    off += arrays[i].rows * (arrays[i].cols ? arrays[i].cols : 1)
+        * arrays[i].elem;
+  }
+
+  char keybuf[17];
+  snprintf(keybuf, sizeof keybuf, "%016llx",
+           (unsigned long long)hash);
+  std::string js = "{\"v\":1,\"checker\":\"";
+  js += h->wr ? "wr" : "append";
+  js += "\",\"src\":";
+  append_json_string(js, std::string(base));
+  js += ",\"key\":{\"size\":";
+  js += std::to_string(size);
+  js += ",\"mtime_ns\":";
+  js += std::to_string(mtime_ns);
+  js += ",\"xxh64\":\"";
+  js += keybuf;
+  js += "\"},\"arrays\":{";
+  for (size_t i = 0; i < arrays.size(); ++i) {
+    if (i) js += ',';
+    sc_entry(js, arrays[i], offs[i]);
+  }
+  js += "},\"pre_names\":";
+  js += h->pre_names_json.empty() ? "[]" : h->pre_names_json;
+  js += ",\"n\":";
+  js += std::to_string(h->n);
+  if (h->wr) {
+    js += ",\"key_count\":";
+    js += std::to_string(h->n_keys);
+  } else {
+    js += ",\"n_keys\":";
+    js += std::to_string(h->n_keys);
+    js += ",\"max_pos\":";
+    js += std::to_string(h->max_pos);
+  }
+  js += '}';
+
+  static const char MAGIC[8] = {'J', 'T', 'E', 'N', 'C', '0', '1',
+                                '\n'};
+  int64_t hlen = (int64_t)js.size();
+  int64_t data_start = align64(16 + hlen);
+
+  std::string tmp = std::string(out_path) + ".tmp."
+      + std::to_string((long long)getpid());
+  FILE* f = fopen(tmp.c_str(), "wb");
+  if (!f) return false;
+  static const char zeros[64] = {0};
+  bool ok = fwrite(MAGIC, 1, 8, f) == 8
+      && fwrite(&hlen, 8, 1, f) == 1
+      && fwrite(js.data(), 1, js.size(), f) == js.size()
+      && fwrite(zeros, 1, (size_t)(data_start - 16 - hlen), f)
+      == (size_t)(data_start - 16 - hlen);
+  int64_t pos = 0;
+  for (size_t i = 0; ok && i < arrays.size(); ++i) {
+    int64_t aligned = align64(pos);
+    if (aligned > pos)
+      ok = fwrite(zeros, 1, (size_t)(aligned - pos), f)
+          == (size_t)(aligned - pos);
+    int64_t nbytes = arrays[i].rows
+        * (arrays[i].cols ? arrays[i].cols : 1) * arrays[i].elem;
+    if (ok && nbytes)
+      ok = fwrite(arrays[i].data, 1, (size_t)nbytes, f)
+          == (size_t)nbytes;
+    pos = aligned + nbytes;
+  }
+  ok = (fclose(f) == 0) && ok;
+  if (!ok) { remove(tmp.c_str()); return false; }
+  if (rename(tmp.c_str(), out_path) != 0) {
+    remove(tmp.c_str());
+    return false;
+  }
+  return true;
+}
+
 extern "C" {
 
-int64_t jt_ha_abi_version() { return 3; }
+int64_t jt_ha_abi_version() { return 4; }
+
+uint64_t jt_xxh64_buf(const uint8_t* p, int64_t n, uint64_t seed) {
+  return xxh64(p, (size_t)n, seed);
+}
+
+// Write the encoded.v1 sidecar for `hp` straight from the handle's
+// buffers (no Python round-trip); 1 on success, 0 on any failure.
+int64_t jt_ha_write_sidecar(void* hp, const char* hist_path,
+                            const char* out_path) {
+  return write_sidecar((Handle*)hp, hist_path, out_path) ? 1 : 0;
+}
 
 void* jt_ha_encode_file(const char* path) {
   Encoder enc;

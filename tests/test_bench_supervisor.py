@@ -52,11 +52,17 @@ def test_supervisor_happy_path():
     ns = out["north_star"]
     assert ns["invalid_found"] >= 1
     # phase-attributed sweep: the per-phase fields must explain
-    # sweep_secs (sum within 10%), and overlap is ONE measured field
-    assert set(ns["phases"]) == {"parse", "pack", "h2d", "dispatch",
-                                 "collect", "render"}
-    assert abs(ns["phases_sum_secs"] - ns["sweep_secs"]) <= \
-        0.1 * ns["sweep_secs"] + 0.02, ns
+    # sweep_secs, and overlap is ONE measured field. With the pack-h2d
+    # thread (default), pack/h2d accrue on their own thread and may
+    # OVERLAP the main thread's phases, so the contract is
+    # directional: the main-thread phases can't exceed the wall clock,
+    # and the total (main + producer work) must still account for it.
+    assert set(ns["phases"]) == {"parse", "feed", "pack", "h2d",
+                                 "dispatch", "collect", "render"}
+    main_sum = sum(ns["phases"][k] for k in
+                   ("parse", "feed", "dispatch", "collect", "render"))
+    assert main_sum <= ns["sweep_secs"] * 1.1 + 0.02, ns
+    assert ns["phases_sum_secs"] >= ns["sweep_secs"] * 0.9 - 0.02, ns
     assert "pipeline_overlap_secs" in ns
     assert "pipeline_overlap" not in ns
     assert "pipeline_overlap_measured" not in ns
