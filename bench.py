@@ -792,7 +792,9 @@ def bench_north_star(n_dev: int, devices) -> dict:
         def _ctr(name: str) -> int:
             return getattr(_tr.counter(name), "value", 0) or 0
 
-        _CTRS = ("shm_bytes", "cache_hits", "cache_misses")
+        _CTRS = ("shm_bytes", "cache_hits", "cache_misses",
+                 "quarantined", "oom_retries", "bucket_splits",
+                 "watchdog_timeouts")
 
         def run_sweep() -> dict:
             """One streaming store->verdict sweep (analyze-store
@@ -1016,6 +1018,15 @@ def bench_north_star(n_dev: int, devices) -> dict:
             "shm_bytes": cold["counters"]["shm_bytes"],
             "cache": {"hits": cold["counters"]["cache_hits"],
                       "misses": cold["counters"]["cache_misses"]},
+            # supervisor activity during the timed sweep — all zeros
+            # on a healthy run (the bench injects no faults); nonzero
+            # means the hardware OOM'd/stalled and the published rate
+            # includes recovery work, which must be visible, not
+            # silently absorbed
+            "robustness": {k: cold["counters"][k]
+                           for k in ("quarantined", "oom_retries",
+                                     "bucket_splits",
+                                     "watchdog_timeouts")},
             # the second sweep over the same store: every run hits its
             # encoded.v1 sidecar (ingest ~ mmap + key check)
             "cache_warm": cache_warm,

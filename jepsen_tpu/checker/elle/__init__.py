@@ -21,8 +21,14 @@ from typing import Any, Iterable
 
 from .. import Checker
 from . import graph as g
-from . import kernels
 from .encode import EncodedHistory, encode_history
+
+# NOTE: `kernels` (the jax/device backend) is imported lazily where
+# used: this package init is on every ingest pool WORKER's bootstrap
+# path (spawn re-imports it per process to encode histories into
+# numpy tensors), and an eager jax import costs each worker ~2s of
+# pure interpreter startup it never uses — across a sweep's pool
+# that is more wall clock than the encoding itself.
 
 # Anomalies that invalidate a history regardless of requested level —
 # they indicate corrupted data structures, not isolation-level choices.
@@ -58,6 +64,7 @@ def cycle_anomalies_cpu(enc: EncodedHistory, realtime: bool = False,
 
 def cycle_anomalies_tpu(enc: EncodedHistory, realtime: bool = False,
                         process_order: bool = False) -> dict:
+    from . import kernels
     return kernels.check_encoded_batch(
         [enc], realtime=realtime, process_order=process_order)[0]
 
@@ -169,6 +176,12 @@ class AppendChecker(Checker):
         from . import artifacts
         out = []
         for enc, cycles in zip(encs, cycles_list):
+            if hasattr(cycles, "verdict"):
+                # a supervisor.Quarantined sentinel: the device sweep
+                # abandoned this history (OOM backdown exhausted /
+                # watchdog) — its validity is unknown, not a judgment
+                out.append(cycles.verdict())
+                continue
             divergent: dict = {}
             if cycles:
                 cycles, divergent = artifacts.device_host_refine(

@@ -565,6 +565,12 @@ def check_edge_batch(per_history: list[dict], realtime: bool = False,
         *args, steps=closure_steps(p["T"]), classify=classify,
         realtime=realtime, process_order=process_order,
         use_pallas=use_pallas, use_int8=use_int8, fused=fused)
+    # the np.asarray below is an implicit device wait: bound it with
+    # the dispatch watchdog so a wedged device can't hang the wr sweep
+    # (JEPSEN_TPU_DISPATCH_TIMEOUT_S; no-op when the gate is off)
+    from ...parallel import _block_flags
+    from ... import trace as _trace
+    flags = _block_flags(flags, _trace.get_current())
     return [flags_to_names(int(w)) for w in np.asarray(flags)[:n]]
 
 

@@ -208,6 +208,21 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
         level=logging.INFO,
         format="%(asctime)s %(levelname)s [%(name)s] %(message)s")
 
+    # Hung sweeps must be debuggable in production: SIGUSR1 dumps
+    # every thread's stack (faulthandler) without killing the process
+    # — `kill -USR1 <pid>` answers "where is it stuck" on a wedged
+    # device wait or a parked pool. Best-effort: unavailable off the
+    # main thread and on platforms without SIGUSR1.
+    try:
+        import faulthandler
+        import signal as _signal
+        # the REAL stderr fd: sys.stderr may be a captured/fileno-less
+        # wrapper (pytest, some embedders), which faulthandler rejects
+        faulthandler.register(_signal.SIGUSR1, all_threads=True,
+                              chain=True, file=sys.__stderr__)
+    except (AttributeError, ValueError, OSError, ImportError):
+        pass
+
     # Every auto-backend checker constructed from here on resolves per
     # this process-wide choice (devices.resolve_backend).
     if getattr(args, "backend", None) and args.backend != "auto":
@@ -291,13 +306,27 @@ def analyze_store(store: Store, checker: str = "append",
     sweep's spans (ingest parse, pack/h2d/dispatch/collect phases,
     device windows, per-checker fallbacks) export to
     `<store>/trace.json` + `metrics.json` at exit, printing the path —
-    the sweep-level analogue of the per-run artifacts save_2 writes."""
+    the sweep-level analogue of the per-run artifacts save_2 writes.
+
+    Sweep start also reclaims /dev/shm segments a previous crashed
+    run's dead pid left behind (`shm_stale_reclaimed` counter), and
+    every verdict appends to the store's `verdicts.jsonl` journal as
+    it lands — `--resume` reads it back and skips the journaled
+    (run, checker) pairs, so an interrupted sweep restarts where it
+    died."""
+    from . import shm as _shm
+    from .store import VerdictJournal
     tr = trace.fresh_run(f"analyze-store:{checker}", scope="sweep")
+    tr.counter("shm_stale_reclaimed").inc(_shm.reclaim_stale())
+    journal = VerdictJournal(store.base / "verdicts.jsonl",
+                             base=store.base)
     try:
         with trace.jax_profile_session(store.base / "jax-profile"):
             return _analyze_store_impl(store, checker=checker,
-                                       name=name, resume=resume)
+                                       name=name, resume=resume,
+                                       journal=journal)
     finally:
+        journal.close()
         if getattr(tr, "enabled", False) and store.base.is_dir():
             try:
                 p = tr.export(store.base / "trace.json")
@@ -309,7 +338,8 @@ def analyze_store(store: Store, checker: str = "append",
 
 def _analyze_store_impl(store: Store, checker: str = "append",
                         name: str | None = None,
-                        resume: bool = False) -> int:
+                        resume: bool = False,
+                        journal=None) -> int:
     """Batch re-check every stored run — the north-star batch path
     (SURVEY.md §3.4, §7 stage 8): encodable histories are packed,
     length-bucketed, and dispatched across the device mesh in one sweep;
@@ -317,21 +347,31 @@ def _analyze_store_impl(store: Store, checker: str = "append",
 
     Writes `results.json`/`results.edn` into each run dir and prints one
     JSON summary line per run. Exit code: worst validity across runs."""
+    from .store import VerdictJournal
     run_dirs = sorted(store.all_run_dirs())
     if name is not None:
         run_dirs = [d for d in run_dirs if d.parent.name == name]
     prior_worst = 0
     if resume:
         # resumable analysis (SURVEY.md §5.4): skip runs THIS sweep
-        # already verdicted (the marker records which checker wrote it,
-        # so an append sweep never masks a pending wr sweep). Skipped
-        # runs still contribute their recorded validity to the exit
-        # code — an invalid verdict from the completed part of an
-        # interrupted sweep must not read as success.
+        # already verdicted — journaled in verdicts.jsonl (appended
+        # per history as results land, so it survives a SIGKILL of
+        # the sweep) or carrying the per-run marker (which records
+        # which checker wrote it, so an append sweep never masks a
+        # pending wr sweep). Skipped runs still contribute their
+        # recorded validity to the exit code — an invalid verdict
+        # from the completed part of an interrupted sweep must not
+        # read as success.
+        journaled = VerdictJournal.load(store.base / "verdicts.jsonl")
+        rel = journal.rel if journal is not None else str
         pending = []
         for d in run_dirs:
+            ent = journaled.get((rel(d), checker))
             if _verdicted(d, checker):
                 prior_worst = max(prior_worst, _prior_code(d, checker))
+            elif ent is not None:
+                prior_worst = max(prior_worst,
+                                  validity_exit_code(ent))
             else:
                 pending.append(d)
         if not pending:
@@ -360,19 +400,21 @@ def _analyze_store_impl(store: Store, checker: str = "append",
         return core.analyze(test)["results"]
 
     def emit(d, res):
-        return _write_results(d, res, checker)
+        return _write_results(d, res, checker, journal=journal)
 
     worst = prior_worst
     if checker == "stored":
         for d in run_dirs:
             worst = max(worst,
-                        _stored_fallback(d, stored_check, "stored"))
+                        _stored_fallback(d, stored_check, "stored",
+                                         journal=journal))
         return worst
 
     if checker == "register":
         return max(prior_worst,
                    _analyze_store_register(store, run_dirs,
-                                           stored_check))
+                                           stored_check,
+                                           journal=journal))
 
     from . import parallel
     from .checker import elle
@@ -392,9 +434,25 @@ def _analyze_store_impl(store: Store, checker: str = "append",
     from . import ingest
 
     def encodable(d, enc, fallback: list) -> bool:
-        """Shared triage: exceptions and txn-less histories route to
-        the run's own stored checker."""
+        """Shared triage, with per-history isolation: a run whose
+        encode raised (the pool returns the per-run exception) gets
+        ONE more chance through its own stored checker — a wr sweep
+        over an append-shaped store is unencodable yet perfectly
+        checkable — and if that fails too, `_stored_fallback`
+        quarantines it as a `valid? unknown` verdict instead of
+        killing the sweep (JEPSEN_TPU_STRICT=1 restores fail-fast).
+        A self-nemesis InjectedFault skips the detour: the injection
+        simulates a poisoned history, whose terminal state IS
+        quarantine. Txn-less histories are no failure at all and
+        route to the stored checker as before."""
+        nonlocal worst
         if isinstance(enc, Exception):
+            from . import supervisor
+            if isinstance(enc, supervisor.InjectedFault):
+                worst = max(worst, _quarantine_run(d, enc, "encode",
+                                                   checker,
+                                                   journal=journal))
+                return False
             log.info("run %s not encodable as %s (%r); using stored "
                      "checker", d, checker, enc)
             fallback.append(d)
@@ -435,6 +493,12 @@ def _analyze_store_impl(store: Store, checker: str = "append",
         prohibited = elle.AppendChecker().prohibited
 
         def emit_append(d, enc, cycles):
+            from . import supervisor
+            if isinstance(cycles, supervisor.Quarantined):
+                # the dispatcher abandoned this history (OOM backdown
+                # exhausted / watchdog) — already counted + span'd at
+                # the quarantine site; persist the unknown verdict
+                return emit(d, cycles.verdict("append"))
             res = elle.render_verdict(enc, cycles, prohibited)
             res["checker"] = "append"   # --resume marker
             return emit(d, res)
@@ -471,19 +535,28 @@ def _analyze_store_impl(store: Store, checker: str = "append",
                 for d, enc, cycles in zip(dense_map, dense, cycles_per):
                     worst = max(worst, emit_append(d, enc, cycles))
         for d, enc in zip(huge_map, huge):
-            if host_only:
-                cycles = elle.cycle_anomalies_cpu(enc)
-            else:
-                # mesh=None: these are all past the dense limit, so
-                # check_long_history goes host-condensation; None just
-                # lets the per-SCC classify stage use default_devices()
-                # (the dp batch mesh would be wrong for B=1 anyway)
-                cycles = parallel.check_long_history(
-                    enc, None, dense_limit=parallel.DENSE_TXN_LIMIT)
+            try:
+                if host_only:
+                    cycles = elle.cycle_anomalies_cpu(enc)
+                else:
+                    # mesh=None: these are all past the dense limit, so
+                    # check_long_history goes host-condensation; None
+                    # just lets the per-SCC classify stage use
+                    # default_devices() (the dp batch mesh would be
+                    # wrong for B=1 anyway)
+                    cycles = parallel.check_long_history(
+                        enc, None, dense_limit=parallel.DENSE_TXN_LIMIT)
+            except Exception as e:
+                # one monster history must fail alone, not take the
+                # whole sweep's remaining verdicts with it
+                worst = max(worst, _quarantine_run(
+                    d, e, "check", checker, journal=journal))
+                continue
             worst = max(worst, emit_append(d, enc, cycles))
         for d in fallback:
             worst = max(worst, _stored_fallback(d, stored_check,
-                                                checker))
+                                                checker,
+                                                journal=journal))
         return worst
 
     # wr: edge lists host-built; bucketed device dispatches — the same
@@ -501,19 +574,83 @@ def _analyze_store_impl(store: Store, checker: str = "append",
             cycles_per = [elle_wr.cycle_anomalies_cpu(e)
                           for _d, e in good]
         else:
-            cycles_per = elle_kernels.check_edge_batch_bucketed(
-                [elle_wr.to_edge_dict(e) for _d, e in good])
+            cycles_per = _wr_chunk_with_backdown(
+                good, elle_kernels, elle_wr)
         # emit per chunk: verdicts persist incrementally (an
         # interrupted sweep --resumes from the last chunk, not from
         # zero) and encodings free as we go
         for (d, enc), cycles in zip(good, cycles_per):
+            if hasattr(cycles, "verdict"):   # supervisor.Quarantined
+                worst = max(worst, emit(d, cycles.verdict("wr")))
+                continue
             res = elle_wr.render_wr_verdict(enc, cycles, prohibited)
             res["checker"] = "wr"       # --resume marker
             worst = max(worst, emit(d, res))
 
     for d in fallback:
-        worst = max(worst, _stored_fallback(d, stored_check, checker))
+        worst = max(worst, _stored_fallback(d, stored_check, checker,
+                                            journal=journal))
     return worst
+
+
+def _wr_chunk_with_backdown(good, elle_kernels, elle_wr):
+    """One wr chunk's device dispatch with the supervisor's OOM and
+    watchdog degradation: the bucketed batch first; on
+    RESOURCE_EXHAUSTED (or a watchdog timeout) the chunk re-checks one
+    history at a time (the wr dispatcher has no incremental split, so
+    singletons ARE the backdown floor), and a history that still fails
+    alone quarantines. Two CONSECUTIVE singleton watchdog timeouts mean
+    the device is wedged, not the data: the chunk's remainder
+    quarantines without re-probing. Other errors (and strict mode)
+    re-raise — fail-fast exactly as before."""
+    from . import supervisor
+
+    def recoverable(e) -> bool:
+        return not supervisor.strict_enabled() and (
+            supervisor.is_oom_error(e)
+            or isinstance(e, supervisor.WatchdogTimeout))
+
+    edges = [elle_wr.to_edge_dict(e) for _d, e in good]
+    tr = trace.get_current()
+    try:
+        return elle_kernels.check_edge_batch_bucketed(edges)
+    except Exception as e:
+        if not recoverable(e):
+            raise
+        if supervisor.is_oom_error(e):
+            # watchdog batch failures are already counted inside the
+            # bounded wait; oom_retries must mean real OOMs so the
+            # bench's robustness block can tell the two causes apart
+            tr.counter("oom_retries").inc()
+    out = []
+    wedged = 0
+    for ed in edges:
+        if wedged >= 2:
+            # two consecutive singleton watchdog timeouts: the device
+            # is wedged, not the data — quarantine the remainder
+            # instead of burning 2x the timeout (and two abandoned
+            # waiter threads) per history on a dead runtime
+            with tr.span("quarantine", stage="watchdog", histories=1):
+                tr.counter("quarantined").inc()
+            out.append(supervisor.Quarantined(
+                "watchdog", "device wedged: consecutive singleton "
+                "watchdog timeouts"))
+            continue
+        try:
+            out.append(elle_kernels.check_edge_batch_bucketed([ed])[0])
+            wedged = 0
+        except Exception as e:
+            if not recoverable(e):
+                raise
+            if isinstance(e, supervisor.WatchdogTimeout):
+                stage = "watchdog"
+                wedged += 1
+            else:
+                stage = "oom"
+            with tr.span("quarantine", stage=stage, histories=1):
+                tr.counter("quarantined").inc()
+            out.append(supervisor.Quarantined(stage, repr(e)))
+    return out
 
 
 def _parse_timed(it):
@@ -571,55 +708,96 @@ def _prior_code(d, checker: str | None = None) -> int:
         return 0  # legacy empty sidecar: validity was reported when run
 
 
-def _write_results(d, res: dict, checker: str | None = None) -> int:
+def _write_results(d, res: dict, checker: str | None = None,
+                   journal=None, persist: bool = True) -> int:
     """Persist results.json/.edn into a run dir and print the one-line
     summary; returns the validity exit code. results.json lands via
     per-process temp-file + atomic rename (multi-host sweeps over a
     shared store race benignly — identical content, last writer wins),
     then the additive `.sweep-<checker>` sidecar marks the run done
-    for --resume."""
+    for --resume, and the sweep's verdicts.jsonl journal (when one is
+    threaded through) gets its per-history append. persist=False skips
+    the results.json/.edn write (sidecar/journal/summary only) so the
+    stored-fallback's failure path can't clobber a run's original
+    test-time results — its success path never writes them either."""
     import os as _os
     from . import edn as edn_mod
     from .store import _results_to_edn
-    (d / "results.edn").write_text(
-        edn_mod.dumps(_results_to_edn(_json_safe(res))) + "\n")
-    tmp = d / f"results.json.tmp.{_os.getpid()}"
-    tmp.write_text(json.dumps(_json_safe(res), indent=2))
-    _os.replace(tmp, d / "results.json")
+    if persist:
+        (d / "results.edn").write_text(
+            edn_mod.dumps(_results_to_edn(_json_safe(res))) + "\n")
+        tmp = d / f"results.json.tmp.{_os.getpid()}"
+        tmp.write_text(json.dumps(_json_safe(res), indent=2))
+        _os.replace(tmp, d / "results.json")
     if checker is not None:
         (d / f".sweep-{checker}").write_text(
             json.dumps({"valid?": res.get("valid?")}))
+    if journal is not None and checker is not None:
+        journal.record(d, checker, res)
     line = {"dir": str(d), "valid?": res.get("valid?")}
     if "anomaly-types" in res:
         line["anomalies"] = res.get("anomaly-types", [])
     if "failures" in res:
         line["failures"] = res["failures"]
+    if "quarantined" in res:
+        line["quarantined"] = res["quarantined"]
+        line["error"] = res.get("error")
     print(json.dumps(line))
     return validity_exit_code(res)
 
 
-def _stored_fallback(d, stored_check, checker: str | None = None) -> int:
-    """Run a dir through its own stored checker, degrading to an error
-    line (never an exception) on failure. With `checker`, a success
-    leaves the `.sweep-<checker>` sidecar so --resume counts the run
-    done for that sweep."""
+def _quarantine_run(d, err, stage: str, checker: str | None = None,
+                    journal=None, persist: bool = True) -> int:
+    """Record a run the sweep abandoned as a `valid? unknown` verdict —
+    never a false verdict, never a dead sweep (Elle's degradation
+    contract) — persisting the cause for triage and journaling it so
+    --resume doesn't grind over the same broken run forever.
+    JEPSEN_TPU_STRICT=1 re-raises instead (the old fail-fast)."""
+    from . import supervisor
+    if supervisor.strict_enabled():
+        if isinstance(err, BaseException):
+            raise err
+        raise RuntimeError(str(err))
+    tr = trace.get_current()
+    with tr.span("quarantine", stage=stage):
+        tr.counter("quarantined").inc()
+    log.warning("quarantining %s (%s): %s", d, stage, err)
+    return _write_results(
+        d, supervisor.quarantine_verdict(err, stage, checker), checker,
+        journal=journal, persist=persist)
+
+
+def _stored_fallback(d, stored_check, checker: str | None = None,
+                     journal=None) -> int:
+    """Run a dir through its own stored checker, quarantining (an
+    `unknown` verdict, never an exception, never a dead sweep) on
+    failure. With `checker`, a success leaves the `.sweep-<checker>`
+    sidecar so --resume counts the run done for that sweep."""
     try:
         res = stored_check(d)
-        print(json.dumps({"dir": str(d), "valid?": res.get("valid?")}))
-        if checker is not None:
-            # record the validity: the fallback may not write a
-            # results.json, and --resume must reproduce this run's
-            # exit-code contribution from the sidecar alone
-            (d / f".sweep-{checker}").write_text(
-                json.dumps({"valid?": res.get("valid?")}))
-        return validity_exit_code(res)
     except Exception as e:
-        print(json.dumps({"dir": str(d), "error": str(e)}))
-        return 254
+        # never clobber an existing test-time results.json — the
+        # stored path's success leaves it untouched too, and a
+        # transient failure must not replace a recorded verdict with
+        # an unknown. A run dir without one records the quarantine so
+        # triage has something to read.
+        return _quarantine_run(
+            d, e, "stored", checker, journal=journal,
+            persist=not (d / "results.json").exists())
+    print(json.dumps({"dir": str(d), "valid?": res.get("valid?")}))
+    if checker is not None:
+        # record the validity: the fallback may not write a
+        # results.json, and --resume must reproduce this run's
+        # exit-code contribution from the sidecar alone
+        (d / f".sweep-{checker}").write_text(
+            json.dumps({"valid?": res.get("valid?")}))
+    if journal is not None and checker is not None:
+        journal.record(d, checker, res)
+    return validity_exit_code(res)
 
 
 def _analyze_store_register(store: Store, run_dirs: list,
-                            stored_check) -> int:
+                            stored_check, journal=None) -> int:
     """Per-key CAS-register linearizability over a whole store: every
     key's subhistory from EVERY run goes down in one tiered device
     sweep (dense grid -> bounded frontier -> CPU re-run), then verdicts
@@ -690,7 +868,8 @@ def _analyze_store_register(store: Store, run_dirs: list,
     for i, d in enumerate(run_dirs):
         if i in fallback:
             worst = max(worst,
-                        _stored_fallback(d, stored_check, "register"))
+                        _stored_fallback(d, stored_check, "register",
+                                         journal=journal))
             continue
         keyed = per_run.get(i, {})
         valid = merge_valid([r.get("valid?", True)
@@ -701,7 +880,8 @@ def _analyze_store_register(store: Store, run_dirs: list,
                "results": {str(k): r for k, r in keyed.items()},
                "failures": sorted(str(k) for k, r in keyed.items()
                                   if r.get("valid?") is False)}
-        worst = max(worst, _write_results(d, res, "register"))
+        worst = max(worst, _write_results(d, res, "register",
+                                          journal=journal))
     return worst
 
 

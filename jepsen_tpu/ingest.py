@@ -50,7 +50,11 @@ def encode_run_dir(run_dir: str | os.PathLike, checker: str = "append",
     when the encoded sidecar cache didn't apply) so pooled callers can
     aggregate cache counters in the PARENT tracer — pool workers'
     tracers are process-local and never exported."""
-    from . import trace
+    from . import supervisor, trace
+    # self-nemesis (JEPSEN_TPU_FAULT_INJECT): deterministic encode
+    # faults / worker kills land here, ahead of the cache, so every
+    # retry of a selected run dir fails identically in every process
+    supervisor.maybe_inject_encode_fault(run_dir)
     cacheable = lean and checker in ("append", "wr")
     if info is not None:
         info["cache"] = None
@@ -196,16 +200,23 @@ def _spawn_safe() -> bool:
 def _pool_map(worker, items: list, processes: int | None) -> list:
     """Shared process-pool recipe: spawned workers (the parent usually
     holds live device runtimes), per-item exceptions returned not
-    raised, serial fallback on pool failure."""
+    raised, serial fallback on pool failure. The pool is a
+    ProcessPoolExecutor rather than multiprocessing.Pool because a
+    SIGKILLed worker (OOM killer, the kill nemesis) must surface as
+    BrokenProcessPool — which routes to the serial fallback — instead
+    of hanging the parent forever on a result that will never come."""
     if processes is None:
         processes = min(len(items), os.cpu_count() or 1)
     if processes <= 1 or len(items) <= 1 or not _spawn_safe():
         return [worker(it) for it in items]
+    from concurrent.futures import ProcessPoolExecutor
     ctx = mp.get_context("spawn")
     try:
-        with ctx.Pool(processes=processes) as pool:
-            return pool.map(worker, items,
-                            chunksize=max(1, len(items) // (4 * processes)))
+        with ProcessPoolExecutor(max_workers=processes,
+                                 mp_context=ctx) as ex:
+            return list(ex.map(worker, items,
+                               chunksize=max(1, len(items)
+                                             // (4 * processes))))
     except Exception:
         log.warning("process-pool map failed; falling back to serial",
                     exc_info=True)
@@ -299,60 +310,93 @@ def iter_encode_chunks(run_dirs: Sequence[str | os.PathLike],
         use_shm = shm.enabled() and shm.available()
         names = [shm.gen_name() if use_shm else None for _ in dirs]
         consumed = [name is None for name in names]
+        from concurrent.futures import ProcessPoolExecutor, as_completed
         ctx = mp.get_context("spawn")
+        ex = None
         try:
-            with ctx.Pool(processes=processes) as pool:
+            # ProcessPoolExecutor, not multiprocessing.Pool: a worker
+            # that dies without delivering (SIGKILL from the kill
+            # nemesis, the OOM killer) raises BrokenProcessPool here
+            # instead of hanging imap on a result that will never
+            # arrive — the except below then resumes SERIALLY from
+            # `done`, so a crashed worker costs re-encodes, never the
+            # sweep. as_completed registers ONE waiter per future
+            # (repeated wait(FIRST_COMPLETED) over the outstanding set
+            # would re-register every not-done future per wake-up —
+            # O(N²) churn on a big store's feed loop).
+            ex = ProcessPoolExecutor(max_workers=processes,
+                                     mp_context=ctx)
+            if info is not None:
+                info["pooled"] = True
+            tr = trace.get_current()
+            futs = [ex.submit(_stream_worker,
+                              (i, d, checker, names[i]))
+                    for i, d in enumerate(dirs)]
+            pending: dict = {}   # idx -> ((dir, enc), span)
+            frontier = 0         # next idx to yield
+            buf, span_buf = [], []
+            for fut in as_completed(futs):
+                idx, payload, einfo, t0, t1 = fut.result()
+                if shm.is_descriptor(payload):
+                    tr.counter("shm_bytes").inc(payload["nbytes"])
+                    payload = shm.materialize(payload)
+                consumed[idx] = True
+                if einfo.get("cache") == "hit":
+                    tr.counter("cache_hits").inc()
+                elif einfo.get("cache") == "miss":
+                    tr.counter("cache_misses").inc()
+                # the worker's parse window lands on its own trace
+                # track (monotonic spans; the tracer converts), so
+                # trace.json shows parse/device overlap directly
+                tr.add_span("parse", t0, t1, track="ingest-pool",
+                            clock="monotonic")
+                pending[idx] = ((dirs[idx], payload), (t0, t1))
+                if len(pending) > 1:
+                    g = tr.gauge("reorder_depth")
+                    g.set(max(getattr(g, "value", 0) or 0,
+                              len(pending)))
+                while frontier in pending:
+                    item, span = pending.pop(frontier)
+                    buf.append(item)
+                    span_buf.append(span)
+                    frontier += 1
+                    if len(buf) >= chunk:
+                        if info is not None:
+                            info["parse_spans"].extend(span_buf)
+                        yield buf
+                        done += len(buf)
+                        buf, span_buf = [], []
+            if buf:
                 if info is not None:
-                    info["pooled"] = True
-                tr = trace.get_current()
-                it = pool.imap_unordered(
-                    _stream_worker,
-                    [(i, d, checker, names[i])
-                     for i, d in enumerate(dirs)],
-                    chunksize=1)
-                pending: dict = {}   # idx -> ((dir, enc), span)
-                frontier = 0         # next idx to yield
-                buf, span_buf = [], []
-                for idx, payload, einfo, t0, t1 in it:
-                    if shm.is_descriptor(payload):
-                        tr.counter("shm_bytes").inc(payload["nbytes"])
-                        payload = shm.materialize(payload)
-                    consumed[idx] = True
-                    if einfo.get("cache") == "hit":
-                        tr.counter("cache_hits").inc()
-                    elif einfo.get("cache") == "miss":
-                        tr.counter("cache_misses").inc()
-                    # the worker's parse window lands on its own trace
-                    # track (monotonic spans; the tracer converts), so
-                    # trace.json shows parse/device overlap directly
-                    tr.add_span("parse", t0, t1, track="ingest-pool",
-                                clock="monotonic")
-                    pending[idx] = ((dirs[idx], payload), (t0, t1))
-                    if len(pending) > 1:
-                        g = tr.gauge("reorder_depth")
-                        g.set(max(getattr(g, "value", 0) or 0,
-                                  len(pending)))
-                    while frontier in pending:
-                        item, span = pending.pop(frontier)
-                        buf.append(item)
-                        span_buf.append(span)
-                        frontier += 1
-                        if len(buf) >= chunk:
-                            if info is not None:
-                                info["parse_spans"].extend(span_buf)
-                            yield buf
-                            done += len(buf)
-                            buf, span_buf = [], []
-                if buf:
-                    if info is not None:
-                        info["parse_spans"].extend(span_buf)
-                    yield buf
-                    done += len(buf)
-                return
+                    info["parse_spans"].extend(span_buf)
+                yield buf
+                done += len(buf)
+            return
         except Exception:
             log.warning("pipelined encode pool failed; falling back "
                         "to serial", exc_info=True)
         finally:
+            if ex is not None:
+                # cancel queued work and give running tasks a bounded
+                # grace to finish: workers should not still be creating
+                # segments when the stale-sweep below runs, but a
+                # WEDGED worker (a hang in a huge/corrupt parse — the
+                # class the supervisor exists for) must not hold
+                # teardown hostage the way shutdown(wait=True) would,
+                # so stragglers are killed. Their segments fall to the
+                # stale-sweep below, or to shm.reclaim_stale at the
+                # next sweep's start, keyed on the dead pid.
+                procs = list((getattr(ex, "_processes", None)
+                              or {}).values())
+                ex.shutdown(wait=False, cancel_futures=True)
+                deadline = time.monotonic() + 5.0
+                for p in procs:
+                    p.join(max(0.0, deadline - time.monotonic()))
+                for p in procs:
+                    if p.is_alive():
+                        log.warning("killing wedged encode worker "
+                                    "pid=%s", p.pid)
+                        p.kill()
             # Exception-path sweep: any segment a worker created but
             # the parent never mapped must not outlive the pool. The
             # happy path unlinks at materialize time, so this only

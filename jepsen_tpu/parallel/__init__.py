@@ -18,6 +18,7 @@ exists so sharding constraints can be placed on the matrices themselves.
 from __future__ import annotations
 
 import functools
+import logging
 import math
 import os
 import queue as _queue
@@ -30,12 +31,15 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import supervisor as sv
 from .. import trace
 from ..checker.elle import kernels as K
 from ..devices import default_devices, ensure_platform_pin
 
 ensure_platform_pin()
 from ..util import pad_to_multiple
+
+log = logging.getLogger(__name__)
 
 
 def factor2(n: int) -> tuple[int, int]:
@@ -218,7 +222,7 @@ def check_long_history(enc, mesh: Mesh | None = None, *,
     # window opens AFTER the enqueue returns (first-call compile is
     # host time, not device time — same contract as the bucket path)
     t_disp = time.perf_counter()
-    flags = np.asarray(jax.block_until_ready(pending))
+    flags = np.asarray(_block_flags(pending, trace.get_current()))
     trace.get_current().device_complete("long-history", t_disp,
                                         txns=enc.n)
     return K.flags_to_names(int(flags[0]))
@@ -284,12 +288,20 @@ class PendingVerdicts:
     bucket's device dispatch without a host sync, so the caller can
     overlap ingest/packing of the NEXT chunk with the device's work on
     this one. `.result()` blocks, pulls the flag words D2H and returns
-    per-history {anomaly: True} dicts in input order."""
+    per-history {anomaly: True} dicts in input order (a history the
+    supervisor abandoned yields its `supervisor.Quarantined` sentinel
+    instead of a flags dict — callers render it as `valid? unknown`)."""
 
-    def __init__(self, n: int, parts: list):
+    def __init__(self, n: int, parts: list, finish=None):
         self._n = n
-        # [(bucket indices, device flags, dispatch-enqueue time|None)]
+        # [(bucket indices, flags, dispatch-enqueue time|None)] —
+        # flags is a live device array, or (already resolved) a list
+        # of per-history flag words / Quarantined aligned with indices
         self._parts = parts
+        # finish(idx, device_flags) -> resolved list: the dispatcher's
+        # watchdog + OOM-backdown closure; None (bare construction)
+        # blocks plainly with no recovery.
+        self._finish = finish
         self._result: list | None = None
 
     def is_ready(self) -> bool:
@@ -312,13 +324,25 @@ class PendingVerdicts:
         tr = trace.get_current()
         out: list[dict | None] = [None] * self._n
         for idx, flags, t_disp in self._parts:
-            flags = np.asarray(jax.block_until_ready(flags))
-            # dispatch->materialized delta on the device track (parts
-            # already resolved by the back-pressure loop carry None)
-            tr.device_complete("bucket", t_disp, histories=len(idx))
-            # padded replicas (indices shorter than flags) are dropped
+            if not isinstance(flags, list):
+                if self._finish is not None:
+                    # the finish closure owns the device window (logged
+                    # on its success path only — a recovered bucket's
+                    # device time is the backdown's own windows)
+                    flags = self._finish(idx, flags, t_disp)
+                else:
+                    arr = np.asarray(jax.block_until_ready(flags))
+                    # padded replicas (flags beyond the bucket's own
+                    # indices) are dropped here
+                    flags = [int(w) for w in arr[:len(idx)]]
+                    # dispatch->materialized delta on the device track
+                    # (parts already resolved by the back-pressure
+                    # loop carry None)
+                    tr.device_complete("bucket", t_disp,
+                                       histories=len(idx))
             for i, w in zip(idx, flags):
-                out[i] = K.flags_to_names(int(w))
+                out[i] = (w if isinstance(w, sv.Quarantined)
+                          else K.flags_to_names(int(w)))
         self._parts = []
         tr.gauge("inflight_depth").set(0)   # fully drained
         _acc_phase(phases, "collect", t0)
@@ -394,6 +418,132 @@ def _h2d_bucket(item: tuple, phases: dict | None) -> tuple:
     return bucket, bucket_mesh, shape, args
 
 
+# ---------------------------------------------------------------------------
+# Supervised dispatch: watchdog, OOM backdown, quarantine (ISSUE 4).
+# The policy (gates, fault injection, the Quarantined sentinel) lives
+# in jepsen_tpu.supervisor; this is the mechanism around jax calls.
+# ---------------------------------------------------------------------------
+
+def _block_flags(flags, tr):
+    """`jax.block_until_ready` bounded by the dispatch watchdog
+    (JEPSEN_TPU_DISPATCH_TIMEOUT_S; default off = plain block). On a
+    timeout the wait is retried once — a transient host stall under a
+    healthy device resolves here — then WatchdogTimeout raises and the
+    caller quarantines the bucket. The device op itself cannot be
+    cancelled; its waiter thread is abandoned daemonically
+    (util.timeout_call), so a wedged runtime can't also wedge exit."""
+    timeout = sv.dispatch_timeout_s()
+    if timeout is None:
+        return jax.block_until_ready(flags)
+    from ..util import timeout_call
+    _pending = object()
+    for _attempt in range(2):
+        got = timeout_call(timeout,
+                           lambda: jax.block_until_ready(flags),
+                           default=_pending)
+        if got is not _pending:
+            return got
+        if _attempt == 0:
+            # one wedged dispatch = one timeout, however many attempts
+            # it burns — operators correlate this against `quarantined`
+            tr.counter("watchdog_timeouts").inc()
+        tr.instant("watchdog_timeout", track="device",
+                   timeout_s=timeout, attempt=_attempt)
+    raise sv.WatchdogTimeout(
+        f"device dispatch exceeded {timeout}s twice")
+
+
+def _quarantine_bucket(idx: list, stage: str, err, tr) -> list:
+    """Per-history Quarantined sentinels for a bucket the supervisor
+    abandoned, attributed as a quarantine span + counter."""
+    with tr.span("quarantine", stage=stage, histories=len(idx)):
+        tr.counter("quarantined").inc(len(idx))
+        log.warning("quarantined %d histories (%s): %r",
+                    len(idx), stage, err)
+    e = repr(err)
+    return [sv.Quarantined(stage, e) for _ in idx]
+
+
+def _sync_check(encs, idx: list, mesh, budget_cells: int, kw: dict,
+                tr, phases) -> np.ndarray:
+    """One synchronous bucket check — the OOM-backdown retry path:
+    pack, transfer, dispatch, block. Raises on OOM/watchdog; the
+    caller owns the split/quarantine policy."""
+    dp = mesh.devices.shape[0] if mesh is not None else 1
+    bucket, bucket_mesh, shape, args = _h2d_bucket(
+        _prep_bucket(encs, idx, mesh, dp, budget_cells, tr, phases),
+        phases)
+    fn = sharded_check_fn(bucket_mesh, shape, **kw)
+    sv.maybe_inject_oom()
+    t_disp = time.perf_counter()
+    arr = np.asarray(_block_flags(fn(*args), tr))
+    tr.device_complete("bucket", t_disp, histories=len(idx))
+    return arr
+
+
+def _oom_backdown(encs, idx: list, mesh, budget_cells: int, kw: dict,
+                  tr, phases, err) -> list:
+    """Recover from a RESOURCE_EXHAUSTED bucket: split it in half and
+    retry each half synchronously at a HALVED per-slot cell budget
+    (the padded footprint shrinks on both axes), recursing to
+    singletons. A singleton that still OOMs is oversized for the
+    device outright — it quarantines instead of crashing the sweep.
+    In strict mode the original error re-raises untouched.
+
+    Retries run WITHOUT draining the pipeline's other in-flight
+    buckets first (draining from inside the threaded dispatcher would
+    have to juggle its envelope semaphore — a deadlock risk not worth
+    the memory it frees), so the halved budget is also what compensates
+    for their residual pressure: each halving shrinks this retry's
+    footprint until it fits the envelope slack or quarantines."""
+    if sv.strict_enabled():
+        raise err
+    tr.counter("oom_retries").inc()
+    if len(idx) == 1:
+        return _quarantine_bucket(idx, "oom", err, tr)
+    tr.counter("bucket_splits").inc()
+    mid = (len(idx) + 1) // 2
+    half_budget = max(1, budget_cells // 2)
+    out: list = []
+    for half in (idx[:mid], idx[mid:]):
+        try:
+            arr = _sync_check(encs, half, mesh, half_budget, kw, tr,
+                              phases)
+            out.extend(int(w) for w in arr[:len(half)])
+        except BaseException as e:
+            if isinstance(e, sv.WatchdogTimeout) \
+                    and not sv.strict_enabled():
+                out.extend(_quarantine_bucket(half, "watchdog", e, tr))
+            elif sv.is_oom_error(e) and not sv.strict_enabled():
+                out.extend(_oom_backdown(encs, half, mesh, half_budget,
+                                         kw, tr, phases, e))
+            else:
+                raise
+    return out
+
+
+def _finish_part(encs, idx: list, flags, mesh, budget_cells: int,
+                 kw: dict, tr, phases, t_disp=None) -> list:
+    """Resolve one dispatched bucket to per-history flag words (padded
+    replicas dropped), recovering from OOM (backdown) and watchdog
+    timeouts (quarantine) unless strict. The dispatch->materialized
+    device window closes HERE, on the success path only — a recovered
+    bucket's device time is the backdown's own per-half windows
+    (_sync_check), never the original window stretched over the whole
+    recovery (which would double-count the device track)."""
+    try:
+        arr = np.asarray(_block_flags(flags, tr))
+        tr.device_complete("bucket", t_disp, histories=len(idx))
+        return [int(w) for w in arr[:len(idx)]]
+    except BaseException as e:
+        if isinstance(e, sv.WatchdogTimeout) and not sv.strict_enabled():
+            return _quarantine_bucket(idx, "watchdog", e, tr)
+        if sv.is_oom_error(e) and not sv.strict_enabled():
+            return _oom_backdown(encs, idx, mesh, budget_cells, kw, tr,
+                                 phases, e)
+        raise
+
+
 def check_bucketed_async(encs: Sequence, mesh: Mesh | None = None, *,
                          classify: bool = True, realtime: bool = False,
                          process_order: bool = False,
@@ -446,6 +596,8 @@ def check_bucketed_async(encs: Sequence, mesh: Mesh | None = None, *,
     depth = max(1, max_inflight)
     dp = mesh.devices.shape[0] if mesh is not None else 1
     tr = trace.get_current()
+    kw = dict(classify=classify, realtime=realtime,
+              process_order=process_order, fused=fused)
     t0 = time.perf_counter()
     eff_budget = max(1, budget_cells // depth)
     buckets = bucket_by_length(encs, budget_cells=eff_budget, dp=dp)
@@ -458,27 +610,62 @@ def check_bucketed_async(encs: Sequence, mesh: Mesh | None = None, *,
                if _est_cells(encs, b, dp) <= eff_budget]
     _acc_phase(phases, "pack", t0)
 
+    def finish(idx, flags, t_disp=None):
+        return _finish_part(encs, idx, flags, mesh, eff_budget, kw,
+                            tr, phases, t_disp)
+
     def resolve_oldest():
         j = inflight.pop(0)
         t0 = time.perf_counter()
         idx, flags, t_disp = parts[j]
-        parts[j] = (idx, np.asarray(jax.block_until_ready(flags)),
-                    None)
-        tr.device_complete("bucket", t_disp, histories=len(idx))
+        parts[j] = (idx, finish(idx, flags, t_disp), None)
         tr.gauge("inflight_depth").set(len(inflight))
         _acc_phase(phases, "collect", t0)
 
-    def dispatch(item):
+    def dispatch(item) -> bool:
+        """Enqueue one packed bucket async; returns False when the
+        bucket was instead resolved synchronously (an OOM at enqueue
+        went down the backdown path — nothing joined the pipeline)."""
         bucket, bucket_mesh, shape, args = item
         t0 = time.perf_counter()
-        fn = sharded_check_fn(bucket_mesh, shape, classify=classify,
-                              realtime=realtime,
-                              process_order=process_order, fused=fused)
-        parts.append((bucket, fn(*args), time.perf_counter()))
+        fn = sharded_check_fn(bucket_mesh, shape, **kw)
+        try:
+            sv.maybe_inject_oom()
+            parts.append((bucket, fn(*args), time.perf_counter()))
+        except BaseException as e:
+            if not sv.is_oom_error(e) or sv.strict_enabled():
+                raise
+            _acc_phase(phases, "dispatch", t0)
+            parts.append((bucket, _oom_backdown(
+                encs, bucket, mesh, eff_budget, kw, tr, phases, e),
+                None))
+            return False
         inflight.append(len(parts) - 1)
         tr.counter("buckets_dispatched").inc()
         tr.gauge("inflight_depth").set(len(inflight))
         _acc_phase(phases, "dispatch", t0)
+        return True
+
+    def handle_failed(bucket, e):
+        """A bucket whose pack/h2d failed: strict re-raises (the old
+        fail-fast contract); OOM goes down the backdown path; any
+        other *Exception* quarantines JUST this bucket — independent
+        sub-problems fail independently, the rest of the sweep
+        proceeds. Non-Exception BaseExceptions (KeyboardInterrupt,
+        SystemExit) always re-raise: a Ctrl-C must stop the sweep,
+        not journal a bogus permanent 'unknown'."""
+        if sv.strict_enabled() or not isinstance(e, Exception):
+            raise e
+        if sv.is_oom_error(e):
+            parts.append((bucket, _oom_backdown(
+                encs, bucket, mesh, eff_budget, kw, tr, phases, e),
+                None))
+        else:
+            parts.append((bucket,
+                          _quarantine_bucket(bucket, "pack", e, tr),
+                          None))
+
+    _FAILED = object()
 
     if pack_thread_enabled() and len(buckets) > 1:
         # Staged pipeline: the packer thread owns pack + h2d; `sem`
@@ -493,12 +680,25 @@ def check_bucketed_async(encs: Sequence, mesh: Mesh | None = None, *,
         def producer():
             try:
                 for b in buckets:
-                    item = _prep_bucket(encs, b, mesh, dp, eff_budget,
-                                        tr, phases)
+                    # per-bucket isolation: a history that breaks
+                    # packing must not kill the producer (and with it
+                    # every later bucket's verdict) — the failure
+                    # rides the queue as a marker for the caller's
+                    # quarantine/backdown policy
+                    try:
+                        item = _prep_bucket(encs, b, mesh, dp,
+                                            eff_budget, tr, phases)
+                    except BaseException as e:
+                        out.put((_FAILED, b, e))
+                        continue
                     sem.acquire()
                     if stop.is_set():
                         return
-                    out.put(_h2d_bucket(item, phases))
+                    try:
+                        out.put(_h2d_bucket(item, phases))
+                    except BaseException as e:
+                        sem.release()   # no dispatch will free this slot
+                        out.put((_FAILED, b, e))
                 out.put(_DONE)
             except BaseException as e:   # surfaced on the caller
                 out.put(e)
@@ -519,12 +719,20 @@ def check_bucketed_async(encs: Sequence, mesh: Mesh | None = None, *,
                     break
                 if isinstance(item, BaseException):
                     raise item
-                dispatch(item)
-                # release an envelope slot as soon as the pipeline is
-                # full: the producer's h2d for bucket N+depth waits on
-                # this resolve, which itself overlaps bucket N+1's
-                # compute
-                if len(inflight) >= depth:
+                if isinstance(item, tuple) and item and \
+                        item[0] is _FAILED:
+                    handle_failed(item[1], item[2])
+                    continue
+                if not dispatch(item):
+                    # resolved synchronously: the envelope slot the
+                    # producer acquired for it frees right now, or the
+                    # producer parks forever while we wait on its queue
+                    sem.release()
+                elif len(inflight) >= depth:
+                    # release an envelope slot as soon as the pipeline
+                    # is full: the producer's h2d for bucket N+depth
+                    # waits on this resolve, which itself overlaps
+                    # bucket N+1's compute
                     resolve_oldest()
                     sem.release()
         finally:
@@ -536,9 +744,14 @@ def check_bucketed_async(encs: Sequence, mesh: Mesh | None = None, *,
         for bucket in buckets:
             while len(inflight) >= depth:
                 resolve_oldest()
-            item = _prep_bucket(encs, bucket, mesh, dp, eff_budget,
-                                tr, phases)
-            dispatch(_h2d_bucket(item, phases))
+            try:
+                item = _h2d_bucket(
+                    _prep_bucket(encs, bucket, mesh, dp, eff_budget,
+                                 tr, phases), phases)
+            except BaseException as e:
+                handle_failed(bucket, e)
+                continue
+            dispatch(item)
     for bucket in oversized:
         # strictly-alone dispatch: drain EVERYTHING first so this
         # history's unavoidable footprint is the only thing resident
@@ -546,10 +759,15 @@ def check_bucketed_async(encs: Sequence, mesh: Mesh | None = None, *,
         # shares the envelope with it)
         while inflight:
             resolve_oldest()
-        item = _prep_bucket(encs, bucket, mesh, dp, budget_cells,
-                            tr, phases)
-        dispatch(_h2d_bucket(item, phases))
-    return PendingVerdicts(len(encs), parts)
+        try:
+            item = _h2d_bucket(
+                _prep_bucket(encs, bucket, mesh, dp, budget_cells,
+                             tr, phases), phases)
+        except BaseException as e:
+            handle_failed(bucket, e)
+            continue
+        dispatch(item)
+    return PendingVerdicts(len(encs), parts, finish)
 
 
 def check_bucketed(encs: Sequence, mesh: Mesh | None = None, *,
@@ -593,7 +811,10 @@ def check_bucketed(encs: Sequence, mesh: Mesh | None = None, *,
                                 realtime=realtime,
                                 process_order=process_order,
                                 budget_cells=budget_cells, phases=phases)
-        flagged = [i for i, f in enumerate(detect) if f]
+        # quarantined sentinels pass straight through: there is
+        # nothing to classify for a history the supervisor abandoned
+        flagged = [i for i, f in enumerate(detect)
+                   if f and not isinstance(f, sv.Quarantined)]
         if not flagged:
             return detect
         # the re-dispatch population is all-cyclic, where the chained

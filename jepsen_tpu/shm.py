@@ -170,9 +170,18 @@ def materialize(desc: dict):
     """Parent side: map the descriptor's segment, UNLINK it
     immediately (pages survive until the views die; the name must
     never outlive this call), and rebuild the encoding with zero-copy
-    numpy views over the shared pages."""
+    numpy views over the shared pages. The attach rides a short
+    jittered-exponential retry: a transiently starved host (EMFILE,
+    ENOMEM under pressure) recovers, while a genuinely missing
+    segment (FileNotFoundError) fails straight through — it can only
+    mean the descriptor outlived its pages, and waiting won't bring
+    them back."""
     from multiprocessing import shared_memory as _sm
-    seg = _sm.SharedMemory(name=desc["name"])
+
+    from .util import with_retry
+    seg = with_retry(lambda: _sm.SharedMemory(name=desc["name"]),
+                     retries=3, backoff=0.005, exceptions=(OSError,),
+                     exponential=True, fatal=(FileNotFoundError,))
     try:
         seg.unlink()
     except FileNotFoundError:
@@ -187,6 +196,50 @@ def materialize(desc: dict):
     from . import store as _store
     return _store.rebuild_encoded(desc["checker"], arrays,
                                   desc["meta"])
+
+
+def _pid_alive(pid: int) -> bool:
+    """Is `pid` a live process? Permission errors mean alive (someone
+    else's process); any other failure errs on the safe side."""
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True
+
+
+def reclaim_stale(shm_dir: str = "/dev/shm") -> int:
+    """Sweep-start reclamation: unlink every `jtshm_<pid>_*` segment
+    whose creating pid is DEAD — the parent-pregenerated names a
+    previous run left behind when it crashed between a worker's create
+    and the parent's materialize (SIGKILL of the whole sweep, OOM
+    kill). Segments of live pids (a concurrent sweep on the same
+    host) and foreign names are untouched, so /dev/shm can't leak
+    across runs yet two sweeps can share a box. Returns the count
+    reclaimed (callers attribute it as the `shm_stale_reclaimed`
+    counter)."""
+    try:
+        names = os.listdir(shm_dir)
+    except OSError:
+        return 0
+    n = 0
+    for name in names:
+        if not name.startswith(NAME_PREFIX + "_"):
+            continue
+        parts = name.split("_")
+        try:
+            pid = int(parts[1])
+        except (IndexError, ValueError):
+            continue
+        if pid == os.getpid() or _pid_alive(pid):
+            continue
+        if unlink_stale(name):
+            log.info("reclaimed stale shm segment %s (pid %d dead)",
+                     name, pid)
+            n += 1
+    return n
 
 
 def unlink_stale(name: str) -> bool:

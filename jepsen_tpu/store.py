@@ -289,6 +289,105 @@ class Store:
 
 
 # ---------------------------------------------------------------------------
+# Resumable verdict journal: verdicts.jsonl at the store root.
+#
+# An interrupted store sweep must restart where it died, not from
+# zero. Each verdict (including quarantined "unknown"s) appends one
+# JSON line — {"dir": <run dir relative to the store>, "checker",
+# "valid?", plus "quarantined"/"error" when the supervisor abandoned
+# the run} — flushed as it lands, so the journal survives SIGKILL of
+# the sweep mid-flight. `analyze-store --resume` loads it and skips
+# every journaled (dir, checker) pair, counting the recorded validity
+# toward the exit code. A line truncated by the crash is skipped on
+# load (that run simply re-checks). The journal complements the
+# per-run `.sweep-<checker>` sidecars: one O(1) append-only file to
+# scan instead of a stat per run dir, and it captures stored-fallback
+# and quarantined runs that may write nothing into their run dir.
+# ---------------------------------------------------------------------------
+
+class VerdictJournal:
+    """Append-only per-history verdict log for one store. Writes are
+    best-effort (a read-only store mount must not sink the sweep) and
+    line-buffered+flushed so a killed sweep loses at most the line in
+    flight."""
+
+    def __init__(self, path: str | os.PathLike,
+                 base: str | os.PathLike | None = None):
+        self.path = Path(path)
+        self.base = Path(base) if base is not None else None
+        self._f = None
+
+    def rel(self, run_dir) -> str:
+        """The journal's key for a run dir: relative to the store base
+        when one is set, so the journal survives the store moving (or
+        being swept from a different cwd)."""
+        if self.base is not None:
+            try:
+                return os.path.relpath(run_dir, self.base)
+            except ValueError:
+                pass
+        return str(run_dir)
+
+    def record(self, run_dir, checker: str, res: dict) -> None:
+        entry = {"dir": self.rel(run_dir), "checker": checker,
+                 "valid?": res.get("valid?")}
+        for k in ("quarantined", "error"):
+            if k in res:
+                entry[k] = res[k]
+        try:
+            if self._f is None:
+                self.path.parent.mkdir(parents=True, exist_ok=True)
+                self._f = open(self.path, "a")
+                # seal a crash-torn tail: a journal killed mid-write can
+                # end without its newline, and appending straight after
+                # those bytes would corrupt THIS record too (load skips
+                # the merged line — one verdict silently lost to resume)
+                if self._f.tell() > 0:
+                    with open(self.path, "rb") as rf:
+                        rf.seek(-1, os.SEEK_END)
+                        torn = rf.read(1) != b"\n"
+                    if torn:
+                        self._f.write("\n")
+            self._f.write(json.dumps(entry) + "\n")
+            self._f.flush()
+        except OSError:
+            log.debug("verdict journal append failed for %s",
+                      self.path, exc_info=True)
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = None
+
+    @staticmethod
+    def load(path: str | os.PathLike) -> dict[tuple[str, str], dict]:
+        """{(dir, checker): last entry} from an existing journal;
+        unparseable lines (the crash-truncated tail) are skipped."""
+        out: dict[tuple[str, str], dict] = {}
+        p = Path(path)
+        if not p.is_file():
+            return out
+        try:
+            lines = p.read_text().splitlines()
+        except OSError:
+            return out
+        for ln in lines:
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                e = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(e, dict) and "dir" in e and "checker" in e:
+                out[(str(e["dir"]), str(e["checker"]))] = e
+        return out
+
+
+# ---------------------------------------------------------------------------
 # Persistent encoded cache: encoded.v1.bin sidecars.
 #
 # Re-analysis sweeps (analyze-store --resume, repeated benches, CI) pay
@@ -584,11 +683,24 @@ def load_encoded(run_dir: str | os.PathLike, checker: str):
         import mmap as _mmap
 
         import numpy as np
+
+        from .util import with_retry
         src = _history_source(d)
         if src is None:
             return None
-        with open(p, "rb") as f:
-            mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+
+        def _map():
+            with open(p, "rb") as f:
+                return _mmap.mmap(f.fileno(), 0,
+                                  access=_mmap.ACCESS_READ)
+
+        # transient open/mmap failures (EMFILE/ENOMEM under a big
+        # sweep's pressure) get a short jittered retry before the
+        # cache degrades to a miss; a vanished sidecar fails straight
+        # to the (cheap, correct) re-encode path
+        mm = with_retry(_map, retries=2, backoff=0.005,
+                        exceptions=(OSError,), exponential=True,
+                        fatal=(FileNotFoundError,))
         if mm[:len(ENCODED_MAGIC)] != ENCODED_MAGIC:
             return None
         hlen = int.from_bytes(

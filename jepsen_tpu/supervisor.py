@@ -1,0 +1,251 @@
+"""Fault-tolerant sweep supervision: the retry/quarantine policy.
+
+Jepsen's premise is that real systems fail partway through, yet the
+analysis pipeline used to be fail-fast end to end: one corrupted
+history, one crashed pool worker, or one RESOURCE_EXHAUSTED on a
+bucket killed an entire store-wide sweep and threw away every verdict
+already computed. At production scale partial failure is the steady
+state, and — as Elle stresses — a checker must degrade to "unknown",
+never to a false verdict or a dead process. This module holds the
+policy the recovery layers share:
+
+  * **Quarantine** — a history that fails encode (worker crash,
+    truncated sidecar, parse error) or exhausts its retry budget is
+    recorded as a ``{"valid?": "unknown", "error": ...}`` verdict and
+    the sweep continues (`Quarantined` sentinel, `quarantine_verdict`).
+  * **OOM backdown** — `parallel.check_bucketed_async` catches
+    RESOURCE_EXHAUSTED / XlaRuntimeError on dispatch, splits the
+    bucket in half and retries at a halved per-slot cell budget,
+    recursing to singletons; an oversized singleton quarantines.
+  * **Watchdog** — `JEPSEN_TPU_DISPATCH_TIMEOUT_S` (default off)
+    bounds each batched device wait: the bucket dispatchers, the dense
+    long-history check, and the edge-batch kernel (shared by the wr
+    sweep and the condensed path's per-SCC classify stage). One retry,
+    then the bucket quarantines (`WatchdogTimeout`).
+  * **Self-nemesis** — `JEPSEN_TPU_FAULT_INJECT` (e.g.
+    ``encode:0.05,oom:first``) deterministically injects encode
+    failures, worker kills, and simulated OOMs so every recovery path
+    is exercisable without real faults: the checker gets its own
+    nemesis.
+
+``JEPSEN_TPU_STRICT=1`` restores the old fail-fast behavior on every
+path (injection still fires — a strict run under the nemesis dies
+loudly, which is the point of strict).
+
+Every recovery is tracer-attributed: `quarantined`, `oom_retries`,
+`bucket_splits`, `watchdog_timeouts` counters plus "quarantine" spans,
+surfaced in metrics.json and the bench JSON artifact.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import zlib
+
+_M = 1_000_000
+
+
+class InjectedFault(RuntimeError):
+    """A fault raised by the self-nemesis (JEPSEN_TPU_FAULT_INJECT)."""
+
+
+class InjectedOom(RuntimeError):
+    """A simulated device OOM ('RESOURCE_EXHAUSTED' is in the message
+    so `is_oom_error` classifies it exactly like the real thing)."""
+
+
+class WatchdogTimeout(RuntimeError):
+    """A device dispatch exceeded JEPSEN_TPU_DISPATCH_TIMEOUT_S twice."""
+
+
+class Quarantined:
+    """Per-history sentinel verdict for work the supervisor abandoned:
+    flows through `PendingVerdicts.result` / `check_bucketed` in place
+    of an anomaly dict; callers render it as a ``valid? unknown``
+    verdict (`.verdict()`), never as valid or invalid."""
+
+    __slots__ = ("stage", "error")
+
+    def __init__(self, stage: str, error: str):
+        self.stage = stage      # "encode" | "oom" | "watchdog" | "pack"
+        self.error = error
+
+    def __repr__(self) -> str:
+        return f"Quarantined({self.stage}: {self.error})"
+
+    def verdict(self, checker: str | None = None) -> dict:
+        return quarantine_verdict(self.error, self.stage, checker)
+
+
+def quarantine_verdict(error, stage: str,
+                       checker: str | None = None) -> dict:
+    """The one shape every quarantine path records: validity is
+    *unknown* (exit code 2), never false — an abandoned history is not
+    evidence of an anomaly — with the cause preserved for triage."""
+    res = {"valid?": "unknown", "error": str(error)[:500],
+           "quarantined": stage}
+    if checker is not None:
+        res["checker"] = checker
+    return res
+
+
+def strict_enabled() -> bool:
+    """JEPSEN_TPU_STRICT=1 restores fail-fast: no quarantine, no OOM
+    backdown — the first failure raises to the caller (CI bisection,
+    debugging a specific corrupt store)."""
+    return os.environ.get("JEPSEN_TPU_STRICT", "") == "1"
+
+
+def dispatch_timeout_s() -> float | None:
+    """The per-dispatch device watchdog (JEPSEN_TPU_DISPATCH_TIMEOUT_S,
+    seconds; unset/empty/<=0 disables — the default, because a healthy
+    closure on a huge bucket can legitimately run minutes)."""
+    raw = os.environ.get("JEPSEN_TPU_DISPATCH_TIMEOUT_S", "")
+    if not raw:
+        return None
+    try:
+        t = float(raw)
+    except ValueError:
+        return None
+    return t if t > 0 else None
+
+
+def is_oom_error(e: BaseException) -> bool:
+    """Device memory exhaustion, by name and message — jaxlib's
+    XlaRuntimeError isn't importable without pulling in the runtime,
+    and the RESOURCE_EXHAUSTED status string is the stable part of the
+    contract across jax versions (InjectedOom carries it too)."""
+    if isinstance(e, InjectedOom):
+        return True
+    name = type(e).__name__
+    msg = str(e)
+    return ("XlaRuntimeError" in name and
+            ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+             or "out of memory" in msg)) \
+        or "RESOURCE_EXHAUSTED" in msg
+
+
+# ---------------------------------------------------------------------------
+# Self-nemesis: deterministic fault injection (JEPSEN_TPU_FAULT_INJECT)
+# ---------------------------------------------------------------------------
+#
+# Spec grammar: comma-separated `mode:arg` pairs.
+#
+#   encode:<rate>   fail encode of the run dirs whose name hashes under
+#                   <rate> (0..1) — deterministic per run dir, so the
+#                   same histories fail in every process and on every
+#                   retry (they exhaust their budget and quarantine).
+#   encode:first / encode:<N>
+#                   fail the first (N) encodes in each process.
+#   kill:<rate|first|N>
+#                   same selection, but the POOL WORKER kills itself
+#                   with SIGKILL instead of raising — the worker-crash
+#                   nemesis. In the parent (serial fallback) it
+#                   degrades to an encode fault, never a dead sweep.
+#   oom:<first|N>   raise a simulated RESOURCE_EXHAUSTED on the first
+#                   (N) bucket dispatches of this process.
+#
+# State is process-local and rebuilt whenever the env spec changes, so
+# tests can monkeypatch the env freely.
+
+class _Injector:
+    def __init__(self, spec: str):
+        self.spec = spec
+        self.modes: dict[str, tuple[str, float]] = {}
+        self._lock = threading.Lock()
+        self._fired: dict[str, int] = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part or ":" not in part:
+                continue
+            mode, _, arg = part.partition(":")
+            mode, arg = mode.strip(), arg.strip()
+            if arg == "first":
+                self.modes[mode] = ("count", 1)
+            else:
+                try:
+                    v = float(arg)
+                except ValueError:
+                    continue
+                if "." in arg or (0 < v < 1):
+                    self.modes[mode] = ("rate", v)
+                else:
+                    self.modes[mode] = ("count", int(v))
+
+    def selects(self, mode: str, name: str | None = None) -> bool:
+        """Does `mode` fire for this event? rate-modes hash `name`
+        (deterministic everywhere); count-modes burn one of N
+        per-process charges."""
+        m = self.modes.get(mode)
+        if m is None:
+            return False
+        kind, arg = m
+        if kind == "rate":
+            h = zlib.crc32((name or "").encode()) % _M
+            return h < int(arg * _M)
+        with self._lock:
+            if self._fired.get(mode, 0) >= arg:
+                return False
+            self._fired[mode] = self._fired.get(mode, 0) + 1
+            return True
+
+
+_injector: _Injector | None = None
+_inj_lock = threading.Lock()
+
+
+def _get_injector() -> _Injector | None:
+    spec = os.environ.get("JEPSEN_TPU_FAULT_INJECT", "")
+    global _injector
+    inj = _injector
+    if inj is None or inj.spec != spec:
+        if not spec:
+            _injector = None
+            return None
+        with _inj_lock:
+            inj = _injector
+            if inj is None or inj.spec != spec:
+                inj = _injector = _Injector(spec)
+    return inj
+
+
+def reset_injection() -> None:
+    """Drop per-process injection state (tests re-arm count modes)."""
+    global _injector
+    _injector = None
+
+
+def _in_pool_worker() -> bool:
+    import multiprocessing as mp
+    return mp.parent_process() is not None
+
+
+def maybe_inject_encode_fault(run_dir) -> None:
+    """The encode-side nemesis hook (called at the top of
+    `ingest.encode_run_dir`): raises InjectedFault, or SIGKILLs the
+    current POOL WORKER for kill-mode (in the parent, kill degrades to
+    a raise — the nemesis must never kill the sweep itself)."""
+    inj = _get_injector()
+    if inj is None:
+        return
+    name = os.path.basename(str(run_dir).rstrip("/"))
+    if inj.selects("kill", name):
+        if _in_pool_worker():
+            import signal
+            os.kill(os.getpid(), signal.SIGKILL)
+        raise InjectedFault(f"injected worker kill for {name!r} "
+                            "(parent process: degraded to encode fault)")
+    if inj.selects("encode", name):
+        raise InjectedFault(f"injected encode fault for {name!r}")
+
+
+def maybe_inject_oom() -> None:
+    """The dispatch-side nemesis hook (called just before each bucket's
+    kernel enqueue in `parallel`)."""
+    inj = _get_injector()
+    if inj is None:
+        return
+    if inj.selects("oom"):
+        raise InjectedOom("RESOURCE_EXHAUSTED: injected device OOM "
+                          "(JEPSEN_TPU_FAULT_INJECT)")

@@ -187,6 +187,9 @@ class NullTracer:
     def add_span(self, name, t0, t1, track=None, clock="perf", **args):
         pass
 
+    def instant(self, name, track=None, **args):
+        pass
+
     def counter(self, name: str):
         return _NULL_METRIC
 
@@ -330,6 +333,25 @@ class Tracer:
             "name": name, "cat": "span", "ph": "X",
             "tid": self._laned_tid(track, ts, ts + dur),
             "ts": ts, "dur": dur,
+            **({"args": args} if args else {})})
+
+    def instant(self, name: str, track: str | None = None,
+                **args) -> None:
+        """A zero-duration mark ("i" event) — fault-path punctuation
+        (watchdog fired, worker lost) that has a moment but no
+        meaningful span. Lands on the calling thread's track, or a
+        named track when given."""
+        if not self._room():
+            return
+        tid = threading.get_ident()
+        if track is not None:
+            tid = self._track_tid(track)
+        elif tid not in self._threads:
+            self._threads[tid] = threading.current_thread().name
+        self._events.append({
+            "name": name, "cat": "instant", "ph": "i", "s": "t",
+            "tid": tid,
+            "ts": (time.perf_counter() - self._origin) * 1e6,
             **({"args": args} if args else {})})
 
     def _track_tid(self, name: str) -> int:

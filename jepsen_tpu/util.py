@@ -117,18 +117,34 @@ class RetryFailed(Exception):
 
 
 def with_retry(f: Callable[[], R], retries: int = 3, backoff: float = 0.0,
-               exceptions: tuple = (Exception,)) -> R:
-    """Call f, retrying up to `retries` times on the given exceptions."""
+               exceptions: tuple = (Exception,), *,
+               exponential: bool = False, fatal: tuple = ()) -> R:
+    """Call f, retrying up to `retries` times on the given exceptions.
+
+    With exponential=True each retry sleeps a jittered exponential
+    backoff — ``backoff * 2**attempt * uniform(0.5, 1.5)`` (attempt
+    counting from 0) — so a herd of workers retrying the same
+    transient failure (shm attach, sidecar mmap) decorrelates instead
+    of stampeding in lockstep. `fatal` exceptions never retry (e.g. a
+    FileNotFoundError under an OSError retry: the segment is gone, not
+    busy)."""
+    import random
     attempt = 0
     while True:
         try:
             return f()
+        except fatal:
+            raise
         except exceptions:
             attempt += 1
             if attempt > retries:
                 raise
             if backoff:
-                _time.sleep(backoff)
+                delay = backoff
+                if exponential:
+                    delay = (backoff * 2 ** (attempt - 1)
+                             * random.uniform(0.5, 1.5))
+                _time.sleep(delay)
 
 
 def timeout_call(seconds: float, f: Callable[[], R], default: Any = None) -> Any:
@@ -136,7 +152,9 @@ def timeout_call(seconds: float, f: Callable[[], R], default: Any = None) -> Any
     `seconds`; exceptions from f propagate to the caller. (On timeout the
     thread is abandoned, mirroring the reference's util/timeout which
     interrupts; Python threads can't be killed, so callers should make f
-    cooperative where it matters.)"""
+    cooperative where it matters. The worker is DAEMONIC — an abandoned
+    thread must never hold interpreter exit hostage — and named so a
+    faulthandler dump attributes it.)"""
     result: list = []
     error: list = []
 
@@ -146,7 +164,7 @@ def timeout_call(seconds: float, f: Callable[[], R], default: Any = None) -> Any
         except BaseException as e:  # noqa: BLE001 - relayed to caller
             error.append(e)
 
-    t = threading.Thread(target=run, daemon=True)
+    t = threading.Thread(target=run, daemon=True, name="timeout-call")
     t.start()
     t.join(seconds)
     if error:

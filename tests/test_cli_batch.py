@@ -408,6 +408,29 @@ def test_analyze_store_register_declined_relift_falls_back(tmp_path):
         assert "key-count" not in res
 
 
+def drop_journal_lines(store: Store, run_dir, checker=None):
+    """Simulate an interrupted sweep for one run: a sweep killed before
+    verdicting `run_dir` would never have journaled it, so tests that
+    strip its results.json/.sweep-* markers must strip its
+    verdicts.jsonl lines too."""
+    import os
+    j = store.base / "verdicts.jsonl"
+    if not j.exists():
+        return
+    rel = os.path.relpath(run_dir, store.base)
+    keep = []
+    for ln in j.read_text().splitlines():
+        try:
+            e = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if e.get("dir") == rel and (checker is None
+                                    or e.get("checker") == checker):
+            continue
+        keep.append(ln)
+    j.write_text("".join(k + "\n" for k in keep))
+
+
 def test_analyze_store_resume_skips_verdicted_runs(tmp_path, capsys):
     store = Store(tmp_path / "store")
     d1 = make_run(store, "etcd", "20200101T000000",
@@ -418,9 +441,11 @@ def test_analyze_store_resume_skips_verdicted_runs(tmp_path, capsys):
     capsys.readouterr()
     stamp1 = (d1 / "results.json").stat().st_mtime_ns
     # make d2 look un-verdicted (an interrupted run has neither the
-    # results.json nor the sidecar — the sidecar lands last)
+    # results.json nor the sidecar — the sidecar lands last — nor its
+    # verdict-journal lines)
     (d2 / "results.json").unlink()
     (d2 / ".sweep-append").unlink()
+    drop_journal_lines(store, d2)
     assert cli.analyze_store(store, checker="append", resume=True) == 0
     lines = [json.loads(ln) for ln in
              capsys.readouterr().out.strip().splitlines()]
@@ -442,6 +467,7 @@ def test_analyze_store_resume_skips_verdicted_runs(tmp_path, capsys):
     # a truncated/absent marker means the run is redone, not skipped
     (d2 / "results.json").write_text("{truncated")
     (d2 / ".sweep-wr").unlink()
+    drop_journal_lines(store, d2, "wr")
     capsys.readouterr()
     assert cli.analyze_store(store, checker="wr", resume=True) in (0, 1, 2)
     lines = [json.loads(ln) for ln in

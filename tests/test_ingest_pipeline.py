@@ -242,43 +242,53 @@ class TestPipeline:
         encs = ingest.parallel_encode(dirs, processes=0)
         delivered = 3
         stale: list[str] = []
+        tasks_box: list = []
 
-        class FakePool:
-            def __enter__(self):
-                return self
+        class FakeFut:
+            """Delivers like the executor pool: results in submit
+            order; the item past `delivered` raises (the
+            BrokenProcessPool moment of a SIGKILLed worker)."""
 
-            def __exit__(self, *exc):
-                return False
+            def __init__(self, k, task):
+                self.k = k
+                self.task = task
 
-            def imap_unordered(self, fn, tasks, chunksize=1):
-                tasks = list(tasks)
-                for k, (idx, _d, checker, name) in enumerate(tasks):
-                    if k >= delivered:
-                        raise RuntimeError("pool died mid-stream")
-                    if name is not None and k == delivered - 1:
-                        # this item's segment was written but the
-                        # parent raises before a later item; simulate
-                        # a crash AFTER segment creation for the NEXT
-                        # (undelivered) task too
-                        nxt = tasks[k + 1][3]
-                        if nxt is not None:
-                            desc = shm.export(encs[tasks[k + 1][0]],
-                                              nxt, checker)
-                            assert shm.is_descriptor(desc)
-                            stale.append(nxt)
-                    payload = (shm.export(encs[idx], name, checker)
-                               if name is not None else encs[idx])
-                    yield idx, payload, {"cache": None}, 0.0, 0.0
+            def result(self):
+                idx, _d, checker, name = self.task
+                if self.k >= delivered:
+                    raise RuntimeError("pool died mid-stream")
+                if name is not None and self.k == delivered - 1:
+                    # this item's segment was written but the parent
+                    # raises before a later item; simulate a crash
+                    # AFTER segment creation for the NEXT
+                    # (undelivered) task too
+                    nxt = tasks_box[self.k + 1][3]
+                    if nxt is not None:
+                        desc = shm.export(encs[tasks_box[self.k + 1][0]],
+                                          nxt, checker)
+                        assert shm.is_descriptor(desc)
+                        stale.append(nxt)
+                payload = (shm.export(encs[idx], name, checker)
+                           if name is not None else encs[idx])
+                return idx, payload, {"cache": None}, 0.0, 0.0
 
-        class FakeCtx:
-            def Pool(self, processes):
-                return FakePool()
+        class FakeExecutor:
+            def __init__(self, max_workers=None, mp_context=None):
+                pass
 
-        class FakeMP:
-            def get_context(self, kind):
-                return FakeCtx()
+            def submit(self, fn, task):
+                tasks_box.append(task)
+                return FakeFut(len(tasks_box) - 1, task)
 
-        monkeypatch.setattr(ingest, "mp", FakeMP())
+            def shutdown(self, wait=True, cancel_futures=False):
+                pass
+
+        def fake_as_completed(fs):
+            return iter(sorted(fs, key=lambda f: f.k))
+
+        import concurrent.futures as cf
+        monkeypatch.setattr(cf, "ProcessPoolExecutor", FakeExecutor)
+        monkeypatch.setattr(cf, "as_completed", fake_as_completed)
         info: dict = {}
         got = []
         for part in ingest.iter_encode_chunks(dirs, chunk=2,
