@@ -1213,8 +1213,29 @@ def main() -> int:
                 if isinstance(blk, dict) and not blk.get("error"):
                     out[b] = {**blk, "backend": "cpu",
                               "tpu_error": tpu_err}
+    out["lint"] = _lint_block()
     print(json.dumps(out))
     return 0
+
+
+def _lint_block() -> dict:
+    """Static-analysis posture for the BENCH artifact: rule count,
+    baseline size, suppressed/open findings — the trajectory should
+    show rules growing and suppressions shrinking. Runs in the
+    supervisor (stdlib-only, never imports JAX)."""
+    try:
+        from jepsen_tpu import lint
+        root = lint.default_root()
+        findings = lint.lint_project(root)
+        entries = lint.load_baseline(root / "lint_baseline.json")
+        res = lint.apply_baseline(findings, entries)
+        return {"rules": len(lint.rule_ids()),
+                "findings_open": len(res.kept),
+                "baseline_entries": len(entries),
+                "baseline_suppressed": len(res.suppressed),
+                "baseline_stale": len(res.stale)}
+    except Exception as e:   # a broken linter must not void the bench
+        return {"error": str(e)[:200]}
 
 
 if __name__ == "__main__":

@@ -548,8 +548,8 @@ class Linearizable(Checker):
         self.backend = backend
         # bounded-frontier arena size; None = JEPSEN_TPU_FRONTIER or 512
         if frontier is None:
-            import os
-            frontier = int(os.environ.get("JEPSEN_TPU_FRONTIER", 512))
+            from .. import gates
+            frontier = gates.get("JEPSEN_TPU_FRONTIER")
         self.frontier = frontier
 
     def _cpu(self, history: list) -> dict:
@@ -607,8 +607,8 @@ class Linearizable(Checker):
             # the CLI communicates --backend via JEPSEN_TPU_BACKEND and
             # constructs checkers with "auto": honor an env-requested
             # race here, where the race is implemented
-            import os
-            backend = os.environ.get("JEPSEN_TPU_BACKEND") or "auto"
+            from .. import gates
+            backend = gates.get("JEPSEN_TPU_BACKEND") or "auto"
         if backend == "race":
             if resolve_backend("auto") != "tpu":
                 return [self._cpu(hs) for hs in histories]

@@ -102,9 +102,6 @@ def pack_batch(encs: list[EncodedHistory],
             "process": process, "shape": shape}
 
 
-_env_warned = False
-
-
 def fused_classify_enabled() -> bool:
     """One home for the JEPSEN_TPU_FUSED_CLASSIFY gate (default on):
     classify dispatches run the fused detect/classify kernel — one
@@ -112,9 +109,9 @@ def fused_classify_enabled() -> bool:
     a `lax.cond` that only fires when some history in the batch is
     cyclic. `=0` restores the separate detect-then-classify re-dispatch
     (the pre-fusion two-pass strategy) for A/B runs."""
-    import os
+    from ... import gates
 
-    return os.environ.get("JEPSEN_TPU_FUSED_CLASSIFY", "1") != "0"
+    return gates.get("JEPSEN_TPU_FUSED_CLASSIFY")
 
 
 def resolve_formulation(use_pallas: bool | None = None,
@@ -133,19 +130,12 @@ def resolve_formulation(use_pallas: bool | None = None,
     stay XLA for the collectives) and a per-VARIANT lowering probe —
     an int8-specific Mosaic regression degrades to the XLA matmul
     instead of breaking production."""
-    import os
+    from ... import gates
 
     from . import pallas_square
-    env = os.environ.get("JEPSEN_TPU_CLOSURE", "").strip()
-    if env not in ("", "bf16", "int8", "pallas", "pallas-int8"):
-        global _env_warned
-        if not _env_warned:
-            _env_warned = True
-            import logging
-            logging.getLogger(__name__).warning(
-                "unrecognized JEPSEN_TPU_CLOSURE=%r (want bf16|int8|"
-                "pallas|pallas-int8); using the auto default", env)
-        env = ""
+    # the registry validates the choice set and warns once on an
+    # unrecognized value, falling back to the auto default ("")
+    env = gates.get("JEPSEN_TPU_CLOSURE")
     if use_int8 is None:
         # auto default is int8: the boolean closure is exact in either
         # arithmetic, and int8 won the race on BOTH measured backends —

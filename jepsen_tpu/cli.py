@@ -22,7 +22,7 @@ import sys
 from pathlib import Path
 from typing import Callable
 
-from . import core, trace
+from . import core, gates, trace
 from .store import Store
 
 log = logging.getLogger(__name__)
@@ -88,11 +88,10 @@ def apply_trace_opts(args: argparse.Namespace) -> None:
     layer reads (JEPSEN_TPU_TRACE / JEPSEN_TPU_JAX_PROFILE), so
     embedded callers and subprocesses see the same choice."""
     if getattr(args, "trace", None) is not None:
-        os.environ["JEPSEN_TPU_TRACE"] = "1" if args.trace else "0"
+        gates.export("JEPSEN_TPU_TRACE", args.trace)
         trace.reset()
     if getattr(args, "jax_profile", None) is not None:
-        os.environ["JEPSEN_TPU_JAX_PROFILE"] = \
-            "1" if args.jax_profile else "0"
+        gates.export("JEPSEN_TPU_JAX_PROFILE", args.jax_profile)
 
 
 def _trace_path_of(test: dict) -> str | None:
@@ -199,10 +198,22 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
     p_serve.add_argument("--host", default="0.0.0.0")
     p_serve.add_argument("--store", default="store")
 
+    from . import lint as _lint   # stdlib-only, import-cheap
+    p_lint = sub.add_parser(
+        "lint",
+        help="self-hosted static analysis (gate registry, JAX "
+             "hazards, concurrency, shm lifecycle, tracer discipline)")
+    _lint.add_args(p_lint)
+
     try:
         args = parser.parse_args(argv)
     except SystemExit as e:
         return 254 if e.code not in (0, None) else 0
+
+    if args.command == "lint":
+        # no logging/backend/trace setup: lint parses source, it never
+        # imports or executes the target package
+        return _lint.run_from_args(args)
 
     logging.basicConfig(
         level=logging.INFO,
@@ -226,7 +237,7 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
     # Every auto-backend checker constructed from here on resolves per
     # this process-wide choice (devices.resolve_backend).
     if getattr(args, "backend", None) and args.backend != "auto":
-        os.environ["JEPSEN_TPU_BACKEND"] = args.backend
+        gates.export("JEPSEN_TPU_BACKEND", args.backend)
     apply_trace_opts(args)
 
     try:
@@ -426,7 +437,7 @@ def _analyze_store_impl(store: Store, checker: str = "append",
     # sweep through the host oracle. Auto stays on the batched kernels:
     # they run on whatever devices exist — that's the north-star sweep,
     # and on CPU-only hosts it doubles as the virtual-mesh dryrun.
-    host_only = _os.environ.get("JEPSEN_TPU_BACKEND") == "cpu"
+    host_only = gates.get("JEPSEN_TPU_BACKEND") == "cpu"
 
     # Encodable histories get the batched device sweep; the rest fall
     # back to their own stored checker host-side. Ingest shards run

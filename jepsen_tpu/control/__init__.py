@@ -28,6 +28,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from .. import gates as _gates
 from ..util import real_pmap
 
 DEFAULT_SSH_OPTS = (
@@ -121,7 +122,10 @@ class SSHRemote(Remote):
     # ssh exits 255 for its OWN failures — but so may the remote
     # command. Disambiguate by echoing the command's exit status to
     # stderr from the remote shell: marker present = the command ran.
-    _EC_MARK = "__JEPSEN_TPU_EC:"
+    # The marker string is a registered protocol constant
+    # (gates.py: JEPSEN_TPU_EC) so the namespace scanner accounts
+    # for it.
+    _EC_MARK = _gates.get("JEPSEN_TPU_EC")
 
     def execute(self, spec: dict, cmd: str, stdin: str = "") -> Result:
         wrapped = (f"( {cmd}\n); __jec=$?; "

@@ -21,6 +21,8 @@ import os
 import subprocess
 import sys
 
+from . import gates
+
 # Result of the one-shot default-backend probe: None = not yet run,
 # (True, None) = healthy, (False, "err...") = dead/unreachable.
 _probe_result: tuple[bool, str | None] | None = None
@@ -48,7 +50,7 @@ class BackendUnavailable(RuntimeError):
 
 
 def probe_timeout() -> float:
-    return float(os.environ.get("JEPSEN_TPU_PROBE_TIMEOUT", "120"))
+    return gates.get("JEPSEN_TPU_PROBE_TIMEOUT")
 
 
 def _backends_already_alive() -> bool:
@@ -124,7 +126,7 @@ def _pin_platform(want: str) -> None:
 
 
 def _requested_platform() -> str | None:
-    plat = os.environ.get("JEPSEN_TPU_PLATFORM")
+    plat = gates.get("JEPSEN_TPU_PLATFORM")
     want = plat or os.environ.get("JAX_PLATFORMS")
     if want and "axon" not in want:
         _pin_platform(want)
@@ -147,7 +149,7 @@ def _cpu_only_pin() -> bool:
     device transport (e.g. the axon plugin exporting
     JAX_PLATFORMS=axon,cpu) still needs the bounded probe: its
     transport may be down, and in-process init would wedge."""
-    want = os.environ.get("JEPSEN_TPU_PLATFORM") \
+    want = gates.get("JEPSEN_TPU_PLATFORM") \
         or os.environ.get("JAX_PLATFORMS")
     if not want:
         return False
@@ -199,7 +201,7 @@ def device_platform(devices: list | None = None) -> str:
         import jax
         devs = jax.devices()
         return devs[0].platform if devs else "none"
-    want = os.environ.get("JEPSEN_TPU_PLATFORM") \
+    want = gates.get("JEPSEN_TPU_PLATFORM") \
         or os.environ.get("JAX_PLATFORMS")
     if want:
         plats = [p.strip() for p in want.split(",") if p.strip()]
@@ -241,7 +243,7 @@ def resolve_backend(backend: str = "auto") -> str:
         backend = "auto"
     if backend != "auto":
         return backend
-    env = os.environ.get("JEPSEN_TPU_BACKEND")
+    env = gates.get("JEPSEN_TPU_BACKEND")
     if env and env not in ("auto", "race"):
         return env
     return "tpu" if accelerator_available() else "cpu"

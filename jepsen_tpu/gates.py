@@ -1,0 +1,285 @@
+"""jepsen_tpu.gates — THE registry of `JEPSEN_TPU_*` environment gates.
+
+Every env var this package reads is declared here exactly once — name,
+type, default, one doc line — and read only through the typed
+accessors below. The rest of the package holds no raw
+`os.environ`/`os.getenv` of a `JEPSEN_TPU_*` name: the self-hosted
+linter (``python -m jepsen_tpu.cli lint``, rule JT-GATE-001) fails the
+build on one, and rule JT-GATE-003/004 fail it when a registered gate
+is missing from the README env-gate table (rendered from this registry
+by `render_env_table`) or from test coverage. That closes the drift
+loop that produced 21 ad-hoc gate reads with three different truthy
+parses: a gate can no longer exist without a declaration, a doc row
+and a test.
+
+Parse semantics are normalized to two shapes (recorded per gate by
+`kind` + `default`):
+
+  * bool, default on  — unset or anything but ``"0"`` is True
+    (the historical ``!= "0"`` convention of the default-on gates);
+  * bool, default off — only a set, non-empty, non-``"0"`` value is
+    True. This widens the old ``== "1"`` gates (STRICT, JAX_PROFILE,
+    PIPELINE) to accept ``yes``/``true`` spellings, and FIXES
+    ``JEPSEN_TPU_NO_NATIVE=0``, which the old truthy-string parse
+    read as *disable native* (see MIGRATING.md);
+  * int/float — parsed, falling back to the declared default on
+    malformed values instead of crashing the run (the old
+    ``int(os.environ[...])`` reads raised ValueError);
+  * str — raw value, empty string treated as unset;
+  * marker — not an env var at all: a protocol constant that shares
+    the namespace (``JEPSEN_TPU_EC`` is the ssh exit-code marker
+    string), registered so the name scanner and the README table can
+    account for it.
+
+This module is the ONE file where `os.environ` access to
+`JEPSEN_TPU_*` names is sanctioned; `export`/`unset` are the writer
+counterparts the CLI uses to hand a flag down to subprocesses.
+Stdlib-only, import-cheap: every hot path reads gates at call time, so
+tests can monkeypatch the env freely.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger(__name__)
+
+PREFIX = "JEPSEN_TPU_"
+
+#: Parse kinds a gate may declare.
+KINDS = ("bool", "int", "float", "str", "marker")
+
+
+class Gate:
+    """One declared gate: name, kind, default, one doc line."""
+
+    __slots__ = ("name", "kind", "default", "doc", "choices")
+
+    def __init__(self, name: str, kind: str, default, doc: str,
+                 choices: tuple[str, ...] | None = None):
+        assert kind in KINDS, kind
+        assert name.startswith(PREFIX), name
+        self.name = name
+        self.kind = kind
+        self.default = default
+        self.doc = doc
+        self.choices = choices
+
+    def parse(self, raw: str | None):
+        """Typed value for a raw env string (None = unset)."""
+        if self.kind == "marker":
+            return self.default
+        if raw is None or (raw == "" and self.kind != "bool"):
+            return self.default
+        if self.kind == "bool":
+            if self.default:
+                return raw != "0"
+            return raw not in ("", "0")
+        if self.kind == "int":
+            try:
+                return int(raw)
+            except ValueError:
+                log.debug("malformed %s=%r; using default %r",
+                          self.name, raw, self.default)
+                return self.default
+        if self.kind == "float":
+            try:
+                return float(raw)
+            except ValueError:
+                log.debug("malformed %s=%r; using default %r",
+                          self.name, raw, self.default)
+                return self.default
+        # str — stripped: a trailing space from a shell export or CI
+        # YAML must not turn a valid choice into "unrecognized"
+        raw = raw.strip()
+        if raw == "":
+            return self.default
+        if self.choices is not None and raw not in self.choices:
+            _warn_once(self.name, raw, self.choices)
+            return self.default
+        return raw
+
+    def default_str(self) -> str:
+        """The README-table rendering of the default."""
+        if self.kind == "marker":
+            return "—"
+        if self.kind == "bool":
+            return "`1`" if self.default else "off"
+        if self.default is None or self.default == "":
+            return "off"
+        return f"`{self.default}`"
+
+
+_warned: set[str] = set()
+
+
+def _warn_once(name: str, raw: str, choices) -> None:
+    if name in _warned:
+        return
+    _warned.add(name)
+    want = "|".join(c for c in choices if c)
+    log.warning("unrecognized %s=%r (want %s); using the default",
+                name, raw, want)
+
+
+# ---------------------------------------------------------------------------
+# The registry. Ordering is the README table ordering.
+# ---------------------------------------------------------------------------
+
+GATES: dict[str, Gate] = {}
+
+
+def _g(name: str, kind: str, default, doc: str,
+       choices: tuple[str, ...] | None = None) -> None:
+    assert name not in GATES, f"duplicate gate {name}"
+    GATES[name] = Gate(name, kind, default, doc, choices)
+
+
+# -- observability ----------------------------------------------------------
+_g("JEPSEN_TPU_TRACE", "bool", True,
+   "`0`: no trace/metrics files, no-op spans (<1µs each — the "
+   "dp8-efficiency floor is unaffected)")
+_g("JEPSEN_TPU_TRACE_MAX_EVENTS", "int", 200_000,
+   "bounded tracer event buffer; overflow is counted "
+   "(`dropped_events`), never silent")
+_g("JEPSEN_TPU_JAX_PROFILE", "bool", False,
+   "`1`: wrap the run in a `jax.profiler` capture "
+   "(`<run-dir>/jax-profile`; `--jax-profile` sets it)")
+# -- kernels / backend ------------------------------------------------------
+_g("JEPSEN_TPU_BACKEND", "str", None,
+   "analysis backend override: `tpu`|`cpu`|`race` (the CLI's "
+   "`--backend` exports it; `auto` resolves by hardware)")
+_g("JEPSEN_TPU_PLATFORM", "str", None,
+   "pin the jax platform set (e.g. `cpu`, `tpu`, `axon,cpu`) before "
+   "backend init; also selects the real-hardware test tier")
+_g("JEPSEN_TPU_CLOSURE", "str", "",
+   "closure formulation: `bf16`|`int8`|`pallas`|`pallas-int8` "
+   "(auto default is the XLA int8 matmul pipeline)",
+   choices=("", "bf16", "int8", "pallas", "pallas-int8"))
+_g("JEPSEN_TPU_FUSED_CLASSIFY", "bool", True,
+   "`0`: detect-then-classify two-pass instead of the fused kernel")
+_g("JEPSEN_TPU_FRONTIER", "int", 512,
+   "bounded-frontier arena size for the sorted-frontier register "
+   "kernel")
+_g("JEPSEN_TPU_PROBE_TIMEOUT", "float", 120.0,
+   "seconds the bounded subprocess backend probe may take before the "
+   "platform is declared unreachable")
+# -- ingest / native --------------------------------------------------------
+_g("JEPSEN_TPU_NATIVE_INGEST", "bool", True,
+   "`0`: Python jsonl→tensor encoder")
+_g("JEPSEN_TPU_NATIVE_SPLIT", "bool", True,
+   "`0`: Python per-key splitter for register sweeps")
+_g("JEPSEN_TPU_NO_NATIVE", "bool", False,
+   "set (non-`0`): disable every ctypes-loaded helper")
+_g("JEPSEN_TPU_NATIVE_LIB_DIR", "str", None,
+   "load the native `.so`s from this directory instead of "
+   "building into `native/build/` — no rebuild, no silent fallback "
+   "to a production lib (`make native-sanitize` points it at the "
+   "ASan/UBSan instrumented builds)")
+_g("JEPSEN_TPU_SHM_INGEST", "bool", True,
+   "`0`: pool-encoded histories ride the classic pickle pipe instead "
+   "of `multiprocessing.shared_memory` descriptors (also "
+   "auto-falls-back when /dev/shm is unusable)")
+_g("JEPSEN_TPU_PIPELINE", "bool", False,
+   "set: force the multi-process ingest pipeline even on single-core "
+   "hosts")
+_g("JEPSEN_TPU_ENCODE_CACHE", "bool", True,
+   "`0`: no `encoded.v1.bin` sidecar reads or writes — every sweep "
+   "re-parses")
+_g("JEPSEN_TPU_ENCODE_CACHE_WRITE", "bool", True,
+   "`0`: read-only cache (hit existing sidecars, never write — e.g. "
+   "a read-only store mount)")
+_g("JEPSEN_TPU_PACK_THREAD", "bool", True,
+   "`0`: bucket packing + `device_put` stay inline on the "
+   "dispatching thread instead of the dedicated pack-h2d thread")
+# -- robustness -------------------------------------------------------------
+_g("JEPSEN_TPU_STRICT", "bool", False,
+   "set: restore fail-fast — no quarantine, no OOM backdown; the "
+   "first failure raises (CI bisection, debugging one corrupt store)")
+_g("JEPSEN_TPU_DISPATCH_TIMEOUT_S", "float", None,
+   "per-dispatch device watchdog: bound each `block_until_ready` to "
+   "this many seconds, retry once, then quarantine the bucket")
+_g("JEPSEN_TPU_FAULT_INJECT", "str", "",
+   "self-nemesis spec, e.g. `encode:0.05,oom:first` — deterministic "
+   "encode faults / worker kills / simulated OOMs (see Robustness)")
+# -- protocol markers (not env vars) ----------------------------------------
+_g("JEPSEN_TPU_EC", "marker", "__JEPSEN_TPU_EC:",
+   "ssh exit-code marker string the control layer echoes from remote "
+   "shells to disambiguate ssh's own 255 from the command's — a "
+   "protocol constant, not an env var")
+
+
+# ---------------------------------------------------------------------------
+# Accessors — the only sanctioned JEPSEN_TPU_* env reads/writes.
+# ---------------------------------------------------------------------------
+
+def gate(name: str) -> Gate:
+    """The declaration for `name` (KeyError on an unregistered gate —
+    reads of undeclared names must fail loudly, not invent a gate)."""
+    return GATES[name]
+
+
+def get(name: str):
+    """The typed value of gate `name` from the current environment."""
+    g = GATES[name]
+    if g.kind == "marker":
+        return g.default
+    return g.parse(os.environ.get(name))
+
+
+def get_raw(name: str) -> str | None:
+    """The raw env string of a REGISTERED gate (None = unset) — for
+    the rare caller that needs the spelling, not the parse (e.g. the
+    fault injector keying its state on the exact spec string)."""
+    GATES[name]  # KeyError on unregistered names
+    return os.environ.get(name)
+
+
+def is_set(name: str) -> bool:
+    """Is the gate explicitly set (non-empty) in the environment?"""
+    GATES[name]
+    return bool(os.environ.get(name))
+
+
+def export(name: str, value) -> None:
+    """Write gate `name` into the environment (the CLI flag→env
+    export; subprocesses and embedded callers then see the choice).
+    Booleans serialize to the canonical `1`/`0`."""
+    g = GATES[name]
+    assert g.kind != "marker", f"{name} is a protocol marker, not a gate"
+    if isinstance(value, bool):
+        value = "1" if value else "0"
+    os.environ[name] = str(value)
+
+
+def unset(name: str) -> None:
+    """Remove gate `name` from the environment."""
+    GATES[name]
+    os.environ.pop(name, None)
+
+
+# ---------------------------------------------------------------------------
+# README rendering — the env-gate table is generated, never hand-kept.
+# ---------------------------------------------------------------------------
+
+#: Markers delimiting the generated block in README.md; lint rule
+#: JT-GATE-003 fails when the committed block drifts from the registry.
+TABLE_BEGIN = "<!-- env-gates:begin (generated by jepsen_tpu.gates) -->"
+TABLE_END = "<!-- env-gates:end -->"
+
+
+def render_env_table() -> str:
+    """The README env-gate table, one row per registered gate. Literal
+    `|` in a doc line is escaped: markdown splits cells on every
+    unescaped pipe, code spans included."""
+    lines = ["| gate | default | meaning |", "|---|---|---|"]
+    for g in GATES.values():
+        doc = g.doc.replace("|", "\\|")
+        lines.append(f"| `{g.name}` | {g.default_str()} | {doc} |")
+    return "\n".join(lines)
+
+
+def render_env_block() -> str:
+    """The full generated README block, markers included."""
+    return f"{TABLE_BEGIN}\n{render_env_table()}\n{TABLE_END}"

@@ -282,9 +282,9 @@ def native_split_enabled() -> bool:
     """One home for the JEPSEN_TPU_NATIVE_SPLIT gate (default on) so
     the register sweep and the bench's reporting can't drift apart:
     `=0` pins the pure-Python relift+subhistories splitter."""
-    import os
+    from . import gates
 
-    return os.environ.get("JEPSEN_TPU_NATIVE_SPLIT", "1") != "0"
+    return gates.get("JEPSEN_TPU_NATIVE_SPLIT")
 
 
 def _subhistories_from_ids(history: list, key_ids, keys: list) -> dict:

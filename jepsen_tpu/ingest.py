@@ -36,7 +36,8 @@ def load_history_dir(run_dir: str | os.PathLike) -> list[dict]:
 def native_ingest_enabled() -> bool:
     """One home for the JEPSEN_TPU_NATIVE_INGEST gate (default on) so
     the sweep and the bench's reporting can't drift apart."""
-    return os.environ.get("JEPSEN_TPU_NATIVE_INGEST", "1") != "0"
+    from . import gates
+    return gates.get("JEPSEN_TPU_NATIVE_INGEST")
 
 
 def encode_run_dir(run_dir: str | os.PathLike, checker: str = "append",
@@ -297,8 +298,9 @@ def iter_encode_chunks(run_dirs: Sequence[str | os.PathLike],
         from . import native_lib
         native_lib.hist_lib()
     if processes is None:
+        from . import gates
         ncpu = os.cpu_count() or 1
-        force = os.environ.get("JEPSEN_TPU_PIPELINE") == "1"
+        force = gates.get("JEPSEN_TPU_PIPELINE")
         processes = min(len(dirs), ncpu) if ncpu > 1 or force else 0
     else:
         # never spawn more workers than there are run dirs to parse

@@ -1,0 +1,460 @@
+"""jepsen_tpu.lint — the self-hosted static-analysis pass.
+
+The package's core invariants — gates declared once in
+`jepsen_tpu.gates`, no host-sync hazards in jitted code, spawn-only
+process pools, lexically-paired shm unlink, spans as context managers,
+metric names from the declared registry — were enforced only by review
+and by runtime failure. Elle's whole thesis (PAPERS.md, arxiv
+2003.10554) is that checking artifacts mechanically beats trusting
+humans to eyeball them; this module applies that to our own source.
+
+Architecture:
+
+  * `Finding` — one violation: rule id, file:line, message, fix hint;
+    machine-readable via `--format json` for CI.
+  * module rules (`ModuleRule`) — pure-AST passes over each file of
+    the package, grouped in rule families: JT-GATE (env-gate
+    registry), JT-JAX (host-sync/recompile hazards), JT-THREAD
+    (concurrency discipline), JT-SHM (shared-memory lifecycle),
+    JT-TRACE (tracer/span + metric-name discipline).
+  * project rules (`ProjectRule`) — whole-repo checks that need more
+    than one file: the README env-gate table must match the registry
+    render; every registered gate must appear in test coverage.
+  * suppressions — inline `# jt-lint: ok JT-XXX-000 (reason)` on the
+    offending line (or alone on the line above) for sanctioned
+    sites, and a repo-level `lint_baseline.json` of justified
+    `{rule, path, max, reason}` entries for grandfathered debt. A
+    baseline entry that no longer matches anything is reported as
+    stale so suppressions can only shrink.
+
+The linter is itself tier-1: `tests/test_lint.py` runs it over
+`jepsen_tpu/` at every commit (the self-hosting contract), and
+`python -m jepsen_tpu.cli lint` / `make lint` expose the same pass to
+CI with the standard exit codes (0 clean, 1 findings, 254 usage).
+Stdlib-only: `ast` + `re`, no third-party dependencies, and target
+files are parsed, never imported or executed.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Iterator
+
+__all__ = [
+    "Finding", "ModuleCtx", "ModuleRule", "ProjectRule", "ProjectCtx",
+    "all_rules", "rule_ids", "lint_paths", "lint_project", "apply_baseline",
+    "load_baseline", "main",
+]
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One violation: where, which rule, what, and how to fix it."""
+
+    rule: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    message: str
+    hint: str = ""
+
+    def render(self) -> str:
+        h = f"  [fix: {self.hint}]" if self.hint else ""
+        return f"{self.path}:{self.line}: {self.rule} {self.message}{h}"
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "hint": self.hint}
+
+
+# ---------------------------------------------------------------------------
+# Per-module context: one parse, shared by every rule.
+# ---------------------------------------------------------------------------
+
+#: `# jt-lint: ok JT-GATE-001 (why)` — rule ids may be comma-separated;
+#: a family prefix (`JT-GATE`) suppresses the whole family on that line.
+_SUPPRESS_RE = re.compile(
+    r"#\s*jt-lint:\s*ok\s+([A-Z][A-Z0-9-]*(?:\s*,\s*[A-Z][A-Z0-9-]*)*)")
+
+
+class ModuleCtx:
+    """One target file: source, AST, per-line suppressions."""
+
+    def __init__(self, path: Path, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.tree = ast.parse(source, filename=rel)
+        self.lines = source.splitlines()
+        # line number -> set of suppressed rule-id/family strings
+        self.suppressions: dict[int, set[str]] = {}
+        for i, ln in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(ln)
+            if not m:
+                continue
+            ids = {s.strip() for s in m.group(1).split(",")}
+            self.suppressions.setdefault(i, set()).update(ids)
+            # a comment-only line suppresses the line below it too
+            if ln.lstrip().startswith("#"):
+                self.suppressions.setdefault(i + 1, set()).update(ids)
+
+    def suppressed(self, f: Finding) -> bool:
+        ids = self.suppressions.get(f.line)
+        if not ids:
+            return False
+        return any(f.rule == s or f.rule.startswith(s + "-") for s in ids)
+
+
+class ProjectCtx:
+    """Whole-repo context for project rules: the repo root plus the
+    already-parsed package modules."""
+
+    def __init__(self, root: Path, modules: list[ModuleCtx]):
+        self.root = root
+        self.modules = modules
+
+
+class ModuleRule:
+    """A per-file AST pass. Subclasses set `id`/`hint` and implement
+    `check(ctx)` yielding Findings."""
+
+    id: str = ""
+    hint: str = ""
+    doc: str = ""
+
+    def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: ModuleCtx, node: ast.AST | int,
+                message: str) -> Finding:
+        line = node if isinstance(node, int) \
+            else getattr(node, "lineno", 1)
+        return Finding(self.id, ctx.rel, line, message, self.hint)
+
+
+class ProjectRule:
+    """A whole-repo pass (README drift, test coverage)."""
+
+    id: str = ""
+    hint: str = ""
+    doc: str = ""
+
+    def check_project(self, ctx: ProjectCtx) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# AST helpers shared by the rule modules.
+# ---------------------------------------------------------------------------
+
+def dotted(node: ast.AST) -> str | None:
+    """`a.b.c` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def const_str(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def call_name(node: ast.Call) -> str | None:
+    """Dotted name of a call's callee, if it is a plain name chain."""
+    return dotted(node.func)
+
+
+def walk_functions(tree: ast.AST) -> Iterator[ast.FunctionDef]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node  # type: ignore[misc]
+
+
+# ---------------------------------------------------------------------------
+# Rule registry.
+# ---------------------------------------------------------------------------
+
+def all_rules() -> tuple[list[ModuleRule], list[ProjectRule]]:
+    """Every registered rule instance (module rules, project rules)."""
+    from . import (rules_concurrency, rules_gates, rules_jax, rules_shm,
+                   rules_trace)
+    mod: list[ModuleRule] = []
+    proj: list[ProjectRule] = []
+    for m in (rules_gates, rules_jax, rules_concurrency, rules_shm,
+              rules_trace):
+        for r in m.RULES:
+            (proj if isinstance(r, ProjectRule) else mod).append(r)
+    return mod, proj
+
+
+def rule_ids() -> list[str]:
+    mod, proj = all_rules()
+    return sorted(r.id for r in mod + proj)
+
+
+def rule_table() -> list[dict]:
+    """id/doc/hint rows for the README rule-id table and --list-rules."""
+    mod, proj = all_rules()
+    return [{"id": r.id, "doc": r.doc, "hint": r.hint}
+            for r in sorted(mod + proj, key=lambda r: r.id)]
+
+
+# ---------------------------------------------------------------------------
+# Runners.
+# ---------------------------------------------------------------------------
+
+#: Files exempt from everything: generated/vendored trees would go
+#: here. (The package has none today.)
+_SKIP_PARTS = {"__pycache__"}
+
+
+def iter_py_files(base: Path) -> Iterator[Path]:
+    for p in sorted(base.rglob("*.py")):
+        if not _SKIP_PARTS.intersection(p.parts):
+            yield p
+
+
+def _load_ctx(path: Path, root: Path) -> ModuleCtx | None:
+    try:
+        src = path.read_text(encoding="utf-8")
+        rel = path.resolve().relative_to(root.resolve()).as_posix() \
+            if path.resolve().is_relative_to(root.resolve()) \
+            else path.as_posix()
+        return ModuleCtx(path, rel, src)
+    except (OSError, SyntaxError, ValueError) as e:
+        # a file the linter cannot parse is itself a finding, surfaced
+        # by the caller via the sentinel
+        raise LintParseError(path, e) from e
+
+
+class LintParseError(Exception):
+    def __init__(self, path: Path, err: Exception):
+        super().__init__(f"{path}: {err}")
+        self.path = path
+        self.err = err
+
+
+def lint_paths(paths: Iterable[Path], root: Path,
+               rules: list[ModuleRule] | None = None) -> list[Finding]:
+    """Run the module rules over explicit files (fixture tests use
+    this); inline suppressions apply, the baseline does not."""
+    if rules is None:
+        rules, _ = all_rules()
+    out: list[Finding] = []
+    for p in paths:
+        try:
+            ctx = _load_ctx(Path(p), root)
+        except LintParseError as e:
+            out.append(Finding("JT-PARSE", str(e.path), 1,
+                               f"unparseable: {e.err}",
+                               "fix the syntax error"))
+            continue
+        for r in rules:
+            for f in r.check(ctx):
+                if not ctx.suppressed(f):
+                    out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+def lint_project(root: Path,
+                 package_dir: Path | None = None) -> list[Finding]:
+    """The full pass: module rules over every file of the package,
+    then the project rules (README drift, gate test coverage).
+    Baseline NOT yet applied — see `apply_baseline`."""
+    root = Path(root)
+    if package_dir is None:
+        package_dir = root / "jepsen_tpu"
+    mod_rules, proj_rules = all_rules()
+    findings: list[Finding] = []
+    modules: list[ModuleCtx] = []
+    for p in iter_py_files(package_dir):
+        try:
+            ctx = _load_ctx(p, root)
+        except LintParseError as e:
+            findings.append(Finding("JT-PARSE", str(e.path), 1,
+                                    f"unparseable: {e.err}",
+                                    "fix the syntax error"))
+            continue
+        modules.append(ctx)
+        for r in mod_rules:
+            for f in r.check(ctx):
+                if not ctx.suppressed(f):
+                    findings.append(f)
+    pctx = ProjectCtx(root, modules)
+    for r in proj_rules:
+        findings.extend(r.check_project(pctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Baseline.
+# ---------------------------------------------------------------------------
+
+@dataclass
+class BaselineResult:
+    kept: list[Finding] = field(default_factory=list)
+    suppressed: list[Finding] = field(default_factory=list)
+    stale: list[dict] = field(default_factory=list)
+
+
+def load_baseline(path: Path) -> list[dict]:
+    """`lint_baseline.json` entries: {rule, path, max, reason}. Every
+    entry MUST carry a non-empty reason — an unjustified suppression
+    is rejected (that's the point of the file)."""
+    try:
+        data = json.loads(Path(path).read_text())
+    except FileNotFoundError:
+        return []
+    entries = data.get("entries", []) if isinstance(data, dict) else data
+    out = []
+    for e in entries:
+        if not isinstance(e, dict) or not e.get("rule") \
+                or not e.get("path") or not str(e.get("reason", "")).strip():
+            raise ValueError(
+                f"baseline entry needs rule/path/reason: {e!r}")
+        out.append({"rule": e["rule"], "path": e["path"],
+                    "max": int(e.get("max", 1)),
+                    "reason": str(e["reason"])})
+    return out
+
+
+def apply_baseline(findings: list[Finding],
+                   entries: list[dict]) -> BaselineResult:
+    """Suppress up to `max` findings per (rule, path) entry; entries
+    that match nothing are reported stale (suppressions must shrink,
+    not accrete)."""
+    res = BaselineResult()
+    budget: dict[tuple[str, str], int] = {}
+    for e in entries:
+        budget[(e["rule"], e["path"])] = \
+            budget.get((e["rule"], e["path"]), 0) + e["max"]
+    used: dict[tuple[str, str], int] = {}
+    for f in findings:
+        key = (f.rule, f.path)
+        if used.get(key, 0) < budget.get(key, 0):
+            used[key] = used.get(key, 0) + 1
+            res.suppressed.append(f)
+        else:
+            res.kept.append(f)
+    for e in entries:
+        if used.get((e["rule"], e["path"]), 0) == 0:
+            res.stale.append(e)
+    return res
+
+
+# ---------------------------------------------------------------------------
+# CLI entry (`python -m jepsen_tpu.cli lint` and `python -m
+# jepsen_tpu.lint` both land here).
+# ---------------------------------------------------------------------------
+
+def default_root() -> Path:
+    """The repo root: the directory holding the `jepsen_tpu` package."""
+    return Path(__file__).resolve().parents[2]
+
+
+def run(paths: list[str] | None = None, *, root: Path | None = None,
+        baseline: str | None = None, fmt: str = "text",
+        out=None) -> int:
+    """The lint run behind the CLI. Returns the exit code (0 clean,
+    1 findings). `paths`: explicit files/dirs to lint with the module
+    rules only; default is the full project pass (module + project
+    rules + baseline)."""
+    out = out if out is not None else sys.stdout
+    root = Path(root) if root is not None else default_root()
+    if paths:
+        files: list[Path] = []
+        for p in paths:
+            pp = Path(p)
+            files.extend(iter_py_files(pp) if pp.is_dir() else [pp])
+        findings = lint_paths(files, root)
+        res = BaselineResult(kept=findings)
+        entries: list[dict] = []
+    else:
+        findings = lint_project(root)
+        bpath = Path(baseline) if baseline \
+            else root / "lint_baseline.json"
+        try:
+            entries = load_baseline(bpath)
+        except ValueError as e:
+            print(f"lint: bad baseline: {e}", file=sys.stderr)
+            return 254
+        res = apply_baseline(findings, entries)
+
+    if fmt == "json":
+        print(json.dumps({
+            "findings": [f.as_dict() for f in res.kept],
+            "suppressed": len(res.suppressed),
+            "baseline_entries": len(entries),
+            "baseline_stale": res.stale,
+            "rules": len(rule_ids()),
+        }, indent=2), file=out)
+    else:
+        for f in res.kept:
+            print(f.render(), file=out)
+        for e in res.stale:
+            print(f"lint: stale baseline entry (matched nothing): "
+                  f"{e['rule']} {e['path']} — remove it", file=out)
+        n = len(res.kept)
+        print(f"lint: {n} finding{'s' if n != 1 else ''} "
+              f"({len(res.suppressed)} baseline-suppressed, "
+              f"{len(rule_ids())} rules)", file=out)
+    # stale baseline entries are findings too: the exit code is what
+    # makes "the baseline can only shrink" enforceable from one command
+    return 1 if res.kept or res.stale else 0
+
+
+def add_args(p) -> None:
+    """The lint CLI surface, defined ONCE — both entry points
+    (`python -m jepsen_tpu.lint` and the `lint` subcommand of
+    `python -m jepsen_tpu.cli`) build their parser from here, so the
+    two documented commands cannot drift apart."""
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to lint with the module rules only "
+                        "(default: the whole package + project rules + "
+                        "baseline)")
+    p.add_argument("--format", choices=("text", "json"),
+                   default="text", dest="lint_format")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file (default lint_baseline.json at "
+                        "the repo root)")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: auto-detected)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit")
+
+
+def run_from_args(args) -> int:
+    """Dispatch a namespace produced by an `add_args` parser."""
+    if args.list_rules:
+        for r in rule_table():
+            print(f"{r['id']}: {r['doc']}")
+        return 0
+    return run(args.paths or None, root=args.root,
+               baseline=args.baseline, fmt=args.lint_format)
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="jepsen-tpu lint",
+        description="self-hosted static analysis (gate registry, JAX "
+                    "hazards, concurrency, shm lifecycle, tracer "
+                    "discipline)")
+    add_args(p)
+    try:
+        args = p.parse_args(argv)
+    except SystemExit as e:
+        return 254 if e.code not in (0, None) else 0
+    return run_from_args(args)

@@ -1,0 +1,110 @@
+"""JT-TRACE — tracer/span and metric-name discipline.
+
+Spans must be context-managed (`with trace.span(...)`): a span object
+held open across an exception never records, and manual enter/exit
+splits the pairing the Chrome exporter depends on. Counter/gauge/
+histogram names must come from the declared registry in
+`jepsen_tpu.trace` (`DECLARED_METRICS` / `METRIC_PREFIXES`): the
+metrics surface is keyed by string, so one typo silently forks a
+series (`quarantined` vs `quarentined`) and every dashboard/bench
+diff downstream reads half the events.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from . import Finding, ModuleCtx, ModuleRule, const_str
+
+_TRACE_FILE = "jepsen_tpu/trace.py"
+_RECEIVERS = {"trace", "tr", "tracer", "jtrace"}
+_METRIC_KINDS = {"counter": "counters", "gauge": "gauges",
+                 "histogram": "histograms"}
+
+
+def _metric_calls(tree: ast.AST) -> Iterator[tuple[ast.Call, str]]:
+    """(call, kind) for tracer metric constructor calls with exactly
+    one positional argument on a tracer-ish receiver."""
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Call) \
+                and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in _METRIC_KINDS \
+                and len(n.args) == 1 and not n.keywords \
+                and isinstance(n.func.value, ast.Name) \
+                and n.func.value.id in _RECEIVERS:
+            yield n, n.func.attr
+
+
+class SpanNotContextManaged(ModuleRule):
+    id = "JT-TRACE-001"
+    doc = ("a span created outside a `with` statement — it never "
+           "records on exceptions and breaks the exporter's pairing")
+    hint = "use `with trace.span(name, **args): ...`"
+
+    def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
+        if ctx.rel.endswith(_TRACE_FILE):
+            return
+        with_exprs: set[int] = set()
+        for n in ast.walk(ctx.tree):
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    with_exprs.add(id(item.context_expr))
+        for n in ast.walk(ctx.tree):
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "span" \
+                    and isinstance(n.func.value, ast.Name) \
+                    and n.func.value.id in _RECEIVERS \
+                    and id(n) not in with_exprs:
+                yield self.finding(ctx, n,
+                                   "span() not used as a context manager")
+
+
+class UndeclaredMetricName(ModuleRule):
+    id = "JT-TRACE-002"
+    doc = ("counter/gauge/histogram name not in the declared registry "
+           "(trace.DECLARED_METRICS) — a typo silently forks a "
+           "metrics series")
+    hint = ("declare the name in trace.DECLARED_METRICS (or fix the "
+            "typo); dynamic names must start with a declared "
+            "METRIC_PREFIXES entry")
+
+    def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
+        if ctx.rel.endswith(_TRACE_FILE):
+            return
+        from .. import trace
+        declared = trace.DECLARED_METRICS
+        prefixes = trace.METRIC_PREFIXES
+        all_names = frozenset().union(*declared.values())
+        for call, kind in _metric_calls(ctx.tree):
+            arg = call.args[0]
+            name = const_str(arg)
+            if name is not None:
+                if name in declared[_METRIC_KINDS[kind]]:
+                    continue
+                if name in all_names:
+                    yield self.finding(
+                        ctx, call,
+                        f"{name!r} is declared as a different metric "
+                        f"kind than {kind}")
+                elif any(name.startswith(p) for p in prefixes):
+                    continue
+                else:
+                    yield self.finding(
+                        ctx, call, f"undeclared {kind} name {name!r}")
+            elif isinstance(arg, ast.JoinedStr):
+                lead = arg.values[0] if arg.values else None
+                lit = const_str(lead) if lead is not None else None
+                if lit is None or not any(lit.startswith(p) or
+                                          p.startswith(lit)
+                                          for p in prefixes):
+                    yield self.finding(
+                        ctx, call,
+                        f"dynamic {kind} name without a declared "
+                        "prefix")
+            # non-literal names (pass-through aggregation) are out of
+            # lexical reach — runtime owns those
+
+
+RULES = [SpanNotContextManaged(), UndeclaredMetricName()]

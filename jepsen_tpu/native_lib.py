@@ -50,7 +50,8 @@ def _load_so(src: Path, so: Path) -> ctypes.CDLL | None:
     JEPSEN_TPU_NO_NATIVE kill switch, rebuild when the source is newer
     than the lib, tolerate a failed rebuild if a stale lib still loads,
     and degrade to None on any failure."""
-    if os.environ.get("JEPSEN_TPU_NO_NATIVE"):
+    from . import gates
+    if gates.get("JEPSEN_TPU_NO_NATIVE"):
         return None
     stale = (so.exists() and src.exists()
              and src.stat().st_mtime > so.stat().st_mtime)
@@ -115,8 +116,24 @@ def _cached_lib(src_name: str, so_name: str, bind) -> ctypes.CDLL | None:
     with _lock:
         if src_name in _cached:
             return _cached[src_name]
-        L = _load_so(_NATIVE_DIR / src_name,
-                     _NATIVE_DIR / "build" / so_name)
+        from . import gates
+        # the NO_NATIVE kill switch wins over an explicit lib dir —
+        # it must disable EVERY ctypes load, pinned or not
+        libdir = None if gates.get("JEPSEN_TPU_NO_NATIVE") \
+            else gates.get("JEPSEN_TPU_NATIVE_LIB_DIR")
+        if libdir:
+            # explicit lib dir (e.g. the sanitizer-instrumented
+            # builds): load exactly that lib or degrade to Python —
+            # never silently substitute the production build
+            try:
+                L = ctypes.CDLL(str(Path(libdir) / so_name))
+            except OSError as e:
+                log.debug("native lib load failed (%s from %s): %s",
+                          so_name, libdir, e)
+                L = None
+        else:
+            L = _load_so(_NATIVE_DIR / src_name,
+                         _NATIVE_DIR / "build" / so_name)
         if L is not None:
             try:
                 if not bind(L):
@@ -127,7 +144,7 @@ def _cached_lib(src_name: str, so_name: str, bind) -> ctypes.CDLL | None:
             note_fallback(
                 src_name,
                 "JEPSEN_TPU_NO_NATIVE set"
-                if os.environ.get("JEPSEN_TPU_NO_NATIVE")
+                if gates.get("JEPSEN_TPU_NO_NATIVE")
                 else "build/load/ABI-bind failed")
         _cached[src_name] = L
         return L

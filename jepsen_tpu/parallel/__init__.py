@@ -356,7 +356,8 @@ def pack_thread_enabled() -> bool:
     dedicated worker thread so the parent's critical path is only the
     async kernel enqueue and h2d overlaps device compute. 0 keeps
     everything inline on the calling thread."""
-    return os.environ.get("JEPSEN_TPU_PACK_THREAD", "1") != "0"
+    from .. import gates
+    return gates.get("JEPSEN_TPU_PACK_THREAD")
 
 
 def _est_cells(encs: Sequence, bucket: list[int], dp: int) -> int:

@@ -54,7 +54,8 @@ NAME_PREFIX = "jtshm"
 
 def enabled() -> bool:
     """One home for the JEPSEN_TPU_SHM_INGEST gate (default on)."""
-    return os.environ.get("JEPSEN_TPU_SHM_INGEST", "1") != "0"
+    from . import gates
+    return gates.get("JEPSEN_TPU_SHM_INGEST")
 
 
 _probe: bool | None = None

@@ -567,13 +567,15 @@ def bounded_file_xxh64(path: Path, size: int) -> int:
 
 def encode_cache_enabled() -> bool:
     """The JEPSEN_TPU_ENCODE_CACHE master gate (default on)."""
-    return os.environ.get("JEPSEN_TPU_ENCODE_CACHE", "1") != "0"
+    from . import gates
+    return gates.get("JEPSEN_TPU_ENCODE_CACHE")
 
 
 def encode_cache_write_enabled() -> bool:
     """JEPSEN_TPU_ENCODE_CACHE_WRITE=0 makes the cache read-only
     (e.g. sweeping a store on a read-only mount)."""
-    return os.environ.get("JEPSEN_TPU_ENCODE_CACHE_WRITE", "1") != "0"
+    from . import gates
+    return gates.get("JEPSEN_TPU_ENCODE_CACHE_WRITE")
 
 
 def encoded_cache_path(run_dir: str | os.PathLike, checker: str) -> Path:

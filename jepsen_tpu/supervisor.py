@@ -94,21 +94,17 @@ def strict_enabled() -> bool:
     """JEPSEN_TPU_STRICT=1 restores fail-fast: no quarantine, no OOM
     backdown — the first failure raises to the caller (CI bisection,
     debugging a specific corrupt store)."""
-    return os.environ.get("JEPSEN_TPU_STRICT", "") == "1"
+    from . import gates
+    return gates.get("JEPSEN_TPU_STRICT")
 
 
 def dispatch_timeout_s() -> float | None:
     """The per-dispatch device watchdog (JEPSEN_TPU_DISPATCH_TIMEOUT_S,
     seconds; unset/empty/<=0 disables — the default, because a healthy
     closure on a huge bucket can legitimately run minutes)."""
-    raw = os.environ.get("JEPSEN_TPU_DISPATCH_TIMEOUT_S", "")
-    if not raw:
-        return None
-    try:
-        t = float(raw)
-    except ValueError:
-        return None
-    return t if t > 0 else None
+    from . import gates
+    t = gates.get("JEPSEN_TPU_DISPATCH_TIMEOUT_S")
+    return t if t is not None and t > 0 else None
 
 
 def is_oom_error(e: BaseException) -> bool:
@@ -196,7 +192,8 @@ _inj_lock = threading.Lock()
 
 
 def _get_injector() -> _Injector | None:
-    spec = os.environ.get("JEPSEN_TPU_FAULT_INJECT", "")
+    from . import gates
+    spec = gates.get("JEPSEN_TPU_FAULT_INJECT")
     global _injector
     inj = _injector
     if inj is None or inj.spec != spec:

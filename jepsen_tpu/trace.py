@@ -22,8 +22,8 @@ This module makes every run self-attributing:
 `JEPSEN_TPU_TRACE=0` (or `--no-trace`) swaps in the `NullTracer`:
 no file is written and a disabled span costs well under a microsecond
 — the dp8-efficiency floor is unaffected. The module imports nothing
-but the stdlib; `jax` is touched only inside an explicitly enabled
-profiler session.
+but the stdlib (plus the stdlib-only `gates` registry); `jax` is
+touched only inside an explicitly enabled profiler session.
 """
 
 from __future__ import annotations
@@ -36,7 +36,31 @@ import threading
 import time
 from pathlib import Path
 
+from . import gates
+
 log = logging.getLogger(__name__)
+
+#: The declared metric-name registry. The metrics surface is keyed by
+#: string, so a typo silently forks a series; lint rule JT-TRACE-002
+#: checks every `counter("...")`/`gauge`/`histogram` literal in the
+#: package against this set (and that the KIND matches), so a new
+#: metric must be declared here before it can ship.
+DECLARED_METRICS: dict[str, frozenset] = {
+    "counters": frozenset({
+        "bucket_splits", "buckets_dispatched", "cache_hits",
+        "cache_misses", "native_fallback", "oom_retries",
+        "pad_waste_cells", "quarantined", "shm_bytes",
+        "shm_stale_reclaimed", "split.native", "split.python",
+        "watchdog_timeouts",
+    }),
+    "gauges": frozenset({"inflight_depth", "reorder_depth"}),
+    "histograms": frozenset({"bucket_cells"}),
+}
+
+#: Sanctioned dynamic-name families: an f-string metric name must
+#: start with one of these (`phase.<key>`, `device.<kernel>`,
+#: `native_fallback.<component>`).
+METRIC_PREFIXES = ("phase.", "device.", "native_fallback.")
 
 #: Synthetic tid for the device track (real thread idents are pthread
 #: addresses, nowhere near this; named tracks count down from here).
@@ -47,7 +71,7 @@ _MLOCK = threading.Lock()   # shared metric read-modify-write lock
 
 def enabled() -> bool:
     """The JEPSEN_TPU_TRACE gate (default on)."""
-    return os.environ.get("JEPSEN_TPU_TRACE", "1") != "0"
+    return gates.get("JEPSEN_TPU_TRACE")
 
 
 # ---------------------------------------------------------------------------
@@ -232,11 +256,9 @@ class Tracer:
         # (dropped_events in metrics.json), never silent; phase totals
         # and metrics keep accumulating past the cap.
         if max_events is None:
-            try:
-                max_events = int(os.environ.get(
-                    "JEPSEN_TPU_TRACE_MAX_EVENTS", "200000"))
-            except ValueError:   # malformed env must not sink the run
-                max_events = 200_000
+            # malformed env must not sink the run: the gate accessor
+            # falls back to the declared default on parse failure
+            max_events = gates.get("JEPSEN_TPU_TRACE_MAX_EVENTS")
         self._max_events = max_events
         self._dropped = 0
         self._origin = time.perf_counter()
@@ -531,7 +553,7 @@ def histogram(name: str):
 # ---------------------------------------------------------------------------
 
 def jax_profile_enabled() -> bool:
-    return os.environ.get("JEPSEN_TPU_JAX_PROFILE", "") == "1"
+    return gates.get("JEPSEN_TPU_JAX_PROFILE")
 
 
 class jax_profile_session:

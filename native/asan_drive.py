@@ -1,4 +1,4 @@
-"""Sanitizer drive for the native libraries (ASan + UBSan).
+"""Sanitizer drive for the native libraries (ASan + UBSan + TSan).
 
 Exercises the three C++ components with the same differential fuzz the
 unit tests use, plus hostile/malformed inputs, under
@@ -11,6 +11,17 @@ AddressSanitizer/UndefinedBehaviorSanitizer:
 
 detect_leaks=0 because CPython's interpreter allocations drown the
 report; buffer overflows / UB in the libraries still abort loudly.
+
+`--tsan` switches to the ThreadSanitizer drive of the encode/sidecar
+writer path (`make -C native tsan` builds it): the production parent
+drives jt_ha_encode_file / jt_ha_write_sidecar / jt_xxh64_buf from
+the dispatcher AND the pack-h2d thread concurrently, and ctypes drops
+the GIL for the call's duration — the library must be race-free, not
+merely GIL-lucky.
+
+    LD_PRELOAD=$(gcc -print-file-name=libtsan.so) \
+        TSAN_OPTIONS=halt_on_error=1 JAX_PLATFORMS=cpu \
+        python native/asan_drive.py --tsan
 """
 import os
 _B = os.path.join(os.path.dirname(__file__), "build", "asan")
@@ -22,6 +33,67 @@ sys.path.insert(0, _R)
 sys.path.insert(0, os.path.join(_R, "tests"))
 
 from jepsen_tpu import native_lib
+
+
+def tsan_drive() -> None:
+    """Hammer the shm/sidecar writer path of the TSan-built encoder
+    from concurrent threads: parallel encodes of shared and private
+    history files, sidecar writes to distinct paths, and xxh64 over a
+    shared read-only buffer."""
+    import threading
+    from test_fuzz_differential import rand_append_history
+    lib = ctypes.CDLL(os.path.join(os.path.dirname(__file__),
+                                   "build", "tsan",
+                                   "libjepsen_histenc.so"))
+    assert native_lib._bind_hist(lib)
+    rng = random.Random(4242)
+    with tempfile.TemporaryDirectory() as tmp:
+        td = Path(tmp)
+        files = []
+        for i in range(8):
+            ops = rand_append_history(rng, T=rng.randrange(10, 120),
+                                      K=rng.randrange(1, 6),
+                                      conc=rng.randrange(1, 9),
+                                      info_p=0.1, corrupt_p=0.2)
+            p = td / f"h{i}.jsonl"
+            p.write_text("\n".join(json.dumps(o) for o in ops) + "\n")
+            files.append(p)
+        shared_buf = files[0].read_bytes()
+        errs: list[BaseException] = []
+
+        def worker(tid: int) -> None:
+            try:
+                r = random.Random(tid)
+                for it in range(25):
+                    p = files[r.randrange(len(files))]
+                    h = lib.jt_ha_encode_file(str(p).encode())
+                    if h:
+                        dims = (ctypes.c_int64 * 8)()
+                        lib.jt_ha_dims(h, dims)
+                        side = td / f"side.t{tid}.{it}.bin"
+                        lib.jt_ha_write_sidecar(
+                            h, str(p).encode(), str(side).encode())
+                        lib.jt_ha_free(h)
+                    lib.jt_xxh64_buf(shared_buf, len(shared_buf), tid)
+            except BaseException as e:  # surfaced on the main thread
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(t,),
+                                    name=f"tsan-drive-{t}")
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+    print("TSAN drive complete: 4 threads x 25 iters "
+          "(encode+sidecar+xxh64, shared+private files)")
+
+
+if "--tsan" in sys.argv:
+    tsan_drive()
+    sys.exit(0)
 
 L = ctypes.CDLL(os.path.join(_B, "libhist_encode.so"))
 W = ctypes.CDLL(os.path.join(_B, "libwgl.so"))

@@ -1,0 +1,31 @@
+"""Seeded JT-THREAD violations (pool, lock, start-method, tracer)."""
+import multiprocessing as mp
+import threading
+
+from jepsen_tpu import trace
+
+_lock = threading.Lock()
+
+
+def hangs_on_dead_worker(items):
+    pool = mp.Pool(4)                                     # EXPECT: JT-THREAD-001
+    return pool.map(str, items)
+
+
+def leaks_on_exception():
+    _lock.acquire()                                       # EXPECT: JT-THREAD-002
+    try:
+        return 1
+    finally:
+        _lock.release()
+
+
+def fork_with_live_threads():
+    ctx = mp.get_context("fork")                          # EXPECT: JT-THREAD-003
+    mp.set_start_method()                                 # EXPECT: JT-THREAD-003
+    return ctx
+
+
+def races_the_recorder():
+    tr = trace.current()
+    tr._events.append({"ph": "X"})                        # EXPECT: JT-THREAD-004

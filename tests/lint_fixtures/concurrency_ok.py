@@ -1,0 +1,26 @@
+"""Clean twin of concurrency_bad.py: spawn-only executor, with-scoped
+lock, tracer via its API."""
+import multiprocessing as mp
+import threading
+from concurrent.futures import ProcessPoolExecutor
+
+from jepsen_tpu import trace
+
+_lock = threading.Lock()
+
+
+def survives_dead_worker(items):
+    with ProcessPoolExecutor(
+            max_workers=4,
+            mp_context=mp.get_context("spawn")) as ex:
+        return list(ex.map(str, items))
+
+
+def scoped_lock():
+    with _lock:
+        return 1
+
+
+def records_via_api():
+    trace.instant("mark")
+    trace.counter("quarantined").inc()

@@ -1,0 +1,37 @@
+"""Seeded JT-GATE violations. `# EXPECT: <ids>` marks each expected
+finding line; tests/test_lint.py parses these markers as the golden."""
+import os
+
+from jepsen_tpu import gates
+
+
+def raw_reads():
+    a = os.environ["JEPSEN_TPU_TRACE"]                    # EXPECT: JT-GATE-001
+    b = os.environ.get("JEPSEN_TPU_STRICT", "")           # EXPECT: JT-GATE-001
+    c = os.getenv("JEPSEN_TPU_SHM_INGEST", "1")           # EXPECT: JT-GATE-001
+    d = "JEPSEN_TPU_PIPELINE" in os.environ               # EXPECT: JT-GATE-001
+    os.environ.pop("JEPSEN_TPU_FAULT_INJECT", None)       # EXPECT: JT-GATE-001
+    return a, b, c, d
+
+
+def unregistered():
+    # a typo'd / undeclared name fires both the raw-access and the
+    # unregistered-name rules
+    e = os.environ.get("JEPSEN_TPU_TYPO_GATE")            # EXPECT: JT-GATE-001, JT-GATE-002
+    f = gates.get("JEPSEN_TPU_NOT_DECLARED")              # EXPECT: JT-GATE-002
+    return e, f
+
+
+from jepsen_tpu import gates as _aliased                  # noqa: E402
+from jepsen_tpu.gates import get as _bare_get             # noqa: E402
+
+
+def unregistered_via_alias():
+    # an import alias or a bare-imported accessor is not a blind spot
+    g = _aliased.get("JEPSEN_TPU_ALIASED_TYPO")           # EXPECT: JT-GATE-002
+    h = _bare_get("JEPSEN_TPU_BARE_TYPO")                 # EXPECT: JT-GATE-002
+    return g, h
+
+
+def non_gate_env_is_fine():
+    return os.environ.get("JAX_PLATFORMS", "")

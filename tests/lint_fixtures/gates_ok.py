@@ -1,0 +1,25 @@
+"""Clean twin of gates_bad.py: every gate read goes through the
+registry accessors; non-gate env vars stay raw-readable."""
+import os
+
+from jepsen_tpu import gates
+
+
+def registry_reads():
+    a = gates.get("JEPSEN_TPU_TRACE")
+    b = gates.get("JEPSEN_TPU_STRICT")
+    c = gates.is_set("JEPSEN_TPU_FAULT_INJECT")
+    gates.export("JEPSEN_TPU_BACKEND", "cpu")
+    gates.unset("JEPSEN_TPU_BACKEND")
+    return a, b, c
+
+
+def non_gate_env():
+    return os.environ.get("JAX_PLATFORMS", ""), os.getenv("HOME")
+
+
+from jepsen_tpu import gates as _aliased
+
+
+def aliased_registered_reads_are_fine():
+    return _aliased.get("JEPSEN_TPU_SHM_INGEST")

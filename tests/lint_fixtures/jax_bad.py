@@ -1,0 +1,31 @@
+"""Seeded JT-JAX violations (host-sync / recompile hazards)."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def item_sync(x):
+    return x.sum().item()                                 # EXPECT: JT-JAX-001
+
+
+@jax.jit
+def numpy_materialize(x):
+    y = np.asarray(x)                                     # EXPECT: JT-JAX-002
+    z = np.array([1, 2]) + np.frombuffer(b"ab", np.uint8)  # EXPECT: JT-JAX-002, JT-JAX-002
+    return y, z
+
+
+@functools.partial(jax.jit, static_argnames=("flag",))
+def tracer_branch(x, n, flag):
+    if flag:               # static: branching on it is the point
+        n = n + 1
+    if n > 0:                                             # EXPECT: JT-JAX-004
+        x = x + 1
+    return x if x.sum() else -x                           # EXPECT: JT-JAX-004
+
+
+def unsanctioned_wait(out):
+    return out.block_until_ready()                        # EXPECT: JT-JAX-003

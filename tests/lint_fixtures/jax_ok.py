@@ -1,0 +1,27 @@
+"""Clean twin of jax_bad.py: on-device control flow, np outside jit,
+waits routed through the sanctioned wrappers."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@jax.jit
+def on_device(x, n):
+    y = jnp.where(n > 0, x + 1, x)
+    return lax.cond(y.sum() > 0, lambda v: v, lambda v: -v, y)
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def static_branch(x, mode):
+    if mode == "double":   # static arg: recompile-per-value by design
+        return x * 2
+    return x
+
+
+def host_side(out):
+    # .item()/np.asarray on a CONCRETE result, outside any jit
+    arr = np.asarray(out)
+    return arr, arr.sum().item()

@@ -1,0 +1,10 @@
+"""Seeded JT-SHM violation: create without a lexical unlink path."""
+from multiprocessing import shared_memory
+
+
+def leaky_writer(payload: bytes, name: str):
+    seg = shared_memory.SharedMemory(name=name, create=True,  # EXPECT: JT-SHM-001
+                                     size=len(payload))
+    seg.buf[:len(payload)] = payload
+    seg.close()    # close() detaches; only unlink() frees the segment
+    return name

@@ -1,0 +1,26 @@
+"""Clean twin of shm_bad.py: every create is paired with an unlink
+path in the same function (happy path + exception sweep)."""
+from multiprocessing import shared_memory
+
+
+def paired_writer(payload: bytes, name: str):
+    seg = shared_memory.SharedMemory(name=name, create=True,
+                                     size=len(payload))
+    try:
+        seg.buf[:len(payload)] = payload
+    except BaseException:
+        seg.close()
+        seg.unlink()
+        raise
+    seg.close()
+    return name
+
+
+def attach_only(name: str):
+    # create=False attaches to an existing segment — no lifecycle
+    # obligation here
+    seg = shared_memory.SharedMemory(name=name)
+    try:
+        return bytes(seg.buf)
+    finally:
+        seg.close()

@@ -1,0 +1,19 @@
+"""Seeded JT-TRACE violations (span + metric-name discipline)."""
+from jepsen_tpu import trace
+
+
+def unmanaged_span():
+    s = trace.span("parse")                               # EXPECT: JT-TRACE-001
+    return s
+
+
+def typoed_counter():
+    trace.counter("quarentined").inc()                    # EXPECT: JT-TRACE-002
+
+
+def kind_mismatch():
+    trace.gauge("quarantined").set(1)                     # EXPECT: JT-TRACE-002
+
+
+def undeclared_dynamic(name):
+    trace.counter(f"whatever.{name}").inc()               # EXPECT: JT-TRACE-002

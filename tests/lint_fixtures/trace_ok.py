@@ -1,0 +1,15 @@
+"""Clean twin of trace_bad.py: context-managed spans, declared metric
+names, declared dynamic prefixes."""
+from jepsen_tpu import trace
+
+
+def managed_span():
+    with trace.span("parse"):
+        return 1
+
+
+def declared_metrics(component):
+    trace.counter("quarantined").inc()
+    trace.gauge("inflight_depth").set(2)
+    trace.histogram("bucket_cells").observe(1024)
+    trace.counter(f"native_fallback.{component}").inc()

@@ -1,0 +1,250 @@
+"""The self-hosted linter (jepsen_tpu.lint).
+
+Two contracts:
+
+1. **Golden fixtures** — every rule family fires on its seeded
+   violation file under `tests/lint_fixtures/` (each offending line
+   carries an `# EXPECT: <rule-ids>` marker that IS the golden) and
+   stays quiet on the clean twin.
+2. **Self-hosting** — `jepsen_tpu/` itself is clean against the
+   committed `lint_baseline.json` at every commit, with no stale
+   baseline entries. This is the tier-1 gate that makes the invariants
+   machine-checked instead of review-enforced.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+
+import pytest
+
+from jepsen_tpu import gates, lint
+
+REPO = Path(__file__).resolve().parents[1]
+FIXTURES = Path(__file__).parent / "lint_fixtures"
+
+_EXPECT_RE = re.compile(r"#\s*EXPECT:\s*([A-Z0-9\-,\s]+?)\s*$")
+
+
+def expected_of(path: Path) -> list[tuple[int, str]]:
+    """(line, rule) golden parsed from the fixture's EXPECT markers."""
+    out: list[tuple[int, str]] = []
+    for i, ln in enumerate(path.read_text().splitlines(), start=1):
+        m = _EXPECT_RE.search(ln)
+        if m:
+            out.extend((i, rid.strip())
+                       for rid in m.group(1).split(",") if rid.strip())
+    return sorted(out)
+
+
+def findings_of(path: Path) -> list[tuple[int, str]]:
+    return sorted((f.line, f.rule)
+                  for f in lint.lint_paths([path], root=REPO))
+
+
+FAMILIES = ["gates", "jax", "concurrency", "shm", "trace"]
+
+
+@pytest.mark.parametrize("family", FAMILIES)
+def test_family_fires_on_seeded_violations(family):
+    bad = FIXTURES / f"{family}_bad.py"
+    golden = expected_of(bad)
+    assert golden, f"{bad} has no EXPECT markers"
+    assert findings_of(bad) == golden
+
+
+@pytest.mark.parametrize("family", FAMILIES)
+def test_family_quiet_on_clean_twin(family):
+    ok = FIXTURES / f"{family}_ok.py"
+    assert findings_of(ok) == []
+
+
+# -- path-scoped rule variants ---------------------------------------------
+
+def _lint_at(tmp_path: Path, rel: str, source: str):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(source)
+    return [f.rule for f in lint.lint_paths([p], root=tmp_path)]
+
+
+def test_kernel_module_item_is_flagged_outside_jit(tmp_path):
+    src = "def collect(x):\n    return x.sum().item()\n"
+    rules = _lint_at(tmp_path, "jepsen_tpu/checker/elle/kernels.py", src)
+    assert rules == ["JT-JAX-001"]
+    # the same code in a non-kernel module is host-side and fine
+    assert _lint_at(tmp_path, "jepsen_tpu/ordinary.py", src) == []
+
+
+def test_block_until_ready_sanctioned_in_watchdog_homes(tmp_path):
+    src = "def wait(out):\n    return out.block_until_ready()\n"
+    assert _lint_at(tmp_path, "jepsen_tpu/parallel/core.py", src) == []
+    assert _lint_at(tmp_path, "jepsen_tpu/supervisor.py", src) == []
+    assert _lint_at(tmp_path, "jepsen_tpu/ingest.py", src) \
+        == ["JT-JAX-003"]
+
+
+# -- suppressions -----------------------------------------------------------
+
+def test_inline_suppression_same_line(tmp_path):
+    rules = _lint_at(
+        tmp_path, "pkg/m.py",
+        'import os\n'
+        'x = os.environ["JEPSEN_TPU_TRACE"]'
+        '  # jt-lint: ok JT-GATE-001 (fixture)\n')
+    assert rules == []
+
+
+def test_inline_suppression_line_above_and_family(tmp_path):
+    rules = _lint_at(
+        tmp_path, "pkg/m.py",
+        'import os\n'
+        '# jt-lint: ok JT-GATE (fixture: family-wide)\n'
+        'x = os.environ.get("JEPSEN_TPU_TYPO_GATE")\n')
+    assert rules == []   # suppresses both JT-GATE-001 and -002
+
+
+def test_suppression_is_rule_scoped(tmp_path):
+    rules = _lint_at(
+        tmp_path, "pkg/m.py",
+        'import os\n'
+        'x = os.environ.get("JEPSEN_TPU_TYPO_GATE")'
+        '  # jt-lint: ok JT-GATE-001 (wrong rule)\n')
+    assert rules == ["JT-GATE-002"]
+
+
+# -- baseline ---------------------------------------------------------------
+
+def test_baseline_requires_reason(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps(
+        {"entries": [{"rule": "JT-SHM-001", "path": "x.py"}]}))
+    with pytest.raises(ValueError):
+        lint.load_baseline(p)
+
+
+def test_baseline_budget_and_stale():
+    f1 = lint.Finding("JT-SHM-001", "a.py", 3, "m")
+    f2 = lint.Finding("JT-SHM-001", "a.py", 9, "m")
+    entries = [{"rule": "JT-SHM-001", "path": "a.py", "max": 1,
+                "reason": "grandfathered"},
+               {"rule": "JT-JAX-001", "path": "gone.py", "max": 1,
+                "reason": "stale entry"}]
+    res = lint.apply_baseline([f1, f2], entries)
+    assert res.suppressed == [f1]
+    assert res.kept == [f2]           # over budget: still a finding
+    assert [e["path"] for e in res.stale] == ["gone.py"]
+
+
+def test_missing_baseline_file_is_empty(tmp_path):
+    assert lint.load_baseline(tmp_path / "nope.json") == []
+
+
+def test_stale_baseline_fails_the_run(tmp_path, capsys):
+    # "the baseline can only shrink" is an exit-code contract, not a
+    # warning: a clean tree with a dead suppression must exit 1
+    b = tmp_path / "b.json"
+    b.write_text(json.dumps({"entries": [
+        {"rule": "JT-SHM-001", "path": "gone.py", "max": 1,
+         "reason": "long since fixed"}]}))
+    (tmp_path / "jepsen_tpu").mkdir()
+    rc = lint.run(None, root=tmp_path, baseline=str(b))
+    assert rc == 1
+    assert "stale baseline entry" in capsys.readouterr().out
+
+
+# -- project rules ----------------------------------------------------------
+
+def test_readme_drift_rule(tmp_path):
+    from jepsen_tpu.lint import rules_gates
+    rule = rules_gates.ReadmeTableDrift()
+    (tmp_path / "README.md").write_text(
+        gates.TABLE_BEGIN + "\n| drifted |\n" + gates.TABLE_END + "\n")
+    ctx = lint.ProjectCtx(tmp_path, [])
+    assert [f.rule for f in rule.check_project(ctx)] == ["JT-GATE-003"]
+    (tmp_path / "README.md").write_text(
+        "intro\n\n" + gates.render_env_block() + "\n\noutro\n")
+    assert list(rule.check_project(ctx)) == []
+
+
+def test_gate_coverage_rule_ignores_fixtures(tmp_path):
+    from jepsen_tpu.lint import rules_gates
+    rule = rules_gates.GateTestCoverage()
+    tdir = tmp_path / "tests"
+    (tdir / "lint_fixtures").mkdir(parents=True)
+    # names mentioned ONLY in a fixture file don't count as coverage
+    (tdir / "lint_fixtures" / "f.py").write_text(
+        "\n".join(sorted(gates.GATES)))
+    ctx = lint.ProjectCtx(tmp_path, [])
+    missing = {f.message.split()[1] for f in rule.check_project(ctx)}
+    assert missing == set(gates.GATES)
+    # a real test file naming them all silences the rule
+    (tdir / "test_gates.py").write_text("\n".join(sorted(gates.GATES)))
+    assert list(rule.check_project(ctx)) == []
+
+
+def test_gate_coverage_needs_word_boundary(tmp_path):
+    # a longer gate name must not shadow its prefix: mentioning only
+    # JEPSEN_TPU_TRACE_MAX_EVENTS leaves JEPSEN_TPU_TRACE uncovered
+    from jepsen_tpu.lint import rules_gates
+    rule = rules_gates.GateTestCoverage()
+    tdir = tmp_path / "tests"
+    tdir.mkdir()
+    others = sorted(n for n in gates.GATES if n != "JEPSEN_TPU_TRACE")
+    (tdir / "test_x.py").write_text("\n".join(others))
+    ctx = lint.ProjectCtx(tmp_path, [])
+    missing = {f.message.split()[1] for f in rule.check_project(ctx)}
+    assert missing == {"JEPSEN_TPU_TRACE"}
+
+
+# -- the self-hosting contract ---------------------------------------------
+
+def test_package_is_clean_against_baseline():
+    findings = lint.lint_project(REPO)
+    entries = lint.load_baseline(REPO / "lint_baseline.json")
+    res = lint.apply_baseline(findings, entries)
+    assert res.kept == [], "\n" + "\n".join(f.render() for f in res.kept)
+    assert res.stale == [], f"stale baseline entries: {res.stale}"
+
+
+def test_rule_families_all_registered():
+    ids = lint.rule_ids()
+    assert len(ids) == len(set(ids))
+    for fam in ("JT-GATE", "JT-JAX", "JT-THREAD", "JT-SHM", "JT-TRACE"):
+        assert any(i.startswith(fam + "-") for i in ids), fam
+    assert len(ids) >= 15
+
+
+# -- CLI --------------------------------------------------------------------
+
+def test_cli_lint_json(capsys):
+    from jepsen_tpu import cli
+    rc = cli.run_cli(lambda tmap, args: tmap,
+                     argv=["lint", "--format", "json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert payload["findings"] == []
+    assert payload["baseline_stale"] == []
+    assert payload["rules"] >= 15
+
+
+def test_cli_lint_list_rules(capsys):
+    from jepsen_tpu import cli
+    assert cli.run_cli(lambda tmap, args: tmap,
+                       argv=["lint", "--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert "JT-GATE-001" in out and "JT-TRACE-002" in out
+
+
+def test_cli_lint_reports_findings(tmp_path, capsys):
+    from jepsen_tpu import cli
+    bad = tmp_path / "bad.py"
+    bad.write_text("import os\n"
+                   "x = os.environ['JEPSEN_TPU_TRACE']\n")
+    rc = cli.run_cli(lambda tmap, args: tmap,
+                     argv=["lint", str(bad)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "JT-GATE-001" in out

@@ -129,7 +129,8 @@ def test_env_reaches_production_dispatch(monkeypatch):
 
     calls.clear()
     monkeypatch.setenv("JEPSEN_TPU_CLOSURE", "xla-int8")  # malformed
-    monkeypatch.setattr(K, "_env_warned", False)
+    from jepsen_tpu import gates
+    monkeypatch.setattr(gates, "_warned", set())  # re-arm warn-once
     K.check_encoded_batch(encs)
     # malformed values fall back to the auto default (int8 since the
     # r5 hardware race), never a half-parsed mixture
