@@ -7,7 +7,8 @@ PY ?= python
 ASAN_RT := $(shell gcc -print-file-name=libasan.so)
 TSAN_RT := $(shell gcc -print-file-name=libtsan.so)
 
-.PHONY: lint lint-json env-table test native native-sanitize bench
+.PHONY: lint lint-json env-table test native native-sanitize bench \
+	bench-report obs-smoke
 
 # Self-hosted static analysis: gate registry, JAX hazards, concurrency
 # discipline, shm lifecycle, tracer discipline (jepsen_tpu/lint/).
@@ -67,3 +68,15 @@ native-sanitize:
 
 bench:
 	JAX_PLATFORMS=cpu $(PY) bench.py
+
+# The trajectory gate: trend table over the committed BENCH_*.json
+# series, exit 1 when the latest round regresses past a declared
+# threshold vs its same-backend predecessor.
+bench-report:
+	$(PY) -m jepsen_tpu.cli bench-report
+
+# Live-telemetry smoke: a tiny sweep with the health sampler and the
+# /metrics endpoint force-enabled, one mid-flight scrape, and an
+# exposition<->metrics.json parity check. Exit 0/1.
+obs-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m jepsen_tpu.obs.smoke

@@ -1107,20 +1107,24 @@ def run_benches() -> int:
             out[name] = fn(*args)
         except Exception as e:  # the elle metric must still report
             out[name] = {"error": repr(e)[:200]}
-    # Archive this round's own attribution: the round tracer exports
-    # as trace.json next to the BENCH_* artifact. BENCH_TRACE_PATH
-    # overrides the destination; JEPSEN_TPU_TRACE=0 skips the file.
+    # Archive this round's own attribution. Default destination is
+    # bench_artifacts/ (gitignored) — earlier rounds dropped
+    # trace.json/metrics.json at the repo root, where they shadowed
+    # real artifacts and risked being committed. BENCH_TRACE_PATH /
+    # BENCH_METRICS_PATH override; JEPSEN_TPU_TRACE=0 skips the files.
     try:
         tcur = jtrace.get_current()
         if getattr(tcur, "enabled", False):
-            tp = os.environ.get("BENCH_TRACE_PATH", "trace.json")
+            tp = os.environ.get("BENCH_TRACE_PATH",
+                                "bench_artifacts/trace.json")
             tcur.export(tp)
             out["trace_path"] = tp
             # the counter/gauge/histogram registry (shm_bytes,
             # cache_hits/misses, reorder_depth, bucket_cells, ...)
             # archives next to the trace so BENCH rounds can diff
             # ingest behavior without re-running
-            mpth = os.environ.get("BENCH_METRICS_PATH", "metrics.json")
+            mpth = os.environ.get("BENCH_METRICS_PATH",
+                                  "bench_artifacts/metrics.json")
             tcur.export_metrics(mpth)
             out["metrics_path"] = mpth
     except Exception as e:

@@ -205,6 +205,14 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
              "hazards, concurrency, shm lifecycle, tracer discipline)")
     _lint.add_args(p_lint)
 
+    from .obs import bench_report as _breport   # stdlib-only
+    p_breport = sub.add_parser(
+        "bench-report",
+        help="bench-trajectory trend table + regression gate over the "
+             "BENCH_*.json series (exit 1 when the latest round "
+             "regresses past a declared threshold)")
+    _breport.add_args(p_breport)
+
     try:
         args = parser.parse_args(argv)
     except SystemExit as e:
@@ -214,6 +222,9 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
         # no logging/backend/trace setup: lint parses source, it never
         # imports or executes the target package
         return _lint.run_from_args(args)
+    if args.command == "bench-report":
+        # same posture as lint: reads artifacts, never touches jax
+        return _breport.run_from_args(args)
 
     logging.basicConfig(
         level=logging.INFO,
@@ -312,7 +323,7 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
 
 def analyze_store(store: Store, checker: str = "append",
                   name: str | None = None,
-                  resume: bool = False) -> int:
+                  resume: bool = False, obs_hook=None) -> int:
     """`_analyze_store_impl` wrapped in a fresh sweep tracer: the whole
     sweep's spans (ingest parse, pack/h2d/dispatch/collect phases,
     device windows, per-checker fallbacks) export to
@@ -324,20 +335,47 @@ def analyze_store(store: Store, checker: str = "append",
     every verdict appends to the store's `verdicts.jsonl` journal as
     it lands — `--resume` reads it back and skips the journaled
     (run, checker) pairs, so an interrupted sweep restarts where it
-    died."""
+    died.
+
+    Live telemetry (jepsen_tpu.obs) wraps the whole sweep: the flight
+    recorder (`<store>/events.jsonl`) always records the lifecycle;
+    `JEPSEN_TPU_HEALTH_INTERVAL_S` additionally starts the health
+    sampler (`<store>/health.json`, atomic, every N s) and
+    `JEPSEN_TPU_METRICS_PORT` the `/metrics`+`/healthz` endpoint —
+    both off by default, costing nothing when unset. `obs_hook(server,
+    sampler)` is a test/smoke seam called once the obs layer is up."""
+    from . import obs
     from . import shm as _shm
     from .store import VerdictJournal
     tr = trace.fresh_run(f"analyze-store:{checker}", scope="sweep")
     tr.counter("shm_stale_reclaimed").inc(_shm.reclaim_stale())
     journal = VerdictJournal(store.base / "verdicts.jsonl",
                              base=store.base)
+    obs.install_events(store.base)
+    obs.emit("sweep_start", checker=checker, resume=bool(resume),
+             store=str(store.base))
+    sampler = obs.maybe_start_health_sampler(store.base)
+    server = obs.maybe_start_metrics_server(
+        health_fn=(sampler.write_snapshot if sampler is not None
+                   else None))
+    rc: int | None = None
     try:
+        if obs_hook is not None:
+            obs_hook(server, sampler)
         with trace.jax_profile_session(store.base / "jax-profile"):
-            return _analyze_store_impl(store, checker=checker,
-                                       name=name, resume=resume,
-                                       journal=journal)
+            rc = _analyze_store_impl(store, checker=checker,
+                                     name=name, resume=resume,
+                                     journal=journal)
+            return rc
     finally:
         journal.close()
+        obs.emit("sweep_end",
+                 exit_code=rc if rc is not None else "crashed")
+        if sampler is not None:
+            sampler.stop()
+        if server is not None:
+            server.stop()
+        obs.reset_events()
         if getattr(tr, "enabled", False) and store.base.is_dir():
             try:
                 p = tr.export(store.base / "trace.json")
@@ -385,6 +423,9 @@ def _analyze_store_impl(store: Store, checker: str = "append",
                                   validity_exit_code(ent))
             else:
                 pending.append(d)
+        from . import obs
+        obs.emit("sweep_resume", skipped=len(run_dirs) - len(pending),
+                 pending=len(pending))
         if not pending:
             print(f"all {len(run_dirs)} runs already verdicted "
                   f"({checker}); nothing to resume", file=sys.stderr)
@@ -393,6 +434,9 @@ def _analyze_store_impl(store: Store, checker: str = "append",
     if not run_dirs:
         print("no stored runs", file=sys.stderr)
         return 254
+    # live-telemetry progress denominators: the health sampler reads
+    # these from the sweep tracer (runs_verdicted ticks per verdict)
+    trace.get_current().gauge("runs_total").set(len(run_dirs))
 
     # multi-host pods: join the job before any device work so meshes
     # span every host's chips (no-op without a coordinator env)
@@ -643,6 +687,9 @@ def _wr_chunk_with_backdown(good, elle_kernels, elle_wr):
             # waiter threads) per history on a dead runtime
             with tr.span("quarantine", stage="watchdog", histories=1):
                 tr.counter("quarantined").inc()
+            from . import obs
+            obs.emit("quarantine", stage="watchdog", histories=1,
+                     cause="device wedged")
             out.append(supervisor.Quarantined(
                 "watchdog", "device wedged: consecutive singleton "
                 "watchdog timeouts"))
@@ -660,6 +707,9 @@ def _wr_chunk_with_backdown(good, elle_kernels, elle_wr):
                 stage = "oom"
             with tr.span("quarantine", stage=stage, histories=1):
                 tr.counter("quarantined").inc()
+            from . import obs
+            obs.emit("quarantine", stage=stage, histories=1,
+                     cause=repr(e)[:300])
             out.append(supervisor.Quarantined(stage, repr(e)))
     return out
 
@@ -745,6 +795,7 @@ def _write_results(d, res: dict, checker: str | None = None,
             json.dumps({"valid?": res.get("valid?")}))
     if journal is not None and checker is not None:
         journal.record(d, checker, res)
+    trace.get_current().counter("runs_verdicted").inc()
     line = {"dir": str(d), "valid?": res.get("valid?")}
     if "anomaly-types" in res:
         line["anomalies"] = res.get("anomaly-types", [])
@@ -772,6 +823,9 @@ def _quarantine_run(d, err, stage: str, checker: str | None = None,
     tr = trace.get_current()
     with tr.span("quarantine", stage=stage):
         tr.counter("quarantined").inc()
+    from . import obs
+    obs.emit("quarantine", stage=stage, run=str(d),
+             cause=str(err)[:300])
     log.warning("quarantining %s (%s): %s", d, stage, err)
     return _write_results(
         d, supervisor.quarantine_verdict(err, stage, checker), checker,
@@ -796,6 +850,7 @@ def _stored_fallback(d, stored_check, checker: str | None = None,
             d, e, "stored", checker, journal=journal,
             persist=not (d / "results.json").exists())
     print(json.dumps({"dir": str(d), "valid?": res.get("valid?")}))
+    trace.get_current().counter("runs_verdicted").inc()
     if checker is not None:
         # record the validity: the fallback may not write a
         # results.json, and --resume must reproduce this run's

@@ -146,6 +146,14 @@ _g("JEPSEN_TPU_TRACE_MAX_EVENTS", "int", 200_000,
 _g("JEPSEN_TPU_JAX_PROFILE", "bool", False,
    "`1`: wrap the run in a `jax.profiler` capture "
    "(`<run-dir>/jax-profile`; `--jax-profile` sets it)")
+_g("JEPSEN_TPU_HEALTH_INTERVAL_S", "float", None,
+   "live telemetry: write `<store>/health.json` atomically every this "
+   "many seconds during a sweep (progress, robustness, throughput, "
+   "heartbeat); unset/<=0 = off")
+_g("JEPSEN_TPU_METRICS_PORT", "int", None,
+   "serve `/metrics` (Prometheus text exposition) + `/healthz` (the "
+   "health snapshot) on this port during a sweep; `0` binds an "
+   "ephemeral port; unset = off")
 # -- kernels / backend ------------------------------------------------------
 _g("JEPSEN_TPU_BACKEND", "str", None,
    "analysis backend override: `tpu`|`cpu`|`race` (the CLI's "

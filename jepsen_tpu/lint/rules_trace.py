@@ -1,4 +1,4 @@
-"""JT-TRACE — tracer/span and metric-name discipline.
+"""JT-TRACE — tracer/span, metric-name and obs-event discipline.
 
 Spans must be context-managed (`with trace.span(...)`): a span object
 held open across an exception never records, and manual enter/exit
@@ -7,7 +7,11 @@ histogram names must come from the declared registry in
 `jepsen_tpu.trace` (`DECLARED_METRICS` / `METRIC_PREFIXES`): the
 metrics surface is keyed by string, so one typo silently forks a
 series (`quarantined` vs `quarentined`) and every dashboard/bench
-diff downstream reads half the events.
+diff downstream reads half the events. Flight-recorder events must go
+through the typed `obs.emit` API with a kind declared in
+`obs.events.EVENT_KINDS` — an ad-hoc dict append to `events.jsonl`
+(or a typoed kind) forks the event stream exactly the way an
+undeclared metric forks a series.
 """
 
 from __future__ import annotations
@@ -107,4 +111,50 @@ class UndeclaredMetricName(ModuleRule):
             # lexical reach — runtime owns those
 
 
-RULES = [SpanNotContextManaged(), UndeclaredMetricName()]
+_EVENTS_FILE = "jepsen_tpu/obs/events.py"
+
+
+def _is_emit_call(n: ast.Call) -> bool:
+    """Any `*.emit(...)` or bare `emit(...)` call — receiver-agnostic,
+    so `from ..obs.events import emit` and aliased chains can't evade
+    the kind check (the runtime raises ValueError on an undeclared
+    kind, so an evading typo would be a production crash, not a lint
+    finding). Only calls whose first argument is a STRING LITERAL are
+    considered, which excludes every unrelated local `emit` helper in
+    the tree."""
+    f = n.func
+    return ((isinstance(f, ast.Attribute) and f.attr == "emit")
+            or (isinstance(f, ast.Name) and f.id == "emit"))
+
+
+class AdHocObsEvent(ModuleRule):
+    id = "JT-TRACE-003"
+    doc = ("flight-recorder events must be emitted via the typed "
+           "obs.emit API with a declared kind — ad-hoc events.jsonl "
+           "writes (or a typoed kind) fork the event stream")
+    hint = ("call obs.emit(<kind>, **fields); declare new kinds in "
+            "jepsen_tpu/obs/events.py EVENT_KINDS")
+
+    def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
+        if ctx.rel.endswith(_EVENTS_FILE):
+            return
+        from ..obs.events import EVENT_KINDS
+        for n in ast.walk(ctx.tree):
+            # the file name is private to obs/events.py: any other
+            # module naming the path is building an ad-hoc writer
+            if isinstance(n, ast.Constant) and isinstance(n.value, str) \
+                    and (n.value == "events.jsonl"           # jt-lint: ok JT-TRACE-003 (the rule's own match literal)
+                         or n.value.endswith("/events.jsonl")):   # jt-lint: ok JT-TRACE-003 (the rule's own match literal)
+                yield self.finding(
+                    ctx, n, "ad-hoc events.jsonl path — the flight "
+                            "recorder is written only by obs.events")
+            elif isinstance(n, ast.Call) and _is_emit_call(n) \
+                    and n.args:
+                kind = const_str(n.args[0])
+                if kind is not None and kind not in EVENT_KINDS:
+                    yield self.finding(
+                        ctx, n, f"undeclared obs event kind {kind!r}")
+
+
+RULES = [SpanNotContextManaged(), UndeclaredMetricName(),
+         AdHocObsEvent()]

@@ -1,0 +1,193 @@
+"""The bench-trajectory regression gate: `jepsen-tpu bench-report`.
+
+The repo ships one `BENCH_rNN.json` artifact per growth round, but
+nothing READ them: a regression in the north-star sweep, the warm
+ingest, or dp8 efficiency would only be noticed by a human diffing
+JSON. This module loads the whole series, prints a per-metric trend
+table, and exits non-zero when the latest round regresses past a
+declared threshold — `make bench-report` makes the trajectory police
+itself.
+
+Comparability rules (the series is heterogeneous by design):
+
+  * An artifact is either the driver wrapper ({"parsed": {...}}) or a
+    raw bench line; both load. A round whose bench died (no parseable
+    JSON) stays in the table as a dash column.
+  * A metric value only counts when it is a real number AND no dict on
+    its path carries an "error" key — a 0.0 that rode an outage is an
+    outage, not a measurement.
+  * Rounds are grouped by the artifact's "backend" field: a CPU
+    number is not comparable to a TPU number, so the gate compares the
+    LATEST present value of each metric against its most recent
+    same-backend predecessor only.
+
+Each metric declares its direction and a relative tolerance; `lint
+open findings` is absolute-zero-tolerance (any increase regresses).
+Exit codes: 0 clean, 1 regression(s), 254 nothing to report.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from pathlib import Path
+
+
+@dataclass(frozen=True)
+class MetricSpec:
+    key: str            # table row id
+    label: str          # human label
+    path: tuple         # path into the parsed bench dict
+    higher_is_better: bool
+    tolerance: float    # allowed relative slack before "regressed"
+
+
+#: The declared trajectory metrics and their regression thresholds.
+#: Tolerances are deliberately loose for wall-clock-noisy rates (CI
+#: boxes jitter) and tight for ratios the repo pins elsewhere.
+METRICS: tuple[MetricSpec, ...] = (
+    MetricSpec("elle_rate", "elle-append hist/s", ("value",),
+               True, 0.30),
+    MetricSpec("ns_rate", "north-star hist/s",
+               ("north_star", "value"), True, 0.30),
+    MetricSpec("ns_sweep_secs", "north-star sweep secs",
+               ("north_star", "sweep_secs"), False, 0.30),
+    MetricSpec("warm_ingest_x", "warm-ingest speedup",
+               ("north_star", "cache_warm", "ingest_speedup_vs_cold"),
+               True, 0.30),
+    MetricSpec("dp8_eff", "dp8 efficiency",
+               ("dp_scaling", "dp8_efficiency"), True, 0.15),
+    MetricSpec("mfu", "north-star MFU",
+               ("north_star", "mfu_measured"), True, 0.20),
+    MetricSpec("lint_open", "lint open findings",
+               ("lint", "findings_open"), False, 0.0),
+)
+
+
+def load_round(path) -> dict | None:
+    """The parsed bench dict of one artifact, or None when the round
+    recorded no parseable bench output (an outage round)."""
+    try:
+        data = json.loads(Path(path).read_text())
+    except (OSError, json.JSONDecodeError):
+        return None
+    if not isinstance(data, dict):
+        return None
+    parsed = data.get("parsed", data) if "parsed" in data else data
+    return parsed if isinstance(parsed, dict) else None
+
+
+def metric_value(parsed: dict | None, spec: MetricSpec):
+    """The metric's numeric value, or None when absent or tainted: any
+    dict on the path carrying "error" voids the reading (a bench block
+    that crashed reports value 0.0 — an outage, not a measurement)."""
+    d = parsed
+    for k in spec.path:
+        if not isinstance(d, dict) or d.get("error"):
+            return None
+        d = d.get(k)
+    if isinstance(d, bool) or not isinstance(d, (int, float)):
+        return None
+    return float(d)
+
+
+def _regressed(spec: MetricSpec, prev: float, last: float) -> bool:
+    if spec.higher_is_better:
+        return last < prev * (1.0 - spec.tolerance)
+    if prev == 0:
+        return last > spec.tolerance
+    return last > prev * (1.0 + spec.tolerance)
+
+
+def default_artifacts(root) -> list[Path]:
+    return sorted(Path(root).glob("BENCH_*.json"))
+
+
+def report(paths, out=print) -> int:
+    """Load the series, print the trend table, gate the latest round.
+    Returns the exit code."""
+    paths = [Path(p) for p in paths]
+    if not paths:
+        out("bench-report: no BENCH_*.json artifacts found")
+        return 254
+    rounds = []     # (name, backend, parsed|None)
+    for p in paths:
+        parsed = load_round(p)
+        backend = parsed.get("backend") if isinstance(parsed, dict) \
+            else None
+        name = p.stem.replace("BENCH_", "")
+        rounds.append((name, backend or "?", parsed))
+
+    name_w = max(len("metric"), *(len(s.label) for s in METRICS))
+    col_w = max(9, *(len(n) for n, _b, _p in rounds))
+    header = " | ".join([f"{'metric':<{name_w}}"]
+                        + [f"{n:>{col_w}}" for n, _b, _p in rounds])
+    out(header)
+    out(" | ".join([f"{'backend':<{name_w}}"]
+                   + [f"{b:>{col_w}}" for _n, b, _p in rounds]))
+    out("-" * len(header))
+
+    regressions: list[str] = []
+    for spec in METRICS:
+        cells = []
+        series = []     # (round name, backend, value) — present only
+        for name, backend, parsed in rounds:
+            v = metric_value(parsed, spec)
+            if v is None:
+                cells.append("—")
+            else:
+                series.append((name, backend, v))
+                cells.append(f"{v:g}")
+        # gate each backend group's LAST transition: a cpu regression
+        # must not hide behind a trailing hardware round, and cpu/tpu
+        # numbers are never compared to each other
+        groups: dict[str, list[tuple[str, float]]] = {}
+        for name, backend, v in series:
+            groups.setdefault(backend, []).append((name, v))
+        notes = []
+        for backend, vals in groups.items():
+            if len(vals) < 2:
+                continue
+            (p_name, prev), (l_name, last) = vals[-2], vals[-1]
+            delta = (last - prev) / prev if prev else 0.0
+            arrow = "+" if delta >= 0 else ""
+            note = f"[{backend} {arrow}{delta * 100:.1f}% vs {p_name}]"
+            if _regressed(spec, prev, last):
+                note += " REGRESSED"
+                regressions.append(
+                    f"{spec.label} ({backend}): {prev:g} ({p_name}) "
+                    f"-> {last:g} ({l_name}), tolerance "
+                    f"{spec.tolerance * 100:g}% "
+                    f"({'higher' if spec.higher_is_better else 'lower'}"
+                    f" is better)")
+            notes.append(note)
+        verdict = ("  " + " ".join(notes)) if notes else ""
+        out(" | ".join([f"{spec.label:<{name_w}}"]
+                       + [f"{c:>{col_w}}" for c in cells]) + verdict)
+
+    out("")
+    if regressions:
+        out(f"bench-report: {len(regressions)} metric(s) regressed "
+            "past their declared threshold:")
+        for r in regressions:
+            out(f"  - {r}")
+        return 1
+    out(f"bench-report: trajectory clean over {len(rounds)} round(s), "
+        f"{len(METRICS)} metrics")
+    return 0
+
+
+def add_args(p) -> None:
+    """The bench-report CLI surface (shared by the cli.py subcommand)."""
+    p.add_argument("artifacts", nargs="*",
+                   help="BENCH_*.json artifacts in round order "
+                        "(default: BENCH_*.json in --root, sorted)")
+    p.add_argument("--root", default=".",
+                   help="directory to glob BENCH_*.json from when no "
+                        "explicit artifacts are given")
+
+
+def run_from_args(args) -> int:
+    paths = [Path(a) for a in args.artifacts] \
+        or default_artifacts(args.root)
+    return report(paths)

@@ -1,0 +1,139 @@
+"""The flight-recorder event log: `<store>/events.jsonl`.
+
+trace.json is written at EXIT — a sweep that dies by SIGKILL leaves no
+causal record of what it was doing or why runs went unknown. The
+flight recorder closes that gap the way VerdictJournal does for
+verdicts: every discrete lifecycle event appends one JSON line,
+written and flushed as it happens, so the on-disk record is always as
+current as the last event.
+
+Events are TYPED: `emit(kind, **fields)` refuses a kind that is not
+declared in `EVENT_KINDS` (the same discipline as the gates registry —
+a typo must fail loudly, not fork an event stream), and lint rule
+JT-TRACE-003 enforces at the AST level that no module outside this one
+writes the events file or emits an undeclared kind.
+
+Concurrency/crash posture: each emit is one `open(append) → write one
+line → close`; the line is a single short `write()` on an O_APPEND
+descriptor, so concurrent emitters (the sampler thread, the sweep
+thread) interleave at line granularity and a crash tears at most the
+line in flight — `load_events` skips unparseable lines, like the
+journal's truncated-tail rule. Pool worker processes never install a
+log, so their `emit` calls are no-ops by construction.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from pathlib import Path
+
+log = logging.getLogger(__name__)
+
+EVENTS_NAME = "events.jsonl"
+
+#: The declared event kinds. An undeclared kind raises ValueError at
+#: emit time (and JT-TRACE-003 at lint time) — the event stream is an
+#: API surface, not a scratch pad.
+EVENT_KINDS = frozenset({
+    "sweep_start",        # checker, runs, resume
+    "sweep_resume",       # skipped (already-journaled runs)
+    "sweep_end",          # exit_code
+    "quarantine",         # stage, cause, histories|run
+    "oom_split",          # histories (bucket size being halved)
+    "watchdog_fire",      # timeout_s, attempt
+    "journal_seal",       # path (crash-torn journal tail sealed)
+    "cache_rebuild",      # path (corrupt/stale sidecar discarded)
+    "health_sample",      # seq (periodic heartbeat mark, first+last)
+    "metrics_serve",      # port (endpoint came up)
+})
+
+_lock = threading.Lock()
+_path: Path | None = None
+
+
+def install_events(store_base) -> Path | None:
+    """Point the flight recorder at `<store_base>/events.jsonl` (the
+    only place the file name exists — JT-TRACE-003 flags the literal
+    anywhere else). Best-effort: an uncreatable directory leaves the
+    recorder uninstalled rather than sinking the sweep."""
+    global _path
+    base = Path(store_base)
+    if not base.is_dir():
+        # a sweep of a nonexistent store is a usage error (exit 254);
+        # the recorder must not fabricate the directory for it
+        _path = None
+        return None
+    _path = base / EVENTS_NAME
+    return _path
+
+
+def reset_events() -> None:
+    """Uninstall the recorder (emit becomes a no-op)."""
+    global _path
+    _path = None
+
+
+def current_path() -> Path | None:
+    return _path
+
+
+def emit(kind: str, **fields) -> bool:
+    """Append one typed event; returns True when a line was written.
+    No-op (False) when no log is installed — callers never guard.
+    Undeclared kinds raise: that is a bug in the caller, caught by
+    lint and tests long before production."""
+    if kind not in EVENT_KINDS:
+        raise ValueError(f"undeclared obs event kind {kind!r} "
+                         "(declare it in obs.events.EVENT_KINDS)")
+    p = _path
+    if p is None:
+        return False
+    rec = {"event": kind,
+           "t_mono": round(time.monotonic(), 6),
+           "t_wall": round(time.time(), 6),
+           "pid": os.getpid(), **fields}
+    try:
+        line = json.dumps(rec) + "\n"
+    except (TypeError, ValueError):
+        log.debug("unserializable obs event %r dropped", kind,
+                  exc_info=True)
+        return False
+    try:
+        with _lock, open(p, "a") as f:
+            f.write(line)
+            f.flush()
+        return True
+    except OSError:
+        # a read-only store mount must not sink the sweep
+        log.debug("obs event append failed for %s", p, exc_info=True)
+        return False
+
+
+def load_events(path) -> list[dict]:
+    """Events from an existing log, in file order; unparseable lines
+    (the crash-torn tail) are skipped, mirroring VerdictJournal.load."""
+    out: list[dict] = []
+    p = Path(path)
+    if p.is_dir():
+        p = p / EVENTS_NAME
+    if not p.is_file():
+        return out
+    try:
+        lines = p.read_text().splitlines()
+    except OSError:
+        return out
+    for ln in lines:
+        ln = ln.strip()
+        if not ln:
+            continue
+        try:
+            e = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(e, dict) and "event" in e:
+            out.append(e)
+    return out

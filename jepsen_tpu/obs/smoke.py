@@ -1,0 +1,115 @@
+"""`python -m jepsen_tpu.obs.smoke` — the one-command live-telemetry
+smoke behind `make obs-smoke`.
+
+Builds a tiny throwaway store, runs a real `analyze-store` sweep with
+the health sampler and the `/metrics` endpoint force-enabled (interval
+0.2 s, ephemeral port), scrapes `/metrics` and `/healthz` once
+mid-flight via a hook, and asserts the contract the acceptance
+criteria pin: health.json snapshots exist and parse, the scraped
+counters match the final metrics.json, and the flight recorder holds
+the sweep's start/end events. Exit 0 on success, 1 with a reason on
+any violation. CPU-only, a few seconds end to end.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import sys
+import tempfile
+import urllib.request
+from pathlib import Path
+
+
+def main() -> int:
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from .. import cli, gates, trace
+    from ..checker.elle.synth import synth_append_history
+    from ..store import Store
+
+    gates.export("JEPSEN_TPU_HEALTH_INTERVAL_S", 0.2)
+    gates.export("JEPSEN_TPU_METRICS_PORT", 0)    # ephemeral
+
+    root = Path(tempfile.mkdtemp(prefix="obs-smoke-"))
+    try:
+        store = Store(root / "store")
+        for i in range(3):
+            d = store.base / "smoke" / f"2020010{i + 1}T000000"
+            d.mkdir(parents=True)
+            hist = synth_append_history(T=40, K=4, seed=i)
+            (d / "history.jsonl").write_text(
+                "\n".join(json.dumps(o) for o in hist) + "\n")
+
+        scraped: dict = {}
+
+        def on_obs_up(server, sampler):
+            """Mid-sweep scrape hook: the endpoint is live, the
+            sampler has written its first snapshot."""
+            base = f"http://127.0.0.1:{server.port}"
+            with urllib.request.urlopen(base + "/metrics",
+                                        timeout=10) as r:
+                scraped["metrics"] = r.read().decode()
+            with urllib.request.urlopen(base + "/healthz",
+                                        timeout=10) as r:
+                scraped["healthz"] = json.loads(r.read().decode())
+
+        rc = cli.analyze_store(store, checker="append",
+                               obs_hook=on_obs_up)
+        if rc != 0:
+            print(f"obs-smoke: sweep failed rc={rc}")
+            return 1
+        if "metrics" not in scraped:
+            print("obs-smoke: endpoint never scraped")
+            return 1
+        health = json.loads((store.base / "health.json").read_text())
+        if health["heartbeat"]["seq"] < 1 \
+                or health["progress"]["runs_total"] != 3 \
+                or health["progress"]["runs_verdicted"] != 3:
+            print(f"obs-smoke: bad final health snapshot: {health}")
+            return 1
+        if scraped["healthz"].get("v") != 1:
+            print(f"obs-smoke: bad /healthz: {scraped['healthz']}")
+            return 1
+        if "jepsen_tpu_shm_stale_reclaimed " not in scraped["metrics"]:
+            print("obs-smoke: mid-flight /metrics page malformed:\n"
+                  + scraped["metrics"])
+            return 1
+        # exposition↔metrics.json parity: rendering the sweep tracer
+        # now (it is still current) must carry every final counter at
+        # its final value — the mid-flight page is the same renderer
+        # over earlier state
+        from .prom import _name, render_prometheus
+        page_lines = render_prometheus(
+            trace.get_current()).splitlines()
+        final = json.loads((store.base / "metrics.json").read_text())
+        for name, v in final["counters"].items():
+            want = f"{_name(name)} {v}"
+            # whole-line match: a renderer bug that extends the value
+            # by a digit must not pass a prefix check
+            if want not in page_lines:
+                print(f"obs-smoke: {want!r} not in /metrics render")
+                return 1
+        for name in ("buckets_dispatched", "buckets_resolved",
+                     "runs_verdicted"):
+            if name not in final["counters"]:
+                print(f"obs-smoke: counter {name} missing from "
+                      "metrics.json")
+                return 1
+        from .events import load_events
+        evs = [e["event"] for e in load_events(store.base)]
+        if "sweep_start" not in evs or "sweep_end" not in evs:
+            print(f"obs-smoke: flight recorder incomplete: {evs}")
+            return 1
+        print("obs-smoke: OK — health.json "
+              f"(seq {health['heartbeat']['seq']}), /metrics scraped "
+              f"({len(scraped['metrics'].splitlines())} lines), "
+              f"{len(evs)} flight-recorder events")
+        return 0
+    finally:
+        trace.reset()
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

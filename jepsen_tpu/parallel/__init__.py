@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import supervisor as sv
 from .. import trace
+from ..obs import events as obs_events
 from ..checker.elle import kernels as K
 from ..devices import default_devices, ensure_platform_pin
 
@@ -450,6 +451,8 @@ def _block_flags(flags, tr):
             tr.counter("watchdog_timeouts").inc()
         tr.instant("watchdog_timeout", track="device",
                    timeout_s=timeout, attempt=_attempt)
+        obs_events.emit("watchdog_fire", timeout_s=timeout,
+                        attempt=_attempt)
     raise sv.WatchdogTimeout(
         f"device dispatch exceeded {timeout}s twice")
 
@@ -462,6 +465,8 @@ def _quarantine_bucket(idx: list, stage: str, err, tr) -> list:
         log.warning("quarantined %d histories (%s): %r",
                     len(idx), stage, err)
     e = repr(err)
+    obs_events.emit("quarantine", stage=stage, histories=len(idx),
+                    cause=e[:300])
     return [sv.Quarantined(stage, e) for _ in idx]
 
 
@@ -503,6 +508,8 @@ def _oom_backdown(encs, idx: list, mesh, budget_cells: int, kw: dict,
     if len(idx) == 1:
         return _quarantine_bucket(idx, "oom", err, tr)
     tr.counter("bucket_splits").inc()
+    obs_events.emit("oom_split", histories=len(idx),
+                    budget_cells=budget_cells)
     mid = (len(idx) + 1) // 2
     half_budget = max(1, budget_cells // 2)
     out: list = []
@@ -612,8 +619,13 @@ def check_bucketed_async(encs: Sequence, mesh: Mesh | None = None, *,
     _acc_phase(phases, "pack", t0)
 
     def finish(idx, flags, t_disp=None):
-        return _finish_part(encs, idx, flags, mesh, eff_budget, kw,
-                            tr, phases, t_disp)
+        out = _finish_part(encs, idx, flags, mesh, eff_budget, kw,
+                           tr, phases, t_disp)
+        # dispatched-vs-resolved parity for the live health snapshot:
+        # exactly the buckets `buckets_dispatched` counted resolve
+        # through here (sync-resolved OOM paths were never dispatched)
+        tr.counter("buckets_resolved").inc()
+        return out
 
     def resolve_oldest():
         j = inflight.pop(0)

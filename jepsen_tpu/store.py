@@ -348,6 +348,9 @@ class VerdictJournal:
                         torn = rf.read(1) != b"\n"
                     if torn:
                         self._f.write("\n")
+                        from .obs import events as obs_events
+                        obs_events.emit("journal_seal",
+                                        path=str(self.path))
             self._f.write(json.dumps(entry) + "\n")
             self._f.flush()
         except OSError:
@@ -704,6 +707,11 @@ def load_encoded(run_dir: str | os.PathLike, checker: str):
                         exceptions=(OSError,), exponential=True,
                         fatal=(FileNotFoundError,))
         if mm[:len(ENCODED_MAGIC)] != ENCODED_MAGIC:
+            # an existing sidecar without the magic is corruption, not
+            # a miss — the flight recorder gets the rebuild cause
+            from .obs import events as obs_events
+            obs_events.emit("cache_rebuild", path=str(p),
+                            cause="bad magic")
             return None
         hlen = int.from_bytes(
             mm[len(ENCODED_MAGIC):len(ENCODED_MAGIC) + 8], "little")
@@ -747,8 +755,11 @@ def load_encoded(run_dir: str | os.PathLike, checker: str):
                 if "key_names" in header else \
                 [pre_names[i] for i in arrays.pop("kid_to_pre").tolist()]
         return rebuild_encoded(checker, arrays, meta)
-    except Exception:
+    except Exception as e:
         log.debug("encoded-cache load failed for %s", p, exc_info=True)
+        from .obs import events as obs_events
+        obs_events.emit("cache_rebuild", path=str(p),
+                        cause=repr(e)[:200])
         return None
 
 

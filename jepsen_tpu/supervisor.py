@@ -34,7 +34,12 @@ loudly, which is the point of strict).
 
 Every recovery is tracer-attributed: `quarantined`, `oom_retries`,
 `bucket_splits`, `watchdog_timeouts` counters plus "quarantine" spans,
-surfaced in metrics.json and the bench JSON artifact.
+surfaced in metrics.json and the bench JSON artifact — and, since the
+live-telemetry layer (jepsen_tpu.obs), each recovery also lands as a
+typed flight-recorder event in the store's `events.jsonl`
+(quarantine/oom_split/watchdog_fire, emitted at the mechanism sites in
+`parallel` and `cli`), so a SIGKILLed sweep still leaves the causal
+record these counters only summarize.
 """
 
 from __future__ import annotations

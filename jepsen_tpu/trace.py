@@ -47,13 +47,14 @@ log = logging.getLogger(__name__)
 #: metric must be declared here before it can ship.
 DECLARED_METRICS: dict[str, frozenset] = {
     "counters": frozenset({
-        "bucket_splits", "buckets_dispatched", "cache_hits",
-        "cache_misses", "native_fallback", "oom_retries",
-        "pad_waste_cells", "quarantined", "shm_bytes",
-        "shm_stale_reclaimed", "split.native", "split.python",
-        "watchdog_timeouts",
+        "bucket_splits", "buckets_dispatched", "buckets_resolved",
+        "cache_hits", "cache_misses", "native_fallback", "oom_retries",
+        "pad_waste_cells", "quarantined", "runs_verdicted",
+        "shm_bytes", "shm_stale_reclaimed", "split.native",
+        "split.python", "watchdog_timeouts",
     }),
-    "gauges": frozenset({"inflight_depth", "reorder_depth"}),
+    "gauges": frozenset({"inflight_depth", "reorder_depth",
+                         "runs_total"}),
     "histograms": frozenset({"bucket_cells"}),
 }
 
@@ -67,6 +68,26 @@ METRIC_PREFIXES = ("phase.", "device.", "native_fallback.")
 DEVICE_TID = 2 ** 31 - 1
 
 _MLOCK = threading.Lock()   # shared metric read-modify-write lock
+
+
+def atomic_write_text(path, text: str) -> Path:
+    """Temp-file + `os.rename` persistence for trace.json/metrics.json
+    — the torn-tail discipline VerdictJournal already has. A crash
+    mid-flush must leave the previous complete artifact (or nothing),
+    never a truncated JSON that poisons later tooling."""
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    tmp = p.with_name(f".{p.name}.{os.getpid()}.tmp")
+    try:
+        tmp.write_text(text)
+        os.replace(tmp, p)
+    except BaseException:
+        try:
+            tmp.unlink(missing_ok=True)
+        except OSError:
+            pass
+        raise
+    return p
 
 
 def enabled() -> bool:
@@ -452,11 +473,9 @@ class Tracer:
     def export(self, path) -> Path:
         """Write Chrome trace-event JSON (Perfetto / chrome://tracing
         loadable) to `path`; returns the path."""
-        p = Path(path)
-        p.parent.mkdir(parents=True, exist_ok=True)
-        p.write_text(json.dumps({"traceEvents": self.chrome_events(),
-                                 "displayTimeUnit": "ms"}))
-        return p
+        return atomic_write_text(
+            path, json.dumps({"traceEvents": self.chrome_events(),
+                              "displayTimeUnit": "ms"}))
 
     def metrics_dict(self) -> dict:
         with _MLOCK:
@@ -473,10 +492,8 @@ class Tracer:
             }
 
     def export_metrics(self, path) -> Path:
-        p = Path(path)
-        p.parent.mkdir(parents=True, exist_ok=True)
-        p.write_text(json.dumps(self.metrics_dict(), indent=2))
-        return p
+        return atomic_write_text(
+            path, json.dumps(self.metrics_dict(), indent=2))
 
 
 # ---------------------------------------------------------------------------

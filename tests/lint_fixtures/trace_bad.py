@@ -1,5 +1,5 @@
-"""Seeded JT-TRACE violations (span + metric-name discipline)."""
-from jepsen_tpu import trace
+"""Seeded JT-TRACE violations (span/metric/obs-event discipline)."""
+from jepsen_tpu import obs, trace
 
 
 def unmanaged_span():
@@ -17,3 +17,16 @@ def kind_mismatch():
 
 def undeclared_dynamic(name):
     trace.counter(f"whatever.{name}").inc()               # EXPECT: JT-TRACE-002
+
+
+def adhoc_event_file(store):
+    return open(store / "events.jsonl", "a")              # EXPECT: JT-TRACE-003
+
+
+def typoed_event_kind():
+    obs.emit("sweep_strat", checker="append")             # EXPECT: JT-TRACE-003
+
+
+def imported_emit_typo():
+    from jepsen_tpu.obs.events import emit
+    emit("quarantene", cause="boom")                      # EXPECT: JT-TRACE-003

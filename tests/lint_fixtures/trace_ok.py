@@ -1,11 +1,21 @@
 """Clean twin of trace_bad.py: context-managed spans, declared metric
-names, declared dynamic prefixes."""
-from jepsen_tpu import trace
+names, declared dynamic prefixes, typed obs events."""
+from jepsen_tpu import obs, trace
 
 
 def managed_span():
     with trace.span("parse"):
         return 1
+
+
+def typed_event(store):
+    obs.install_events(store)
+    obs.emit("sweep_start", checker="append")
+
+
+def typed_event_imported(store):
+    from jepsen_tpu.obs.events import emit
+    emit("sweep_end", exit_code=0)
 
 
 def declared_metrics(component):

@@ -27,6 +27,8 @@ ALL_GATES = [
     "JEPSEN_TPU_TRACE",
     "JEPSEN_TPU_TRACE_MAX_EVENTS",
     "JEPSEN_TPU_JAX_PROFILE",
+    "JEPSEN_TPU_HEALTH_INTERVAL_S",
+    "JEPSEN_TPU_METRICS_PORT",
     "JEPSEN_TPU_BACKEND",
     "JEPSEN_TPU_PLATFORM",
     "JEPSEN_TPU_CLOSURE",
