@@ -8,7 +8,7 @@ ASAN_RT := $(shell gcc -print-file-name=libasan.so)
 TSAN_RT := $(shell gcc -print-file-name=libtsan.so)
 
 .PHONY: lint lint-json env-table test native native-sanitize bench \
-	bench-report obs-smoke
+	bench-report bench-warm obs-smoke
 
 # Self-hosted static analysis: gate registry, JAX hazards, concurrency
 # discipline, shm lifecycle, tracer discipline (jepsen_tpu/lint/).
@@ -74,6 +74,13 @@ bench:
 # threshold vs its same-backend predecessor.
 bench-report:
 	$(PY) -m jepsen_tpu.cli bench-report
+
+# The copy-free warm-path gate: smoke-shape cold -> warm -> warm-again
+# sweeps (each its own process, shared store + executable cache); fails
+# if the second warm run copies any host bytes on the pack path or
+# misses the AOT executable cache even once. Exit 0/1.
+bench-warm:
+	JAX_PLATFORMS=cpu $(PY) -m jepsen_tpu.warm_bench
 
 # Live-telemetry smoke: a tiny sweep with the health sampler and the
 # /metrics endpoint force-enabled, one mid-flight scrape, and an

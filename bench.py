@@ -652,45 +652,12 @@ def bench_generator(reps: int) -> dict:
 
 def _write_synth_store(root: Path, B: int, T: int, K: int,
                        bad_every: int) -> list[Path]:
-    """Materialize B serial list-append runs as history.jsonl dirs —
-    the same execution shape as synth_encoded_history (txn i appends
-    (key (i+rot)%K, pos i//K+1) and externally reads a key it has seen),
-    written as raw JSON lines without per-op dict churn. Every
-    `bad_every`-th history gets two adjacent txns reading EACH OTHER's
-    appends (one of them a future observation): mutual wr edges — a
-    G1c cycle for the classify pass to find, with no same-txn read
-    that would trip the encoder's `internal` check instead."""
-    dirs = []
-    for h in range(B):
-        rot = h % K
-        corrupt = bad_every and h % bad_every == bad_every - 1
-        a = T // 2
-        lines = []
-        for i in range(T):
-            ak = (i + rot) % K
-            ap = i // K + 1
-            rk = (i * 7 + 3 + rot) % K
-            first = (rk - rot) % K
-            rp = (i - 1 - first) // K + 1 if i > first else 0
-            if corrupt and i == a:          # reads txn a+1's append
-                rk, rp = (a + 1 + rot) % K, (a + 1) // K + 1
-            elif corrupt and i == a + 1:    # reads txn a's append
-                rk, rp = (a + rot) % K, a // K + 1
-            obs = list(range(1, rp + 1))
-            p = i % 5
-            lines.append(
-                f'{{"type":"invoke","process":{p},"f":"txn",'
-                f'"value":[["append",{ak},{ap}],["r",{rk},null]],'
-                f'"time":{2 * i * 1000},"index":{2 * i}}}')
-            lines.append(
-                f'{{"type":"ok","process":{p},"f":"txn",'
-                f'"value":[["append",{ak},{ap}],["r",{rk},{obs}]],'
-                f'"time":{(2 * i + 1) * 1000},"index":{2 * i + 1}}}')
-        d = root / f"run-{h:05d}"
-        d.mkdir()
-        (d / "history.jsonl").write_text("\n".join(lines) + "\n")
-        dirs.append(d)
-    return dirs
+    """The shared synthetic-store generator (moved to
+    checker.elle.synth so `make bench-warm` exercises the exact same
+    history shape): B serial list-append runs, every `bad_every`-th
+    seeded with a G1c cycle."""
+    from jepsen_tpu.checker.elle.synth import write_synth_store
+    return write_synth_store(root, B, T, K, bad_every)
 
 
 def _native_ingest_active() -> bool:
@@ -793,6 +760,8 @@ def bench_north_star(n_dev: int, devices) -> dict:
             return getattr(_tr.counter(name), "value", 0) or 0
 
         _CTRS = ("shm_bytes", "cache_hits", "cache_misses",
+                 "warm_copy_bytes", "h2d_bytes", "compile_cache_hits",
+                 "compile_cache_misses", "buffers_donated",
                  "quarantined", "oom_retries", "bucket_splits",
                  "watchdog_timeouts")
 
@@ -917,6 +886,9 @@ def bench_north_star(n_dev: int, devices) -> dict:
             warm_bad = sum(1 for v in warm["verdicts"]
                            if v["valid?"] is False)
             assert warm_bad == n_bad, (warm_bad, n_bad)
+            wk = warm["counters"]
+            warm_dispatches = (wk["compile_cache_hits"]
+                               + wk["compile_cache_misses"])
             cache_warm = {
                 "value": round(B / warm["t_sweep"], 2),
                 "sweep_secs": round(warm["t_sweep"], 3),
@@ -926,7 +898,15 @@ def bench_north_star(n_dev: int, devices) -> dict:
                 "phases": {k: round(warm["phases"].get(k, 0.0), 3)
                            for k in ("parse", "feed", "pack", "h2d",
                                      "dispatch", "collect", "render")},
-                **warm["counters"],
+                # the zero-copy contract, measured: host bytes copied
+                # for cache-loaded histories on THIS sweep's pack path
+                # (0 = every bucket fed device_put from the mmap) and
+                # the sweep's executable-cache hit rate (1.0 = zero
+                # XLA compiles — the ISSUE-7 acceptance numbers)
+                "compile_cache_hit_rate": (
+                    round(wk["compile_cache_hits"] / warm_dispatches, 3)
+                    if warm_dispatches else None),
+                **wk,
             }
         else:
             cache_warm = {"skipped": "JEPSEN_TPU_ENCODE_CACHE=0"}
@@ -1018,6 +998,10 @@ def bench_north_star(n_dev: int, devices) -> dict:
             "shm_bytes": cold["counters"]["shm_bytes"],
             "cache": {"hits": cold["counters"]["cache_hits"],
                       "misses": cold["counters"]["cache_misses"]},
+            "h2d_bytes": cold["counters"]["h2d_bytes"],
+            "compile_cache": {
+                "hits": cold["counters"]["compile_cache_hits"],
+                "misses": cold["counters"]["compile_cache_misses"]},
             # supervisor activity during the timed sweep — all zeros
             # on a healthy run (the bench injects no faults); nonzero
             # means the hardware OOM'd/stalled and the published rate

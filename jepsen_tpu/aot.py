@@ -1,0 +1,147 @@
+"""Persistent AOT executable cache: zero XLA compiles on warm sweeps.
+
+A bucketed sweep's kernels are keyed by a tiny tuple — bucket shape,
+batch size, flag set, closure formulation, backend — yet every fresh
+`analyze-store` process used to re-trace and re-compile each of them
+from scratch: tens of seconds of XLA time on the north-star shape
+before the first verdict, paid again on every repeat sweep over the
+same store. This module front-ends `jax.jit(...).lower(...).compile()`
+with two layers:
+
+  * an in-process map (compiled executables reused across buckets of
+    the same geometry — what jit's own tracing cache did, minus the
+    tracing), and
+  * a disk cache of serialized executables
+    (`jax.experimental.serialize_executable`), keyed by a digest of
+    (jax/jaxlib version, backend platform + device count, input
+    avals, kernel flags, formulation), so a REPEAT sweep in a fresh
+    process deserializes instead of compiling.
+
+Every lookup lands in exactly one of the `compile_cache_hits` /
+`compile_cache_misses` counters — the warm-path bench drives the miss
+count to zero and `make bench-warm` gates on it. Everything here is
+best-effort: a corrupt/incompatible cache entry (jax upgrade, topology
+change — both keyed, but belt and braces) degrades to a fresh compile,
+never to a failed sweep. Gates: `JEPSEN_TPU_AOT_CACHE` (default on),
+`JEPSEN_TPU_COMPILE_CACHE_DIR` (default `~/.cache/jepsen_tpu/
+executables`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import pickle
+import threading
+from pathlib import Path
+
+log = logging.getLogger(__name__)
+
+#: In-memory executables, bounded: a sweep sees a handful of bucket
+#: geometries, so 128 is generous; insertion order evicts oldest.
+_MEM_CAP = 128
+
+_mem: dict[str, object] = {}
+_lock = threading.Lock()
+
+
+def enabled() -> bool:
+    """One home for the JEPSEN_TPU_AOT_CACHE gate (default on)."""
+    from . import gates
+    return gates.get("JEPSEN_TPU_AOT_CACHE")
+
+
+def cache_dir() -> Path:
+    """The on-disk executable cache directory
+    (JEPSEN_TPU_COMPILE_CACHE_DIR overrides the default)."""
+    from . import gates
+    d = gates.get("JEPSEN_TPU_COMPILE_CACHE_DIR")
+    if d:
+        return Path(d)
+    return Path.home() / ".cache" / "jepsen_tpu" / "executables"
+
+
+def clear_memory() -> None:
+    """Drop the in-process executable map (tests; a backend restart)."""
+    with _lock:
+        _mem.clear()
+
+
+def _fingerprint(args, key_parts: tuple) -> str:
+    """Digest of everything that determines the compiled artifact:
+    toolchain versions, backend topology, input avals, kernel flags."""
+    import jax
+    try:
+        import jaxlib
+        jaxlib_v = jaxlib.__version__
+    except Exception:
+        jaxlib_v = ""
+    backend = jax.devices()[0].platform if jax.devices() else "none"
+    parts = [jax.__version__, jaxlib_v,
+             backend, str(jax.device_count()), repr(key_parts)]
+    for a in args:
+        parts.append(f"{tuple(a.shape)}:{a.dtype}")
+    return hashlib.sha256("|".join(map(str, parts)).encode()).hexdigest()
+
+
+def _disk_load(path: Path):
+    """Deserialize one cached executable, or None (missing/corrupt/
+    incompatible — the caller recompiles and overwrites)."""
+    try:
+        from jax.experimental import serialize_executable as se
+        payload, in_tree, out_tree = pickle.loads(path.read_bytes())
+        return se.deserialize_and_load(payload, in_tree, out_tree)
+    except FileNotFoundError:
+        return None
+    except Exception:
+        log.debug("AOT cache load failed for %s; recompiling",
+                  path, exc_info=True)
+        return None
+
+
+def _disk_store(path: Path, compiled) -> None:
+    """Serialize one executable, atomically (temp + rename — a crash
+    mid-write must never leave a torn entry for another process)."""
+    try:
+        from jax.experimental import serialize_executable as se
+        payload, in_tree, out_tree = se.serialize(compiled)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_name(f".{path.name}.{os.getpid()}.tmp")
+        tmp.write_bytes(pickle.dumps((payload, in_tree, out_tree)))
+        os.replace(tmp, path)
+    except Exception:
+        log.debug("AOT cache store failed for %s", path, exc_info=True)
+
+
+def compiled_for(jitfn, args, key_parts: tuple):
+    """The compiled executable for `jitfn` over `args`' avals: memory,
+    then disk, then `lower().compile()` (+ persist). Exactly one of
+    compile_cache_hits/compile_cache_misses increments per call. Any
+    failure in the AOT machinery returns the plain jitted fn — the
+    sweep must never be hostage to its own compile cache."""
+    from . import trace
+    try:
+        key = _fingerprint(args, key_parts)
+        with _lock:
+            hit = _mem.get(key)
+        if hit is not None:
+            trace.counter("compile_cache_hits").inc()
+            return hit
+        path = cache_dir() / f"{key}.jtx"
+        compiled = _disk_load(path)
+        if compiled is not None:
+            trace.counter("compile_cache_hits").inc()
+        else:
+            trace.counter("compile_cache_misses").inc()
+            compiled = jitfn.lower(*args).compile()
+            _disk_store(path, compiled)
+        with _lock:
+            if len(_mem) >= _MEM_CAP:
+                _mem.pop(next(iter(_mem)))
+            _mem[key] = compiled
+        return compiled
+    except Exception:
+        log.warning("AOT executable cache failed; dispatching via jit",
+                    exc_info=True)
+        return jitfn

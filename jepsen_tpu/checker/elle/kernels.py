@@ -102,6 +102,49 @@ def pack_batch(encs: list[EncodedHistory],
             "process": process, "shape": shape}
 
 
+def dispatch_shape(enc) -> "BatchShape | None":
+    """The BatchShape a v2 (dispatch-shaped) sidecar pre-padded this
+    encoding to, or None when it carries no dispatch views. The pad
+    plan lives in store.dispatch_pad_plan (jax-free, for pool
+    workers); this is the one place it re-enters the kernel type."""
+    p = getattr(enc, "dispatch_pad", None)
+    if not p or getattr(enc, "dispatch", None) is None:
+        return None
+    try:
+        return BatchShape(n_txns=p["n_txns"], n_appends=p["n_appends"],
+                          n_reads=p["n_reads"], n_keys=p["n_keys"],
+                          max_pos=p["max_pos"])
+    except KeyError:
+        return None
+
+
+def pack_batch_views(encs: list, shape: BatchShape) -> dict | None:
+    """Copy-free sibling of pack_batch: when EVERY encoding carries
+    dispatch-shaped mmap views (v2 sidecar warm path), return
+    per-field LISTS of those views instead of freshly-copied stacked
+    arrays — the h2d stage then device_puts each view straight from
+    the mapped pages, pads ragged ones ON DEVICE (a history's own pad
+    geometry may be smaller than the bucket max — `pad_to` is
+    monotone, so it is never larger), and stacks in HBM. The host
+    copies zero bytes either way. None when any encoding carries no
+    views (cold encodings, v1 cache) or claims a geometry beyond the
+    bucket's: the caller falls back to pack_batch, whose copies the
+    warm counters attribute."""
+    for e in encs:
+        ds = dispatch_shape(e)
+        if ds is None or ds.n_txns > shape.n_txns \
+                or ds.n_appends > shape.n_appends \
+                or ds.n_reads > shape.n_reads:
+            return None
+    fields = ("appends", "reads", "invoke_index", "complete_index",
+              "process")
+    out: dict = {f: [e.dispatch[f] for e in encs] for f in fields}
+    out["n_txns"] = np.asarray([e.n for e in encs], np.int32)
+    out["shape"] = shape
+    out["views"] = True
+    return out
+
+
 def fused_classify_enabled() -> bool:
     """One home for the JEPSEN_TPU_FUSED_CLASSIFY gate (default on):
     classify dispatches run the fused detect/classify kernel — one

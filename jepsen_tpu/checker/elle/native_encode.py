@@ -72,14 +72,17 @@ def _witness(code: int, f0: int, f1: int, f2: int, f3: int,
 
 
 def _write_sidecar(L, h, hist_path: Path, sidecar_path) -> None:
-    """Persist the encoded.v1 sidecar straight from the native
-    handle's buffers (store.py's flat layout, no Python round-trip).
+    """Persist the encoded sidecar straight from the native handle's
+    buffers (store.py's flat layout, no Python round-trip). The layout
+    version rides the target filename (`.v2.bin` = dispatch-shaped),
+    which store.encoded_cache_path already resolved from the gate.
     Best-effort: a 0 return just leaves the run uncached."""
     if sidecar_path is None:
         return
+    version = 2 if str(sidecar_path).endswith(".v2.bin") else 1
     try:
         L.jt_ha_write_sidecar(h, os.fsencode(str(hist_path)),
-                              os.fsencode(str(sidecar_path)))
+                              os.fsencode(str(sidecar_path)), version)
     except Exception:
         pass
 

@@ -166,3 +166,50 @@ def synth_append_history(T: int, K: int, seed: int = 0,
              "time": t + 3, "index": len(hist) + 3},
         ]
     return hist
+
+
+def write_synth_store(root, B: int, T: int, K: int,
+                      bad_every: int) -> list:
+    """Materialize B serial list-append runs as history.jsonl dirs —
+    the same execution shape as synth_encoded_history (txn i appends
+    (key (i+rot)%K, pos i//K+1) and externally reads a key it has
+    seen), written as raw JSON lines without per-op dict churn. Every
+    `bad_every`-th history gets two adjacent txns reading EACH OTHER's
+    appends (one of them a future observation): mutual wr edges — a
+    G1c cycle for the classify pass to find, with no same-txn read
+    that would trip the encoder's `internal` check instead. The ONE
+    synthetic-store generator, shared by bench.py's north-star block
+    and the `make bench-warm` gate so the two can't drift."""
+    from pathlib import Path
+    root = Path(root)
+    dirs = []
+    for h in range(B):
+        rot = h % K
+        corrupt = bad_every and h % bad_every == bad_every - 1
+        a = T // 2
+        lines = []
+        for i in range(T):
+            ak = (i + rot) % K
+            ap = i // K + 1
+            rk = (i * 7 + 3 + rot) % K
+            first = (rk - rot) % K
+            rp = (i - 1 - first) // K + 1 if i > first else 0
+            if corrupt and i == a:          # reads txn a+1's append
+                rk, rp = (a + 1 + rot) % K, (a + 1) // K + 1
+            elif corrupt and i == a + 1:    # reads txn a's append
+                rk, rp = (a + rot) % K, a // K + 1
+            obs = list(range(1, rp + 1))
+            p = i % 5
+            lines.append(
+                f'{{"type":"invoke","process":{p},"f":"txn",'
+                f'"value":[["append",{ak},{ap}],["r",{rk},null]],'
+                f'"time":{2 * i * 1000},"index":{2 * i}}}')
+            lines.append(
+                f'{{"type":"ok","process":{p},"f":"txn",'
+                f'"value":[["append",{ak},{ap}],["r",{rk},{obs}]],'
+                f'"time":{(2 * i + 1) * 1000},"index":{2 * i + 1}}}')
+        d = root / f"run-{h:05d}"
+        d.mkdir()
+        (d / "history.jsonl").write_text("\n".join(lines) + "\n")
+        dirs.append(d)
+    return dirs

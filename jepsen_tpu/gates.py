@@ -201,6 +201,21 @@ _g("JEPSEN_TPU_ENCODE_CACHE_WRITE", "bool", True,
 _g("JEPSEN_TPU_PACK_THREAD", "bool", True,
    "`0`: bucket packing + `device_put` stay inline on the "
    "dispatching thread instead of the dedicated pack-h2d thread")
+# -- warm path --------------------------------------------------------------
+_g("JEPSEN_TPU_SIDECAR_V2", "bool", True,
+   "`0`: write/read only v1 (unpadded) encoded sidecars — no "
+   "dispatch-shaped `encoded.v2.bin`, no v1→v2 upgrade, warm sweeps "
+   "pack with host copies as before")
+_g("JEPSEN_TPU_DONATE_BUFFERS", "bool", True,
+   "`0`: single-device bucket dispatches keep their input buffers "
+   "instead of donating them to XLA (`donate_argnums`) for reuse "
+   "across dispatches")
+_g("JEPSEN_TPU_AOT_CACHE", "bool", True,
+   "`0`: no persistent AOT executable cache — every process pays its "
+   "own XLA compiles (the in-memory jit cache still applies)")
+_g("JEPSEN_TPU_COMPILE_CACHE_DIR", "str", None,
+   "directory for the persistent AOT executable cache (default "
+   "`~/.cache/jepsen_tpu/executables`)")
 # -- robustness -------------------------------------------------------------
 _g("JEPSEN_TPU_STRICT", "bool", False,
    "set: restore fail-fast — no quarantine, no OOM backdown; the "

@@ -67,6 +67,8 @@ def encode_run_dir(run_dir: str | os.PathLike, checker: str = "append",
                 trace.counter("cache_hits").inc()
                 if info is not None:
                     info["cache"] = "hit"
+                    if getattr(enc, "upgraded", False):
+                        info["upgraded"] = True
                 return enc
             trace.counter("cache_misses").inc()
             if info is not None:
@@ -166,8 +168,24 @@ def _stream_worker(args):
     einfo: dict = {}
     try:
         enc = encode_run_dir(run_dir, checker, info=einfo)
-        if seg_name is not None:
-            from . import shm
+        from . import shm
+        from . import store as _store
+        if _store.sidecar_version(checker) == 2 \
+                and _store.encode_cache_enabled() \
+                and (einfo.get("cache") == "hit"
+                     or _store.encode_cache_write_enabled()) \
+                and _store.encoded_cache_path(run_dir, checker,
+                                              2).is_file():
+            # a dispatch-shaped sidecar answers for this run (warm
+            # hit, or this encode just wrote it — with cache writes
+            # DISABLED a merely-existing file may be stale, so only a
+            # validated hit qualifies): send a tiny reference and let
+            # the PARENT mmap it — copying the padded tensors through
+            # a shm segment would re-introduce the host copy the v2
+            # format exists to remove, and the parent's views must be
+            # its own mapping for the pack stage to stay copy-free
+            payload = shm.sidecar_ref(run_dir, checker)
+        elif seg_name is not None:
             payload = shm.export(enc, seg_name, checker)
         else:
             payload = enc
@@ -309,6 +327,7 @@ def iter_encode_chunks(run_dirs: Sequence[str | os.PathLike],
     #            serially from here instead of double-yielding
     if processes and processes > 0 and len(dirs) > 1 and _spawn_safe():
         from . import shm, trace
+        from . import store as _store
         use_shm = shm.enabled() and shm.available()
         names = [shm.gen_name() if use_shm else None for _ in dirs]
         consumed = [name is None for name in names]
@@ -339,7 +358,11 @@ def iter_encode_chunks(run_dirs: Sequence[str | os.PathLike],
             buf, span_buf = [], []
             for fut in as_completed(futs):
                 idx, payload, einfo, t0, t1 = fut.result()
-                if shm.is_descriptor(payload):
+                if shm.is_sidecar_ref(payload):
+                    # warm v2 hit: mmap the sidecar HERE, in the
+                    # consuming process — zero bytes crossed the pipe
+                    payload = shm.materialize_sidecar(payload)
+                elif shm.is_descriptor(payload):
                     tr.counter("shm_bytes").inc(payload["nbytes"])
                     payload = shm.materialize(payload)
                 consumed[idx] = True
@@ -347,6 +370,17 @@ def iter_encode_chunks(run_dirs: Sequence[str | os.PathLike],
                     tr.counter("cache_hits").inc()
                 elif einfo.get("cache") == "miss":
                     tr.counter("cache_misses").inc()
+                if einfo.get("upgraded"):
+                    # the worker's v1->v2 upgrade telemetry relayed
+                    # into THIS process (worker tracers/events are
+                    # process-local and never exported)
+                    tr.counter("sidecar_upgrades").inc()
+                    from .obs import events as obs_events
+                    obs_events.emit(
+                        "cache_rebuild",
+                        path=str(_store.encoded_cache_path(
+                            dirs[idx], checker, 2)),
+                        cause="v1->v2 upgrade")
                 # the worker's parse window lands on its own trace
                 # track (monotonic spans; the tracer converts), so
                 # trace.json shows parse/device overlap directly

@@ -176,7 +176,7 @@ def available() -> bool:
 
 def _bind_hist(L: ctypes.CDLL) -> bool:
     L.jt_ha_abi_version.restype = ctypes.c_int64
-    if L.jt_ha_abi_version() != 4:
+    if L.jt_ha_abi_version() != 5:
         return False
     i32p = ctypes.POINTER(ctypes.c_int32)
     i64p = ctypes.POINTER(ctypes.c_int64)
@@ -200,11 +200,11 @@ def _bind_hist(L: ctypes.CDLL) -> bool:
     L.jt_ha_pre_key_names_json.argtypes = [ctypes.c_void_p]
     L.jt_ha_free.restype = None
     L.jt_ha_free.argtypes = [ctypes.c_void_p]
-    # ABI v4: encoded.v1 sidecar writer + the bounded-hash primitive
-    # (parity-tested against store.xxh64)
+    # ABI v5: versioned sidecar writer (1 = lean, 2 = dispatch-shaped)
+    # + the bounded-hash primitive (parity-tested against store.xxh64)
     L.jt_ha_write_sidecar.restype = ctypes.c_int64
     L.jt_ha_write_sidecar.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                      ctypes.c_char_p]
+                                      ctypes.c_char_p, ctypes.c_int64]
     L.jt_xxh64_buf.restype = ctypes.c_uint64
     L.jt_xxh64_buf.argtypes = [ctypes.c_char_p, ctypes.c_int64,
                                ctypes.c_uint64]

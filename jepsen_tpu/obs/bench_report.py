@@ -55,6 +55,17 @@ METRICS: tuple[MetricSpec, ...] = (
     MetricSpec("warm_ingest_x", "warm-ingest speedup",
                ("north_star", "cache_warm", "ingest_speedup_vs_cold"),
                True, 0.30),
+    # the warm-path zero-copy contract: bytes the warm sweep copied on
+    # the host for cache-loaded histories (target 0 — tolerance 0.0
+    # means ANY growth over the predecessor regresses) and the warm
+    # sweep's executable-cache hit rate (target 1.0; a 10% dip means
+    # shapes started recompiling)
+    MetricSpec("warm_copy_b", "warm-copy bytes",
+               ("north_star", "cache_warm", "warm_copy_bytes"),
+               False, 0.0),
+    MetricSpec("compile_hit_rate", "warm compile-cache hit rate",
+               ("north_star", "cache_warm", "compile_cache_hit_rate"),
+               True, 0.10),
     MetricSpec("dp8_eff", "dp8 efficiency",
                ("dp_scaling", "dp8_efficiency"), True, 0.15),
     MetricSpec("mfu", "north-star MFU",

@@ -98,7 +98,8 @@ def sharded_check_fn(mesh: Mesh | None, shape: K.BatchShape, *,
                      process_order: bool = False,
                      use_pallas: bool | None = None,
                      use_int8: bool | None = None,
-                     fused: bool | None = None):
+                     fused: bool | None = None,
+                     donate: bool = False):
     """Build a jitted batched checker around kernels.check_batched_impl.
     With a mesh, inputs are expected sharded over 'dp' and the closure
     matrices are constrained to P('dp', None, 'mp'); without one, it's
@@ -128,9 +129,29 @@ def sharded_check_fn(mesh: Mesh | None, shape: K.BatchShape, *,
     # fused only exists in classify mode; normalize so detect-mode
     # dispatches never compile twice over an irrelevant flag
     fused = bool(fused) and classify
+    # donation is a dispatch-layer contract (the caller must treat its
+    # input arrays as consumed) — normalize it away under a mesh so
+    # the flag can't split the compile cache for sharded dispatches
+    donate = bool(donate) and mesh is None
     return _sharded_check_fn_cached(mesh, shape, classify, realtime,
                                     process_order, use_pallas, use_int8,
-                                    fused)
+                                    fused, donate)
+
+
+def _filter_cpu_donation_warning() -> None:
+    """On CPU — where XLA has no donation and ALWAYS warns — suppress
+    the 'donated buffers were not usable' warning. Installed at the
+    DISPATCH site (_donate_active), not inside the lru-cached compile:
+    anything may reset the warnings filters between dispatches (pytest
+    does, per test) and the warning fires at trace/lowering time, so
+    only a per-dispatch install actually covers every donated call;
+    filterwarnings de-duplicates identical entries itself. On real
+    accelerators the warning stays live: there it means a donation
+    actually failed, which is an actionable signal."""
+    if jax.default_backend() == "cpu":
+        import warnings
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
 
 
 @functools.lru_cache(maxsize=64)
@@ -139,7 +160,8 @@ def _sharded_check_fn_cached(mesh: Mesh | None, shape: K.BatchShape,
                              process_order: bool,
                              use_pallas: bool = False,
                              use_int8: bool = False,
-                             fused: bool = False):
+                             fused: bool = False,
+                             donate: bool = False):
     if mesh is not None:
         spec = P("dp", None, "mp")
 
@@ -157,6 +179,11 @@ def _sharded_check_fn_cached(mesh: Mesh | None, shape: K.BatchShape,
         constrain=constrain, use_pallas=use_pallas, use_int8=use_int8,
         fused=fused)
     if mesh is None:
+        if donate:
+            # donated inputs: XLA reuses the six packed tensors' HBM
+            # for the closure scratch instead of allocating fresh —
+            # the caller's arrays are CONSUMED by the call.
+            return jax.jit(f, donate_argnums=tuple(range(6)))
         return jax.jit(f)
     in_shard = NamedSharding(mesh, P("dp"))
     out_shard = NamedSharding(mesh, P("dp"))
@@ -165,10 +192,52 @@ def _sharded_check_fn_cached(mesh: Mesh | None, shape: K.BatchShape,
 
 def shard_batch(mesh: Mesh | None, packed: dict) -> tuple:
     """Device-put packed batch arrays, sharded over dp when a mesh is
-    given. Returns the 6 positional args for the check fn."""
+    given. Returns the 6 positional args for the check fn.
+
+    A views-packed dict (kernels.pack_batch_views — the v2-sidecar
+    warm path) carries per-history mmap views instead of stacked
+    arrays: each view is device_put straight from the mapped pages,
+    ragged views (a history padded to a smaller geometry than the
+    bucket max) are padded ON DEVICE with the pack fill convention,
+    and the batch axis is assembled in HBM (jnp.stack over device
+    arrays) — the host copies zero bytes between the sidecar and the
+    device. `h2d_bytes` counts what crossed to the device either
+    way."""
+    tr = trace.get_current()
     names = ("appends", "reads", "invoke_index", "complete_index",
              "process", "n_txns")
+    if packed.get("views"):
+        shape: K.BatchShape = packed["shape"]
+        targets = {"appends": (shape.n_appends, 3),
+                   "reads": (shape.n_reads, 3),
+                   "invoke_index": (shape.n_txns,),
+                   "complete_index": (shape.n_txns,),
+                   "process": (shape.n_txns,)}
+        fills = {"appends": -1, "reads": -1, "process": -1,
+                 "invoke_index": 0, "complete_index": 0}
+        args = []
+        nbytes = 0
+        for k in names[:-1]:
+            tgt, fill = targets[k], fills[k]
+            parts = []
+            for v in packed[k]:
+                nbytes += v.nbytes
+                dv = jax.device_put(v)
+                if v.shape != tgt:
+                    dv = jnp.pad(dv,
+                                 [(0, t - s)
+                                  for s, t in zip(v.shape, tgt)],
+                                 constant_values=fill)
+                parts.append(dv)
+            args.append(jnp.stack(parts))
+        args.append(jnp.asarray(packed["n_txns"]))
+        if tr.enabled:
+            tr.counter("h2d_bytes").inc(nbytes)
+        return tuple(args)
     args = [jnp.asarray(packed[k]) for k in names]
+    if tr.enabled:
+        tr.counter("h2d_bytes").inc(
+            sum(packed[k].nbytes for k in names))
     if mesh is not None:
         s = NamedSharding(mesh, P("dp"))
         args = [jax.device_put(a, s) for a in args]
@@ -295,9 +364,11 @@ class PendingVerdicts:
 
     def __init__(self, n: int, parts: list, finish=None):
         self._n = n
-        # [(bucket indices, flags, dispatch-enqueue time|None)] —
-        # flags is a live device array, or (already resolved) a list
-        # of per-history flag words / Quarantined aligned with indices
+        # [(bucket indices, flags, dispatch-enqueue time|None,
+        #   donated)] — flags is a live device array, or (already
+        # resolved) a list of per-history flag words / Quarantined
+        # aligned with indices; `donated` marks a dispatch holding a
+        # device-slot ledger entry the finish closure must release
         self._parts = parts
         # finish(idx, device_flags) -> resolved list: the dispatcher's
         # watchdog + OOM-backdown closure; None (bare construction)
@@ -311,7 +382,7 @@ class PendingVerdicts:
         whose flags are already ready before the next host stall must
         not count that stall as pipeline overlap."""
         return all(getattr(f, "is_ready", lambda: True)()
-                   for _, f, _ in self._parts)
+                   for _, f, _, _ in self._parts)
 
     def result(self, phases: dict | None = None) -> list[dict]:
         # Idempotent: callers can observe readiness and collect from
@@ -324,13 +395,13 @@ class PendingVerdicts:
         t0 = time.perf_counter()
         tr = trace.get_current()
         out: list[dict | None] = [None] * self._n
-        for idx, flags, t_disp in self._parts:
+        for idx, flags, t_disp, donated in self._parts:
             if not isinstance(flags, list):
                 if self._finish is not None:
                     # the finish closure owns the device window (logged
                     # on its success path only — a recovered bucket's
                     # device time is the backdown's own windows)
-                    flags = self._finish(idx, flags, t_disp)
+                    flags = self._finish(idx, flags, t_disp, donated)
                 else:
                     arr = np.asarray(jax.block_until_ready(flags))
                     # padded replicas (flags beyond the bucket's own
@@ -377,7 +448,15 @@ def _prep_bucket(encs: Sequence, bucket: list[int], mesh: Mesh | None,
     dp-replica padding, BatchShape planning and tensor packing. Runs on
     the packer thread when pack_thread_enabled(), inline otherwise —
     the tracer span lands on whichever thread did the work (its own
-    track in trace.json)."""
+    track in trace.json).
+
+    Single-device buckets try the copy-free views path first
+    (kernels.pack_batch_views): when every history carries
+    dispatch-shaped v2-sidecar views matching the planned shape, no
+    host tensor is built at all. Otherwise pack_batch copies as
+    before, and the bytes it copied for WARM (cache-loaded) histories
+    are attributed to `warm_copy_bytes` — the number the warm
+    north-star bench drives to zero."""
     t0 = time.perf_counter()
     group = [encs[i] for i in bucket]
     bucket_mesh = mesh
@@ -395,7 +474,18 @@ def _prep_bucket(encs: Sequence, bucket: list[int], mesh: Mesh | None,
         else:
             bucket_mesh = None
     shape = K.BatchShape.plan(group)
-    packed = K.pack_batch(group, shape)
+    packed = K.pack_batch_views(group, shape) \
+        if bucket_mesh is None else None
+    if packed is None:
+        packed = K.pack_batch(group, shape)
+        if tr.enabled:
+            warm = sum(
+                e.appends.nbytes + e.reads.nbytes
+                + e.invoke_index.nbytes + e.complete_index.nbytes
+                + e.process.nbytes
+                for e in group if getattr(e, "warm", False))
+            if warm:
+                tr.counter("warm_copy_bytes").inc(warm)
     if tr.enabled:
         # padding waste this dispatch pays: B_pad·T_pad² minus the
         # ORIGINAL bucket's own cells, so dp-replica padding (group
@@ -470,19 +560,63 @@ def _quarantine_bucket(idx: list, stage: str, err, tr) -> list:
     return [sv.Quarantined(stage, e) for _ in idx]
 
 
+def _dispatch_fn(bucket_mesh, shape: K.BatchShape, kw: dict, args,
+                 donate: bool):
+    """The callable for one bucket dispatch: the jitted check fn, or —
+    single-device with the AOT cache on — a persistent compiled
+    executable (jepsen_tpu.aot) keyed by the input avals + kernel
+    flags + formulation, so a repeat sweep pays zero XLA compiles."""
+    fn = sharded_check_fn(bucket_mesh, shape, donate=donate, **kw)
+    if bucket_mesh is not None:
+        return fn
+    from .. import aot
+    if not aot.enabled():
+        return fn
+    use_pallas, use_int8 = K.resolve_formulation(single_device=True)
+    key = (kw.get("classify", True), kw.get("realtime", False),
+           kw.get("process_order", False), kw.get("fused"),
+           use_pallas, use_int8, donate,
+           shape.n_keys, shape.max_pos, shape.n_txns)
+    return aot.compiled_for(fn, args, key)
+
+
+def _donate_active(bucket_mesh) -> bool:
+    active = bucket_mesh is None and sv.donate_buffers_enabled()
+    if active:
+        _filter_cpu_donation_warning()
+    return active
+
+
+def _note_donation(tr) -> None:
+    """One donated dispatch: six input buffers handed to XLA, one
+    ledger slot until the dispatch resolves."""
+    sv.slot_ledger.acquire()
+    tr.counter("buffers_donated").inc(6)
+
+
 def _sync_check(encs, idx: list, mesh, budget_cells: int, kw: dict,
                 tr, phases) -> np.ndarray:
     """One synchronous bucket check — the OOM-backdown retry path:
     pack, transfer, dispatch, block. Raises on OOM/watchdog; the
-    caller owns the split/quarantine policy."""
+    caller owns the split/quarantine policy. Donation here is
+    self-contained: the slot acquired for this retry releases in the
+    finally, whatever the outcome — backdown recursion holds only its
+    own halves' slots, never an ancestor's."""
     dp = mesh.devices.shape[0] if mesh is not None else 1
     bucket, bucket_mesh, shape, args = _h2d_bucket(
         _prep_bucket(encs, idx, mesh, dp, budget_cells, tr, phases),
         phases)
-    fn = sharded_check_fn(bucket_mesh, shape, **kw)
+    donate = _donate_active(bucket_mesh)
+    fn = _dispatch_fn(bucket_mesh, shape, kw, args, donate)
     sv.maybe_inject_oom()
-    t_disp = time.perf_counter()
-    arr = np.asarray(_block_flags(fn(*args), tr))
+    if donate:
+        _note_donation(tr)
+    try:
+        t_disp = time.perf_counter()
+        arr = np.asarray(_block_flags(fn(*args), tr))
+    finally:
+        if donate:
+            sv.slot_ledger.release()
     tr.device_complete("bucket", t_disp, histories=len(idx))
     return arr
 
@@ -531,19 +665,27 @@ def _oom_backdown(encs, idx: list, mesh, budget_cells: int, kw: dict,
 
 
 def _finish_part(encs, idx: list, flags, mesh, budget_cells: int,
-                 kw: dict, tr, phases, t_disp=None) -> list:
+                 kw: dict, tr, phases, t_disp=None,
+                 donated: bool = False) -> list:
     """Resolve one dispatched bucket to per-history flag words (padded
     replicas dropped), recovering from OOM (backdown) and watchdog
     timeouts (quarantine) unless strict. The dispatch->materialized
     device window closes HERE, on the success path only — a recovered
     bucket's device time is the backdown's own per-half windows
     (_sync_check), never the original window stretched over the whole
-    recovery (which would double-count the device track)."""
+    recovery (which would double-count the device track). A donated
+    dispatch's ledger slot releases the moment its fate is decided —
+    in particular BEFORE an OOM backdown re-plans, so a split bucket
+    drops its original slot and the halves acquire their own."""
     try:
         arr = np.asarray(_block_flags(flags, tr))
+        if donated:
+            sv.slot_ledger.release()
         tr.device_complete("bucket", t_disp, histories=len(idx))
         return [int(w) for w in arr[:len(idx)]]
     except BaseException as e:
+        if donated:
+            sv.slot_ledger.release()
         if isinstance(e, sv.WatchdogTimeout) and not sv.strict_enabled():
             return _quarantine_bucket(idx, "watchdog", e, tr)
         if sv.is_oom_error(e) and not sv.strict_enabled():
@@ -599,6 +741,14 @@ def check_bucketed_async(encs: Sequence, mesh: Mesh | None = None, *,
     sharding), "dispatch" (async kernel enqueue); `.result(phases)`
     and the max_inflight back-pressure add "collect" (block + D2H +
     flag rendering)."""
+    if mesh is not None and mesh.devices.size == 1:
+        # a 1-device mesh (analyze-store's make_mesh() on a single-
+        # device host) is single-device dispatch wearing a Mesh:
+        # normalize it away so the warm path — views pack, donated
+        # buffers, the AOT executable cache — applies to the REAL
+        # sweep, not just bare-mesh callers. Sharding over one device
+        # is an identity constraint; verdicts are unchanged.
+        mesh = None
     parts: list = []
     inflight: list[int] = []    # indices into parts, oldest first
     depth = max(1, max_inflight)
@@ -618,9 +768,9 @@ def check_bucketed_async(encs: Sequence, mesh: Mesh | None = None, *,
                if _est_cells(encs, b, dp) <= eff_budget]
     _acc_phase(phases, "pack", t0)
 
-    def finish(idx, flags, t_disp=None):
+    def finish(idx, flags, t_disp=None, donated=False):
         out = _finish_part(encs, idx, flags, mesh, eff_budget, kw,
-                           tr, phases, t_disp)
+                           tr, phases, t_disp, donated)
         # dispatched-vs-resolved parity for the live health snapshot:
         # exactly the buckets `buckets_dispatched` counted resolve
         # through here (sync-resolved OOM paths were never dispatched)
@@ -630,8 +780,9 @@ def check_bucketed_async(encs: Sequence, mesh: Mesh | None = None, *,
     def resolve_oldest():
         j = inflight.pop(0)
         t0 = time.perf_counter()
-        idx, flags, t_disp = parts[j]
-        parts[j] = (idx, finish(idx, flags, t_disp), None)
+        idx, flags, t_disp, donated = parts[j]
+        parts[j] = (idx, finish(idx, flags, t_disp, donated), None,
+                    False)
         tr.gauge("inflight_depth").set(len(inflight))
         _acc_phase(phases, "collect", t0)
 
@@ -641,17 +792,21 @@ def check_bucketed_async(encs: Sequence, mesh: Mesh | None = None, *,
         went down the backdown path — nothing joined the pipeline)."""
         bucket, bucket_mesh, shape, args = item
         t0 = time.perf_counter()
-        fn = sharded_check_fn(bucket_mesh, shape, **kw)
+        donate = _donate_active(bucket_mesh)
+        fn = _dispatch_fn(bucket_mesh, shape, kw, args, donate)
         try:
             sv.maybe_inject_oom()
-            parts.append((bucket, fn(*args), time.perf_counter()))
+            flags = fn(*args)
+            if donate:
+                _note_donation(tr)
+            parts.append((bucket, flags, time.perf_counter(), donate))
         except BaseException as e:
             if not sv.is_oom_error(e) or sv.strict_enabled():
                 raise
             _acc_phase(phases, "dispatch", t0)
             parts.append((bucket, _oom_backdown(
                 encs, bucket, mesh, eff_budget, kw, tr, phases, e),
-                None))
+                None, False))
             return False
         inflight.append(len(parts) - 1)
         tr.counter("buckets_dispatched").inc()
@@ -672,11 +827,11 @@ def check_bucketed_async(encs: Sequence, mesh: Mesh | None = None, *,
         if sv.is_oom_error(e):
             parts.append((bucket, _oom_backdown(
                 encs, bucket, mesh, eff_budget, kw, tr, phases, e),
-                None))
+                None, False))
         else:
             parts.append((bucket,
                           _quarantine_bucket(bucket, "pack", e, tr),
-                          None))
+                          None, False))
 
     _FAILED = object()
 

@@ -136,7 +136,12 @@ def export(enc, name: str, checker: str):
                     "max_pos": enc.max_pos, "key_names": enc.key_names,
                     "anomalies": enc.anomalies}
         return {"__jt_shm__": True, "name": name, "checker": checker,
-                "fields": layout, "nbytes": off, "meta": meta}
+                "fields": layout, "nbytes": off, "meta": meta,
+                # cache-hit provenance survives the transport: the
+                # parent's warm_copy_bytes attribution needs to know
+                # this encoding came from a sidecar even though the
+                # rebuild makes fresh view objects
+                "warm": bool(getattr(enc, "warm", False))}
     except Exception as e:
         log.debug("shm export failed (%r); item falls back to pickle",
                   e)
@@ -149,6 +154,45 @@ def export(enc, name: str, checker: str):
 
 def is_descriptor(payload) -> bool:
     return isinstance(payload, dict) and payload.get("__jt_shm__")
+
+
+# -- sidecar references ----------------------------------------------------
+#
+# A warm v2 cache hit must NOT ride shared memory: the worker's mmap
+# views would be memcpy'd into a segment and the parent's "zero-copy"
+# views would alias that copy — the exact host copy the dispatch-shaped
+# sidecar exists to remove, plus the parent-side encoding would lose
+# its `.dispatch` views entirely. Instead the worker sends a tiny
+# REFERENCE (run dir + checker) and the parent mmaps the sidecar
+# itself, so the pages the pack stage hands to device_put are the
+# parent's own mapping of the on-disk cache. The parent re-validates
+# the cache key on materialize (bounded hash — microseconds), so a
+# history rewritten between the worker's check and the parent's map
+# degrades to a re-encode, never to stale tensors.
+
+def sidecar_ref(run_dir, checker: str) -> dict:
+    """Worker side: the descriptor for a dispatch-shaped cache hit."""
+    return {"__jt_sidecar__": True, "dir": str(run_dir),
+            "checker": checker}
+
+
+def is_sidecar_ref(payload) -> bool:
+    return isinstance(payload, dict) and payload.get("__jt_sidecar__")
+
+
+def materialize_sidecar(ref: dict):
+    """Parent side: mmap the referenced sidecar. Falls back to a full
+    in-parent encode when the sidecar vanished or re-keyed between the
+    worker's hit and now (rare; correctness over speed)."""
+    from . import store as _store
+    enc = _store.load_encoded(ref["dir"], ref["checker"])
+    if enc is not None:
+        return enc
+    from .ingest import encode_run_dir
+    try:
+        return encode_run_dir(ref["dir"], ref["checker"])
+    except Exception as e:
+        return e
 
 
 def _orphan(seg) -> None:
@@ -195,8 +239,11 @@ def materialize(desc: dict):
                                   offset=off).reshape(shape)
     _orphan(seg)
     from . import store as _store
-    return _store.rebuild_encoded(desc["checker"], arrays,
-                                  desc["meta"])
+    enc = _store.rebuild_encoded(desc["checker"], arrays,
+                                 desc["meta"])
+    if desc.get("warm"):
+        enc.warm = True
+    return enc
 
 
 def _pid_alive(pid: int) -> bool:

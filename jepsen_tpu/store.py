@@ -391,7 +391,7 @@ class VerdictJournal:
 
 
 # ---------------------------------------------------------------------------
-# Persistent encoded cache: encoded.v1.bin sidecars.
+# Persistent encoded cache: encoded.v1.bin / encoded.v2.bin sidecars.
 #
 # Re-analysis sweeps (analyze-store --resume, repeated benches, CI) pay
 # the full history parse every time even though a run dir's history is
@@ -405,9 +405,47 @@ class VerdictJournal:
 # (native/hist_encode.cc, jt_ha_write_sidecar) writes the SAME layout
 # straight from its own buffers, so the C++ fast path never
 # round-trips through Python to populate the cache.
+#
+# v2 (dispatch-shaped, append checker only): the same container, but
+# the tensors the batch packer feeds the device are persisted
+# PRE-PADDED to the singleton bucket geometry the sweep planner would
+# choose (kernels.BatchShape.plan: txn axis to a multiple of 128,
+# triple/key axes to 8), with the effective completion keys
+# precomputed. A warm sweep whose bucket shape matches can then hand
+# the mmap views straight to device_put — no pack_batch, no host
+# copies (parallel counts `warm_copy_bytes` to prove it). The lean
+# (unpadded) arrays the rest of the package uses are SLICES of the
+# padded ones, so v2 costs no second copy on disk either. v1 sidecars
+# stay readable and are upgraded to v2 in place on first warm load
+# (`sidecar_upgrades` counter + a `cache_rebuild` event); the wr
+# checker keeps v1 — its edge-matrix packer has no padded-tensor fast
+# path to feed.
 # ---------------------------------------------------------------------------
 
 ENCODED_MAGIC = b"JTENC01\n"
+ENCODED_MAGIC_V2 = b"JTENC02\n"
+
+#: The dispatch-padding multiples — MUST mirror kernels.BatchShape.plan
+#: (txn axis 128 = the MXU tile, everything else 8); parity is pinned
+#: by tests/test_warm_path.py so the two can't drift. Kept local so
+#: pool workers writing sidecars never import jax.
+_PAD_TXNS = 128
+_PAD_MINOR = 8
+
+
+def _pad_up(x: int, multiple: int) -> int:
+    """kernels.pad_to, re-stated (round up to a positive multiple)."""
+    return max(multiple, ((x + multiple - 1) // multiple) * multiple)
+
+
+def dispatch_pad_plan(enc) -> dict:
+    """The padded geometry a singleton-bucket BatchShape.plan would
+    pick for this encoding — the shape the v2 sidecar persists at."""
+    return {"n_txns": _pad_up(enc.n, _PAD_TXNS),
+            "n_appends": _pad_up(len(enc.appends), _PAD_MINOR),
+            "n_reads": _pad_up(len(enc.reads), _PAD_MINOR),
+            "n_keys": _pad_up(enc.n_keys, _PAD_MINOR),
+            "max_pos": _pad_up(enc.max_pos, _PAD_MINOR)}
 
 # Per-checker array fields of a lean encoding, in canonical layout
 # order — the ONE list the shm transport (jepsen_tpu/shm.py) and the
@@ -581,10 +619,28 @@ def encode_cache_write_enabled() -> bool:
     return gates.get("JEPSEN_TPU_ENCODE_CACHE_WRITE")
 
 
-def encoded_cache_path(run_dir: str | os.PathLike, checker: str) -> Path:
+def sidecar_v2_enabled() -> bool:
+    """One home for the JEPSEN_TPU_SIDECAR_V2 gate (default on):
+    append sidecars are written dispatch-shaped (encoded.v2.bin) and
+    v1 sidecars upgrade in place on load. 0 pins the v1 format."""
+    from . import gates
+    return gates.get("JEPSEN_TPU_SIDECAR_V2")
+
+
+def sidecar_version(checker: str) -> int:
+    """The sidecar version the current env writes for `checker`: v2 is
+    append-only (the wr edge packer has no padded fast path)."""
+    return 2 if checker == "append" and sidecar_v2_enabled() else 1
+
+
+def encoded_cache_path(run_dir: str | os.PathLike, checker: str,
+                       version: int | None = None) -> Path:
     """The per-checker sidecar path: append and wr digests of the same
-    history are different tensors, so they cache separately."""
-    name = "encoded.v1.bin" if checker == "append" \
+    history are different tensors, so they cache separately. `version`
+    defaults to what the env would write (`sidecar_version`)."""
+    if version is None:
+        version = sidecar_version(checker)
+    name = f"encoded.v{version}.bin" if checker == "append" \
         else f"encoded-{checker}.v1.bin"
     return Path(run_dir) / name
 
@@ -610,47 +666,100 @@ def _align64(n: int) -> int:
     return (n + 63) & ~63
 
 
+def _padded_arrays(enc, pad: dict) -> list:
+    """[(field, contiguous ndarray)] for the v2 (dispatch-shaped)
+    sidecar: the lean arrays padded to `pad` with pack_batch's fill
+    convention (-1 dead triples/process rows, 0 dead index rows), plus
+    the two device-dtype dispatch tensors pack_batch would otherwise
+    compute per sweep — int32 invoke keys and int32 EFFECTIVE
+    completion keys (effective_complete_index precomputed, so the
+    warm path never touches `status` on the host)."""
+    import numpy as np
+
+    from .checker.elle.encode import effective_complete_index
+    T, A, R = pad["n_txns"], pad["n_appends"], pad["n_reads"]
+    n = enc.n
+    appends = np.full((A, 3), -1, np.int32)
+    appends[:len(enc.appends)] = np.asarray(enc.appends,
+                                            np.int32).reshape(-1, 3)
+    reads = np.full((R, 3), -1, np.int32)
+    reads[:len(enc.reads)] = np.asarray(enc.reads,
+                                        np.int32).reshape(-1, 3)
+    process = np.full(T, -1, np.int32)
+    process[:n] = np.asarray(enc.process, np.int32)
+    d_invoke = np.zeros(T, np.int32)
+    d_invoke[:n] = np.asarray(enc.invoke_index, np.int32)
+    d_complete = np.zeros(T, np.int32)
+    d_complete[:n] = effective_complete_index(
+        np.asarray(enc.status, np.int32),
+        np.asarray(enc.complete_index, np.int64)).astype(np.int32)
+    return [("appends", appends), ("reads", reads),
+            ("status", np.ascontiguousarray(enc.status, np.int32)),
+            ("process", process),
+            ("invoke_index",
+             np.ascontiguousarray(enc.invoke_index, np.int64)),
+            ("complete_index",
+             np.ascontiguousarray(enc.complete_index, np.int64)),
+            ("d_invoke", d_invoke), ("d_complete", d_complete)]
+
+
 def save_encoded(run_dir: str | os.PathLike, checker: str,
                  enc) -> Path | None:
-    """Write the flat encoded sidecar for a LEAN encoding. Best-effort:
-    any failure (non-JSON-able keys, read-only dir) returns None and
-    the run simply stays uncached. Layout — magic, int64 header length,
+    """Write the flat encoded sidecar for a LEAN encoding (v2 when
+    `sidecar_version(checker)` says so, else v1). Best-effort: any
+    failure (non-JSON-able keys, read-only dir) returns None and the
+    run simply stays uncached. Layout — magic, int64 header length,
     JSON header, zero pad to 64, then each tensor raw at the
     64-aligned offset its header entry records (relative to the data
-    start, itself align64(16 + header_len))."""
+    start, itself align64(16 + header_len)). A successful v2 write
+    also retires the run's v1 sidecar: two sidecars answering the same
+    key would just double the invalidation surface."""
     if not (encode_cache_enabled() and encode_cache_write_enabled()):
         return None
     d = Path(run_dir)
     src = _history_source(d)
     if src is None:
         return None
+    version = sidecar_version(checker)
     tmp = None
     try:
-        arrays = encoded_arrays(enc, checker)
-        if checker == "wr":
-            meta = {"n": enc.n, "key_count": enc.key_count}
-        else:
+        if version == 2:
+            pad = dispatch_pad_plan(enc)
+            arrays = _padded_arrays(enc, pad)
             meta = {"n": enc.n, "n_keys": enc.n_keys,
                     "max_pos": enc.max_pos,
-                    "key_names": list(enc.key_names)}
+                    "key_names": list(enc.key_names),
+                    "pad": pad,
+                    "lens": {"appends": len(enc.appends),
+                             "reads": len(enc.reads)}}
+            magic = ENCODED_MAGIC_V2
+        else:
+            arrays = encoded_arrays(enc, checker)
+            if checker == "wr":
+                meta = {"n": enc.n, "key_count": enc.key_count}
+            else:
+                meta = {"n": enc.n, "n_keys": enc.n_keys,
+                        "max_pos": enc.max_pos,
+                        "key_names": list(enc.key_names)}
+            magic = ENCODED_MAGIC
         off = 0
         entries = {}
         for name, a in arrays:
             off = _align64(off)
             entries[name] = [off, list(a.shape), a.dtype.str]
             off += a.nbytes
-        header = {"v": 1, "checker": checker, "src": src.name,
+        header = {"v": version, "checker": checker, "src": src.name,
                   "key": _cache_key(src), "arrays": entries,
                   "anomalies": enc.anomalies, **meta}
         hj = json.dumps(header).encode()
-        data_start = _align64(len(ENCODED_MAGIC) + 8 + len(hj))
-        out = encoded_cache_path(d, checker)
+        data_start = _align64(len(magic) + 8 + len(hj))
+        out = encoded_cache_path(d, checker, version)
         tmp = out.with_name(f".{out.name}.{os.getpid()}.tmp")
         with open(tmp, "wb") as f:
-            f.write(ENCODED_MAGIC)
+            f.write(magic)
             f.write(len(hj).to_bytes(8, "little"))
             f.write(hj)
-            f.write(b"\0" * (data_start - len(ENCODED_MAGIC) - 8
+            f.write(b"\0" * (data_start - len(magic) - 8
                              - len(hj)))
             pos = 0
             for name, a in arrays:
@@ -659,6 +768,11 @@ def save_encoded(run_dir: str | os.PathLike, checker: str,
                 f.write(memoryview(a).cast("B") if a.nbytes else b"")
                 pos = aligned + a.nbytes
         os.replace(tmp, out)
+        if version == 2:
+            try:
+                encoded_cache_path(d, checker, 1).unlink()
+            except OSError:
+                pass
         return out
     except Exception:
         log.debug("encoded-cache write failed for %s", d, exc_info=True)
@@ -674,25 +788,73 @@ def load_encoded(run_dir: str | os.PathLike, checker: str):
     """mmap the encoded sidecar back into an EncodedHistory/WrEncoded
     (zero-copy views over the mapped pages), or None on miss: no
     sidecar, stale key (history changed), wrong checker, or any parse
-    failure. Handles both writer dialects — the Python writer embeds
-    lean anomalies as JSON; the native writer stores raw anomaly rows
-    + the pre-key name table, decoded here with the exact `_witness`
-    mapping the in-process native path uses."""
+    failure. Prefers the dispatch-shaped v2 sidecar when the gate is
+    on (the returned encoding then carries `.dispatch` — pre-padded
+    mmap views the batch packer can feed to device_put copy-free —
+    and `.dispatch_pad`, the geometry they were padded to); a v1-only
+    run upgrades to v2 in place on the way through. Every cache-loaded
+    encoding is flagged `.warm = True` so the pack stage can attribute
+    `warm_copy_bytes` honestly."""
     if not encode_cache_enabled():
         return None
     d = Path(run_dir)
-    p = encoded_cache_path(d, checker)
+    src = _history_source(d)
+    if src is None:
+        return None
+    want_v2 = sidecar_version(checker) == 2
+    if want_v2:
+        enc = _load_sidecar(encoded_cache_path(d, checker, 2), 2,
+                            checker, src)
+        if enc is not None:
+            return enc
+    enc = _load_sidecar(encoded_cache_path(d, checker, 1), 1,
+                        checker, src)
+    if enc is None:
+        return None
+    if want_v2 and encode_cache_write_enabled():
+        enc = _upgrade_sidecar(d, checker, enc)
+    return enc
+
+
+def _upgrade_sidecar(run_dir: Path, checker: str, enc):
+    """v1 → v2 in place: rewrite the sidecar dispatch-shaped and serve
+    the v2 views. A failed write (read-only mount) keeps serving the
+    v1 encoding — the upgrade is an optimization, never a gate."""
+    out = save_encoded(run_dir, checker, enc)
+    if out is None:
+        return enc
+    from . import trace
+    trace.counter("sidecar_upgrades").inc()
+    from .obs import events as obs_events
+    obs_events.emit("cache_rebuild", path=str(out),
+                    cause="v1->v2 upgrade")
+    src = _history_source(Path(run_dir))
+    enc2 = _load_sidecar(out, 2, checker, src) if src is not None \
+        else None
+    if enc2 is not None:
+        # pool workers' tracers/events are process-local and never
+        # exported: flag the encoding so ingest can relay the upgrade
+        # to the PARENT's counter + flight recorder (info["upgraded"])
+        enc2.upgraded = True
+        return enc2
+    return enc
+
+
+def _load_sidecar(p: Path, version: int, checker: str, src: Path):
+    """One sidecar file → encoding, or None on miss/corruption.
+    Handles both writer dialects at either version — the Python writer
+    embeds lean anomalies as JSON; the native writer stores raw
+    anomaly rows + the pre-key name table, decoded here with the exact
+    `_witness` mapping the in-process native path uses."""
     if not p.is_file():
         return None
+    magic = ENCODED_MAGIC_V2 if version == 2 else ENCODED_MAGIC
     try:
         import mmap as _mmap
 
         import numpy as np
 
         from .util import with_retry
-        src = _history_source(d)
-        if src is None:
-            return None
 
         def _map():
             with open(p, "rb") as f:
@@ -706,7 +868,7 @@ def load_encoded(run_dir: str | os.PathLike, checker: str):
         mm = with_retry(_map, retries=2, backoff=0.005,
                         exceptions=(OSError,), exponential=True,
                         fatal=(FileNotFoundError,))
-        if mm[:len(ENCODED_MAGIC)] != ENCODED_MAGIC:
+        if mm[:len(magic)] != magic:
             # an existing sidecar without the magic is corruption, not
             # a miss — the flight recorder gets the rebuild cause
             from .obs import events as obs_events
@@ -714,15 +876,16 @@ def load_encoded(run_dir: str | os.PathLike, checker: str):
                             cause="bad magic")
             return None
         hlen = int.from_bytes(
-            mm[len(ENCODED_MAGIC):len(ENCODED_MAGIC) + 8], "little")
+            mm[len(magic):len(magic) + 8], "little")
         header = json.loads(
-            mm[len(ENCODED_MAGIC) + 8:len(ENCODED_MAGIC) + 8 + hlen])
-        if header.get("v") != 1 or header.get("checker") != checker \
+            mm[len(magic) + 8:len(magic) + 8 + hlen])
+        if header.get("v") != version \
+                or header.get("checker") != checker \
                 or header.get("src") != src.name:
             return None
         if header.get("key") != _cache_key(src):
             return None
-        data_start = _align64(len(ENCODED_MAGIC) + 8 + hlen)
+        data_start = _align64(len(magic) + 8 + hlen)
         arrays = {}
         for name, (off, shape, dt) in header["arrays"].items():
             n = 1
@@ -754,13 +917,50 @@ def load_encoded(run_dir: str | os.PathLike, checker: str):
             meta["key_names"] = header["key_names"] \
                 if "key_names" in header else \
                 [pre_names[i] for i in arrays.pop("kid_to_pre").tolist()]
-        return rebuild_encoded(checker, arrays, meta)
+        if version == 2:
+            enc = _rebuild_v2(arrays, meta, header)
+        else:
+            enc = rebuild_encoded(checker, arrays, meta)
+        enc.warm = True
+        return enc
     except Exception as e:
         log.debug("encoded-cache load failed for %s", p, exc_info=True)
         from .obs import events as obs_events
         obs_events.emit("cache_rebuild", path=str(p),
                         cause=repr(e)[:200])
         return None
+
+
+def _rebuild_v2(arrays: dict, meta: dict, header: dict):
+    """(padded mmap arrays + scalars) → EncodedHistory whose lean
+    fields are SLICES of the padded tensors and whose `.dispatch` dict
+    holds the full dispatch-shaped views (pack order: appends, reads,
+    invoke, complete(effective), process) ready for device_put."""
+    from .checker.elle.encode import EncodedHistory
+    n = int(meta["n"])
+    lens = header["lens"]
+    pad = header["pad"]
+    enc = EncodedHistory()
+    enc.n = n
+    enc.n_keys = int(meta["n_keys"])
+    enc.max_pos = int(meta["max_pos"])
+    enc.key_names = meta["key_names"]
+    enc.appends = arrays["appends"][:int(lens["appends"])]
+    enc.reads = arrays["reads"][:int(lens["reads"])]
+    enc.status = arrays["status"]
+    enc.process = arrays["process"][:n]
+    enc.invoke_index = arrays["invoke_index"]
+    enc.complete_index = arrays["complete_index"]
+    enc.op_index = arrays["complete_index"]
+    enc.anomalies = meta["anomalies"]
+    enc.txn_ops = []
+    enc.dispatch = {"appends": arrays["appends"],
+                    "reads": arrays["reads"],
+                    "invoke_index": arrays["d_invoke"],
+                    "complete_index": arrays["d_complete"],
+                    "process": arrays["process"]}
+    enc.dispatch_pad = {k: int(v) for k, v in pad.items()}
+    return enc
 
 
 def _results_to_edn(v: Any) -> Any:

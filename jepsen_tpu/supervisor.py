@@ -95,6 +95,65 @@ def quarantine_verdict(error, stage: str,
     return res
 
 
+def donate_buffers_enabled() -> bool:
+    """One home for the JEPSEN_TPU_DONATE_BUFFERS gate (default on):
+    single-device bucket dispatches compile with `donate_argnums` over
+    the six packed input tensors, so XLA reuses their HBM for the
+    closure scratch instead of allocating fresh — the per-dispatch
+    footprint drops by the inputs' size and repeat dispatches cycle
+    the same arena. 0 keeps inputs alive across the call (debugging,
+    backends where donation misbehaves)."""
+    from . import gates
+    return gates.get("JEPSEN_TPU_DONATE_BUFFERS")
+
+
+class DeviceSlotLedger:
+    """Accounting for donated device-buffer slots: every donated
+    dispatch acquires one slot (its six input buffers now belong to
+    XLA) and MUST release it on every exit path — success, watchdog
+    quarantine, or OOM backdown. The backdown contract in particular:
+    a split bucket's original slot is released BEFORE the halves
+    re-plan (each half packs fresh buffers and acquires its own slot),
+    so recovery can never leak slots however deep the recursion goes.
+    The ledger is bookkeeping, not allocation — XLA frees donated
+    buffers itself — but a nonzero `inflight()` after a drained sweep
+    means some dispatch path lost track of its buffers, which is
+    exactly the class of leak the tests pin to zero. Thread-safe (the
+    pack-h2d thread and the dispatcher both touch it); the
+    `donate_slots_inflight` gauge mirrors every transition."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight = 0
+
+    def _gauge(self, v: int) -> None:
+        from . import trace
+        trace.gauge("donate_slots_inflight").set(v)
+
+    def acquire(self) -> None:
+        # gauge published INSIDE the lock: concurrent transitions must
+        # not publish stale values out of order (a drained sweep whose
+        # last publish lost the race would read nonzero forever)
+        with self._lock:
+            self._inflight += 1
+            self._gauge(self._inflight)
+
+    def release(self) -> None:
+        with self._lock:
+            # never below zero: a non-donated resolve path calling
+            # release must be a no-op, not negative bookkeeping
+            self._inflight = max(0, self._inflight - 1)
+            self._gauge(self._inflight)
+
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+
+#: Process-wide ledger the dispatch layer (parallel) threads through.
+slot_ledger = DeviceSlotLedger()
+
+
 def strict_enabled() -> bool:
     """JEPSEN_TPU_STRICT=1 restores fail-fast: no quarantine, no OOM
     backdown — the first failure raises to the caller (CI bisection,

@@ -48,13 +48,15 @@ log = logging.getLogger(__name__)
 DECLARED_METRICS: dict[str, frozenset] = {
     "counters": frozenset({
         "bucket_splits", "buckets_dispatched", "buckets_resolved",
-        "cache_hits", "cache_misses", "native_fallback", "oom_retries",
-        "pad_waste_cells", "quarantined", "runs_verdicted",
-        "shm_bytes", "shm_stale_reclaimed", "split.native",
-        "split.python", "watchdog_timeouts",
+        "buffers_donated", "cache_hits", "cache_misses",
+        "compile_cache_hits", "compile_cache_misses", "h2d_bytes",
+        "native_fallback", "oom_retries", "pad_waste_cells",
+        "quarantined", "runs_verdicted", "shm_bytes",
+        "shm_stale_reclaimed", "sidecar_upgrades", "split.native",
+        "split.python", "warm_copy_bytes", "watchdog_timeouts",
     }),
-    "gauges": frozenset({"inflight_depth", "reorder_depth",
-                         "runs_total"}),
+    "gauges": frozenset({"donate_slots_inflight", "inflight_depth",
+                         "reorder_depth", "runs_total"}),
     "histograms": frozenset({"bucket_cells"}),
 }
 

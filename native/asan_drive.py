@@ -71,8 +71,11 @@ def tsan_drive() -> None:
                         dims = (ctypes.c_int64 * 8)()
                         lib.jt_ha_dims(h, dims)
                         side = td / f"side.t{tid}.{it}.bin"
+                        # alternate v1/v2 layouts so both sidecar
+                        # writers run under the sanitizer
                         lib.jt_ha_write_sidecar(
-                            h, str(p).encode(), str(side).encode())
+                            h, str(p).encode(), str(side).encode(),
+                            1 + (it % 2))
                         lib.jt_ha_free(h)
                     lib.jt_xxh64_buf(shared_buf, len(shared_buf), tid)
             except BaseException as e:  # surfaced on the main thread

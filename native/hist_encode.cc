@@ -1906,18 +1906,61 @@ static void sc_entry(std::string& js, const SidecarArray& a,
   js += "\"]";
 }
 
+// Dispatch-padding multiples — MUST match store.py's _PAD_TXNS /
+// _PAD_MINOR (themselves mirrors of kernels.BatchShape.plan).
+static constexpr int64_t PAD_TXNS = 128;
+static constexpr int64_t PAD_MINOR = 8;
+static constexpr int64_t SC_NEVER = int64_t(1) << 30;  // NEVER_COMPLETED
+
+static inline int64_t pad_up(int64_t x, int64_t m) {
+  int64_t p = ((x + m - 1) / m) * m;
+  return p < m ? m : p;
+}
+
 static bool write_sidecar(Handle* h, const char* hist_path,
-                          const char* out_path) {
+                          const char* out_path, int64_t version) {
   int64_t size, mtime_ns;
   uint64_t hash;
+  if (h->wr) version = 1;   // wr has no dispatch-shaped format
   if (!file_cache_key(hist_path, size, mtime_ns, hash)) return false;
   const char* base = strrchr(hist_path, '/');
   base = base ? base + 1 : hist_path;
+
+  // v2 (append): the device-facing tensors persisted PRE-PADDED to the
+  // singleton bucket geometry (store.py's dispatch_pad_plan), dead
+  // triples/process rows filled -1, dead index rows 0, plus the two
+  // int32 dispatch tensors pack_batch would otherwise compute per
+  // sweep (invoke keys, EFFECTIVE completion keys). The lean arrays
+  // the Python loader slices out of them stay byte-identical to v1's.
+  int64_t t_pad = pad_up(h->n, PAD_TXNS);
+  int64_t a_pad = pad_up((int64_t)(h->appends.size() / 3), PAD_MINOR);
+  int64_t r_pad = pad_up((int64_t)(h->reads.size() / 3), PAD_MINOR);
+  std::vector<int32_t> appends_p, reads_p, process_p, d_invoke,
+      d_complete;
+  if (version == 2) {
+    appends_p.assign((size_t)(a_pad * 3), -1);
+    std::copy(h->appends.begin(), h->appends.end(), appends_p.begin());
+    reads_p.assign((size_t)(r_pad * 3), -1);
+    std::copy(h->reads.begin(), h->reads.end(), reads_p.begin());
+    process_p.assign((size_t)t_pad, -1);
+    std::copy(h->process.begin(), h->process.end(), process_p.begin());
+    d_invoke.assign((size_t)t_pad, 0);
+    d_complete.assign((size_t)t_pad, 0);
+    for (int64_t r = 0; r < h->n; ++r) {
+      d_invoke[(size_t)r] = (int32_t)h->invoke_index[(size_t)r];
+      d_complete[(size_t)r] = (int32_t)(
+          h->status[(size_t)r] == 1 ? SC_NEVER + r
+                                    : h->complete_index[(size_t)r]);
+    }
+  }
 
   std::vector<SidecarArray> arrays;
   if (h->wr) {
     arrays.push_back({"edges", h->edges.data(),
                       (int64_t)(h->edges.size() / 3), 3, 4});
+  } else if (version == 2) {
+    arrays.push_back({"appends", appends_p.data(), a_pad, 3, 4});
+    arrays.push_back({"reads", reads_p.data(), r_pad, 3, 4});
   } else {
     arrays.push_back({"appends", h->appends.data(),
                       (int64_t)(h->appends.size() / 3), 3, 4});
@@ -1926,12 +1969,19 @@ static bool write_sidecar(Handle* h, const char* hist_path,
   }
   arrays.push_back({"status", h->status.data(),
                     (int64_t)h->status.size(), 0, 4});
-  arrays.push_back({"process", h->process.data(),
-                    (int64_t)h->process.size(), 0, 4});
+  if (version == 2)
+    arrays.push_back({"process", process_p.data(), t_pad, 0, 4});
+  else
+    arrays.push_back({"process", h->process.data(),
+                      (int64_t)h->process.size(), 0, 4});
   arrays.push_back({"invoke_index", h->invoke_index.data(),
                     (int64_t)h->invoke_index.size(), 0, 8});
   arrays.push_back({"complete_index", h->complete_index.data(),
                     (int64_t)h->complete_index.size(), 0, 8});
+  if (version == 2) {
+    arrays.push_back({"d_invoke", d_invoke.data(), t_pad, 0, 4});
+    arrays.push_back({"d_complete", d_complete.data(), t_pad, 0, 4});
+  }
   arrays.push_back({"anom", h->anomalies.data(),
                     (int64_t)(h->anomalies.size() / 5), 5, 8});
   if (!h->wr)
@@ -1950,7 +2000,9 @@ static bool write_sidecar(Handle* h, const char* hist_path,
   char keybuf[17];
   snprintf(keybuf, sizeof keybuf, "%016llx",
            (unsigned long long)hash);
-  std::string js = "{\"v\":1,\"checker\":\"";
+  std::string js = "{\"v\":";
+  js += std::to_string(version);
+  js += ",\"checker\":\"";
   js += h->wr ? "wr" : "append";
   js += "\",\"src\":";
   append_json_string(js, std::string(base));
@@ -1978,10 +2030,27 @@ static bool write_sidecar(Handle* h, const char* hist_path,
     js += ",\"max_pos\":";
     js += std::to_string(h->max_pos);
   }
+  if (version == 2) {
+    js += ",\"pad\":{\"n_txns\":";
+    js += std::to_string(t_pad);
+    js += ",\"n_appends\":";
+    js += std::to_string(a_pad);
+    js += ",\"n_reads\":";
+    js += std::to_string(r_pad);
+    js += ",\"n_keys\":";
+    js += std::to_string(pad_up(h->n_keys, PAD_MINOR));
+    js += ",\"max_pos\":";
+    js += std::to_string(pad_up(h->max_pos, PAD_MINOR));
+    js += "},\"lens\":{\"appends\":";
+    js += std::to_string((int64_t)(h->appends.size() / 3));
+    js += ",\"reads\":";
+    js += std::to_string((int64_t)(h->reads.size() / 3));
+    js += '}';
+  }
   js += '}';
 
-  static const char MAGIC[8] = {'J', 'T', 'E', 'N', 'C', '0', '1',
-                                '\n'};
+  const char MAGIC[8] = {'J', 'T', 'E', 'N', 'C', '0',
+                         version == 2 ? '2' : '1', '\n'};
   int64_t hlen = (int64_t)js.size();
   int64_t data_start = align64(16 + hlen);
 
@@ -2014,22 +2083,37 @@ static bool write_sidecar(Handle* h, const char* hist_path,
     remove(tmp.c_str());
     return false;
   }
+  if (version == 2) {
+    // retire the run's v1 sidecar, mirroring the Python writer: two
+    // sidecars answering the same key doubles the invalidation
+    // surface for no benefit
+    std::string v1(out_path);
+    size_t pos = v1.rfind(".v2.bin");
+    if (pos != std::string::npos) {
+      v1.replace(pos, 7, ".v1.bin");
+      remove(v1.c_str());
+    }
+  }
   return true;
 }
 
 extern "C" {
 
-int64_t jt_ha_abi_version() { return 4; }
+int64_t jt_ha_abi_version() { return 5; }
 
 uint64_t jt_xxh64_buf(const uint8_t* p, int64_t n, uint64_t seed) {
   return xxh64(p, (size_t)n, seed);
 }
 
-// Write the encoded.v1 sidecar for `hp` straight from the handle's
+// Write the encoded sidecar for `hp` straight from the handle's
 // buffers (no Python round-trip); 1 on success, 0 on any failure.
+// ABI v5: `version` selects the layout — 1 = lean arrays, 2 =
+// dispatch-shaped (append only; wr silently writes v1, matching the
+// Python side's sidecar_version()).
 int64_t jt_ha_write_sidecar(void* hp, const char* hist_path,
-                            const char* out_path) {
-  return write_sidecar((Handle*)hp, hist_path, out_path) ? 1 : 0;
+                            const char* out_path, int64_t version) {
+  return write_sidecar((Handle*)hp, hist_path, out_path,
+                       version == 2 ? 2 : 1) ? 1 : 0;
 }
 
 void* jt_ha_encode_file(const char* path) {

@@ -29,3 +29,9 @@ def tracer_branch(x, n, flag):
 
 def unsanctioned_wait(out):
     return out.block_until_ready()                        # EXPECT: JT-JAX-003
+
+
+def pack_hot_batch(views):
+    padded = np.pad(views[0], 4)                          # EXPECT: JT-JAX-005
+    staged = np.ascontiguousarray(padded)                 # EXPECT: JT-JAX-005
+    return np.copy(staged)                                # EXPECT: JT-JAX-005

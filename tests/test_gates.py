@@ -44,6 +44,10 @@ ALL_GATES = [
     "JEPSEN_TPU_ENCODE_CACHE",
     "JEPSEN_TPU_ENCODE_CACHE_WRITE",
     "JEPSEN_TPU_PACK_THREAD",
+    "JEPSEN_TPU_SIDECAR_V2",
+    "JEPSEN_TPU_DONATE_BUFFERS",
+    "JEPSEN_TPU_AOT_CACHE",
+    "JEPSEN_TPU_COMPILE_CACHE_DIR",
     "JEPSEN_TPU_STRICT",
     "JEPSEN_TPU_DISPATCH_TIMEOUT_S",
     "JEPSEN_TPU_FAULT_INJECT",

@@ -177,7 +177,13 @@ class TestDifferential:
 # ---------------------------------------------------------------------------
 
 class TestPipeline:
-    def test_unordered_reorder_correctness(self, tmp_path):
+    def test_unordered_reorder_correctness(self, tmp_path,
+                                           monkeypatch):
+        # v1 sidecars: with v2 on, pooled append encodes send sidecar
+        # REFERENCES (the parent mmaps; zero shm bytes by design —
+        # tests/test_warm_path.py covers that transport), and this
+        # test is about the shm descriptor path
+        monkeypatch.setenv("JEPSEN_TPU_SIDECAR_V2", "0")
         dirs = append_dirs(tmp_path, n=7)
         tr = trace.fresh_run("reorder")
         got = []
