@@ -7,16 +7,34 @@ PY ?= python
 ASAN_RT := $(shell gcc -print-file-name=libasan.so)
 TSAN_RT := $(shell gcc -print-file-name=libtsan.so)
 
-.PHONY: lint lint-json env-table test native native-sanitize bench \
-	bench-report bench-warm obs-smoke
+.PHONY: lint lint-json lint-changed env-table rule-table test native \
+	native-sanitize bench bench-report bench-warm obs-smoke
 
 # Self-hosted static analysis: gate registry, JAX hazards, concurrency
-# discipline, shm lifecycle, tracer discipline (jepsen_tpu/lint/).
+# discipline, shm lifecycle, tracer discipline, plus the cross-boundary
+# analyses — ABI/layout prover, tensor-contract dataflow, lockset
+# analysis (jepsen_tpu/lint/).
 lint:
 	$(PY) -m jepsen_tpu.cli lint
 
 lint-json:
 	$(PY) -m jepsen_tpu.cli lint --format json
+
+# The fast inner loop: only files dirty vs the git merge-base, through
+# the content-hash result cache (bench_artifacts/.lintcache). Full
+# runs stay the tier-1 default.
+lint-changed:
+	$(PY) -m jepsen_tpu.cli lint --changed
+
+# Regenerate the README rule table from the rule registry (lint rule
+# JT-META-001 fails the build when the committed table drifts).
+rule-table:
+	$(PY) -c "from pathlib import Path; from jepsen_tpu import lint; \
+	p = Path('README.md'); t = p.read_text(); \
+	s = t.index(lint.RULES_BEGIN); \
+	e = t.index(lint.RULES_END) + len(lint.RULES_END); \
+	p.write_text(t[:s] + lint.render_rule_block() + t[e:]); \
+	print('README.md rule table regenerated')"
 
 # Regenerate the README env-gate table from the gates registry (lint
 # rule JT-GATE-003 fails the build when the committed table drifts).

@@ -1208,17 +1208,24 @@ def main() -> int:
 
 def _lint_block() -> dict:
     """Static-analysis posture for the BENCH artifact: rule count,
-    baseline size, suppressed/open findings — the trajectory should
-    show rules growing and suppressions shrinking. Runs in the
-    supervisor (stdlib-only, never imports JAX)."""
+    baseline size, suppressed/open findings, per-family open counts,
+    and the analyzer's wall time — the trajectory should show rules
+    growing, suppressions shrinking, findings_open pinned at zero
+    (bench-report gates ANY growth), and wall time staying sane as the
+    engine grows. Runs in the supervisor (stdlib-only, never imports
+    JAX)."""
     try:
         from jepsen_tpu import lint
         root = lint.default_root()
+        t0 = time.perf_counter()
         findings = lint.lint_project(root)
+        wall = time.perf_counter() - t0
         entries = lint.load_baseline(root / "lint_baseline.json")
         res = lint.apply_baseline(findings, entries)
         return {"rules": len(lint.rule_ids()),
                 "findings_open": len(res.kept),
+                "findings_by_family": lint.findings_by_family(res.kept),
+                "wall_secs": round(wall, 3),
                 "baseline_entries": len(entries),
                 "baseline_suppressed": len(res.suppressed),
                 "baseline_stale": len(res.stale)}

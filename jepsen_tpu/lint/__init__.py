@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import ast
 import json
+import os
 import re
 import sys
 from dataclasses import dataclass, field
@@ -47,9 +48,28 @@ from typing import Iterable, Iterator
 
 __all__ = [
     "Finding", "ModuleCtx", "ModuleRule", "ProjectRule", "ProjectCtx",
-    "all_rules", "rule_ids", "lint_paths", "lint_project", "apply_baseline",
-    "load_baseline", "main",
+    "all_rules", "rule_ids", "rule_table", "render_rule_block",
+    "lint_paths", "lint_project", "apply_baseline", "load_baseline",
+    "LintCache", "changed_files", "family_of", "main",
 ]
+
+
+def family_of(rule_id: str) -> str:
+    """'JT-GATE' for 'JT-GATE-001' — the per-family bench rollup key.
+    Ids without a numeric suffix (the JT-PARSE sentinel) are their own
+    family."""
+    head, _, tail = rule_id.rpartition("-")
+    return head if head and tail.isdigit() else rule_id
+
+
+def findings_by_family(findings: list["Finding"]) -> dict[str, int]:
+    """Open findings rolled up per family, every registered family
+    present (zero-seeded) — the ONE rollup `lint --format json` and
+    bench.py's lint block both emit, so the two can't drift."""
+    fams = {family_of(i): 0 for i in rule_ids()}
+    for f in findings:
+        fams[family_of(f.rule)] = fams.get(family_of(f.rule), 0) + 1
+    return dict(sorted(fams.items()))
 
 
 @dataclass(frozen=True)
@@ -186,12 +206,14 @@ def walk_functions(tree: ast.AST) -> Iterator[ast.FunctionDef]:
 
 def all_rules() -> tuple[list[ModuleRule], list[ProjectRule]]:
     """Every registered rule instance (module rules, project rules)."""
-    from . import (rules_concurrency, rules_gates, rules_jax, rules_shm,
-                   rules_trace)
+    from . import (rules_abi, rules_concurrency, rules_gates,
+                   rules_jax, rules_lock, rules_meta, rules_shm,
+                   rules_tensor, rules_trace)
     mod: list[ModuleRule] = []
     proj: list[ProjectRule] = []
     for m in (rules_gates, rules_jax, rules_concurrency, rules_shm,
-              rules_trace):
+              rules_trace, rules_abi, rules_tensor, rules_lock,
+              rules_meta):
         for r in m.RULES:
             (proj if isinstance(r, ProjectRule) else mod).append(r)
     return mod, proj
@@ -207,6 +229,24 @@ def rule_table() -> list[dict]:
     mod, proj = all_rules()
     return [{"id": r.id, "doc": r.doc, "hint": r.hint}
             for r in sorted(mod + proj, key=lambda r: r.id)]
+
+
+#: README markers for the generated rule table (the env-gate table's
+#: pattern: edit the rules, run `make rule-table`, JT-META-001 fails
+#: the build on drift).
+RULES_BEGIN = "<!-- lint-rules:begin (generated by jepsen_tpu.lint) -->"
+RULES_END = "<!-- lint-rules:end -->"
+
+
+def render_rule_table() -> str:
+    rows = ["| rule | checks |", "|---|---|"]
+    for r in rule_table():
+        rows.append(f"| {r['id']} | {' '.join(r['doc'].split())} |")
+    return "\n".join(rows)
+
+
+def render_rule_block() -> str:
+    return f"{RULES_BEGIN}\n{render_rule_table()}\n{RULES_END}"
 
 
 # ---------------------------------------------------------------------------
@@ -245,33 +285,46 @@ class LintParseError(Exception):
 
 
 def lint_paths(paths: Iterable[Path], root: Path,
-               rules: list[ModuleRule] | None = None) -> list[Finding]:
+               rules: list[ModuleRule] | None = None,
+               cache: "LintCache | None" = None) -> list[Finding]:
     """Run the module rules over explicit files (fixture tests use
-    this); inline suppressions apply, the baseline does not."""
+    this); inline suppressions apply, the baseline does not. With a
+    `cache`, per-file results are keyed by content hash + engine
+    fingerprint — a clean re-run of an unchanged file costs one hash."""
     if rules is None:
         rules, _ = all_rules()
     out: list[Finding] = []
     for p in paths:
+        p = Path(p)
+        if cache is not None:
+            cached = cache.get(p)
+            if cached is not None:
+                out.extend(cached)
+                continue
         try:
-            ctx = _load_ctx(Path(p), root)
+            ctx = _load_ctx(p, root)
         except LintParseError as e:
             out.append(Finding("JT-PARSE", str(e.path), 1,
                                f"unparseable: {e.err}",
                                "fix the syntax error"))
             continue
-        for r in rules:
-            for f in r.check(ctx):
-                if not ctx.suppressed(f):
-                    out.append(f)
+        found = [f for r in rules for f in r.check(ctx)
+                 if not ctx.suppressed(f)]
+        if cache is not None:
+            cache.put(p, found)
+        out.extend(found)
     out.sort(key=lambda f: (f.path, f.line, f.rule))
     return out
 
 
-def lint_project(root: Path,
-                 package_dir: Path | None = None) -> list[Finding]:
+def lint_project(root: Path, package_dir: Path | None = None,
+                 cache: "LintCache | None" = None) -> list[Finding]:
     """The full pass: module rules over every file of the package,
-    then the project rules (README drift, gate test coverage).
-    Baseline NOT yet applied — see `apply_baseline`."""
+    then the project rules (README drift, gate test coverage, the ABI
+    prover). Baseline NOT yet applied — see `apply_baseline`. With a
+    `cache`, unchanged files are served from the content-hash store
+    (sound: module-rule findings are a pure function of file bytes +
+    the engine fingerprint); project rules always run fresh."""
     root = Path(root)
     if package_dir is None:
         package_dir = root / "jepsen_tpu"
@@ -286,16 +339,165 @@ def lint_project(root: Path,
                                     f"unparseable: {e.err}",
                                     "fix the syntax error"))
             continue
+        # the ctx is built even on a cache hit (parsing is the cheap
+        # part): ProjectCtx.modules must stay COMPLETE — a project
+        # rule iterating it on a warm cache would otherwise silently
+        # see only the dirty files
         modules.append(ctx)
-        for r in mod_rules:
-            for f in r.check(ctx):
-                if not ctx.suppressed(f):
-                    findings.append(f)
+        cached = cache.get(p) if cache is not None else None
+        if cached is not None:
+            findings.extend(cached)
+            continue
+        found = [f for r in mod_rules for f in r.check(ctx)
+                 if not ctx.suppressed(f)]
+        if cache is not None:
+            cache.put(p, found)
+        findings.extend(found)
     pctx = ProjectCtx(root, modules)
     for r in proj_rules:
         findings.extend(r.check_project(pctx))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
+
+
+# ---------------------------------------------------------------------------
+# Incremental mode: --changed + the content-hash result cache.
+# ---------------------------------------------------------------------------
+
+#: Out-of-package inputs module rules consult at check time: the gate
+#: registry (JT-GATE-002), the declared metric names (JT-TRACE-002),
+#: the typed event kinds (JT-TRACE-003). Editing any of these must
+#: invalidate the cache exactly like editing a rule would.
+_RULE_INPUT_SOURCES = ("gates.py", "trace.py", "obs/events.py")
+
+
+def _engine_fingerprint() -> str:
+    """Hash of everything that determines a file's findings besides
+    the file itself: the lint engine's own sources plus the registry
+    modules the rules consult (`_RULE_INPUT_SOURCES`). The cache can
+    never serve findings from an older rule set or registry."""
+    import hashlib
+    h = hashlib.sha256()
+    lint_dir = Path(__file__).resolve().parent
+    for p in sorted(lint_dir.glob("*.py")):
+        h.update(p.name.encode())
+        h.update(p.read_bytes())
+    for rel in _RULE_INPUT_SOURCES:
+        p = lint_dir.parent / rel
+        h.update(rel.encode())
+        try:
+            h.update(p.read_bytes())
+        except OSError:
+            h.update(b"<absent>")
+    return h.hexdigest()[:16]
+
+
+class LintCache:
+    """Per-file module-rule results under
+    `bench_artifacts/.lintcache/`, keyed by sha256(engine fingerprint
+    + root-relative path + file bytes). The path is part of the key
+    because findings are NOT a pure function of content: path-scoped
+    rules (hot-path files, kernel modules, the gates-file exemption)
+    fire differently for byte-identical files at different locations,
+    and the findings themselves embed the path. Best-effort on every
+    other axis: an unreadable or corrupt entry is a miss, a failed
+    write is ignored — the cache can only make a run faster, never
+    wrong."""
+
+    def __init__(self, root: Path):
+        self.root = Path(root)
+        self.dir = self.root / "bench_artifacts" / ".lintcache"
+        self._fp = _engine_fingerprint()
+        # get() then put() on a miss must not hash the file twice:
+        # the key is memoized per path for this run's lifetime
+        self._keys: dict[str, str | None] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def _key(self, path: Path) -> str | None:
+        import hashlib
+        memo = str(path)
+        if memo in self._keys:
+            return self._keys[memo]
+        try:
+            rel = path.resolve().relative_to(
+                self.root.resolve()).as_posix() \
+                if path.resolve().is_relative_to(self.root.resolve()) \
+                else path.as_posix()
+            h = hashlib.sha256(self._fp.encode())
+            h.update(rel.encode())
+            h.update(b"\0")
+            h.update(path.read_bytes())
+            key = h.hexdigest()
+        except OSError:
+            key = None
+        self._keys[memo] = key
+        return key
+
+    def get(self, path: Path) -> list[Finding] | None:
+        key = self._key(path)
+        if key is None:
+            return None
+        try:
+            data = json.loads((self.dir / f"{key}.json").read_text())
+            out = [Finding(**f) for f in data]
+        except (OSError, TypeError, ValueError):
+            self.misses += 1
+            return None
+        self.hits += 1
+        return out
+
+    def put(self, path: Path, findings: list[Finding]) -> None:
+        key = self._key(path)
+        if key is None:
+            return
+        try:
+            self.dir.mkdir(parents=True, exist_ok=True)
+            tmp = self.dir / f".{key}.{os.getpid()}.tmp"
+            tmp.write_text(json.dumps([f.as_dict() for f in findings]))
+            os.replace(tmp, self.dir / f"{key}.json")
+        except OSError:
+            pass
+
+
+def changed_files(root: Path) -> list[Path] | None:
+    """Package .py files dirty vs the merge-base with the upstream
+    branch (falling back to origin/main, main, then plain HEAD for a
+    detached checkout), plus untracked files. None when git itself is
+    unavailable — callers degrade to the full run."""
+    import subprocess
+
+    def git(*args: str):
+        try:
+            return subprocess.run(
+                ["git", "-C", str(root), *args],
+                capture_output=True, text=True, timeout=30)
+        except (OSError, subprocess.SubprocessError):
+            return None
+
+    base = "HEAD"
+    for ref in ("@{upstream}", "origin/main", "main"):
+        r = git("merge-base", "HEAD", ref)
+        if r is None:
+            return None
+        if r.returncode == 0:
+            base = r.stdout.strip()
+            break
+    r = git("diff", "--name-only", base)
+    if r is None or r.returncode != 0:
+        return None
+    names = set(r.stdout.split())
+    r = git("ls-files", "--others", "--exclude-standard")
+    if r is not None and r.returncode == 0:
+        names.update(r.stdout.split())
+    out = []
+    for n in sorted(names):
+        p = Path(root) / n
+        if n.endswith(".py") and n.startswith("jepsen_tpu/") \
+                and p.is_file() \
+                and not _SKIP_PARTS.intersection(p.parts):
+            out.append(p)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -366,13 +568,16 @@ def default_root() -> Path:
 
 def run(paths: list[str] | None = None, *, root: Path | None = None,
         baseline: str | None = None, fmt: str = "text",
-        out=None) -> int:
+        changed: bool = False, out=None) -> int:
     """The lint run behind the CLI. Returns the exit code (0 clean,
     1 findings). `paths`: explicit files/dirs to lint with the module
     rules only; default is the full project pass (module + project
-    rules + baseline)."""
+    rules + baseline). `changed` analyzes only files dirty vs the git
+    merge-base through the content-hash result cache — the fast inner
+    loop; the full run stays the tier-1 default."""
     out = out if out is not None else sys.stdout
     root = Path(root) if root is not None else default_root()
+    cache_line = ""
     if paths:
         files: list[Path] = []
         for p in paths:
@@ -382,7 +587,24 @@ def run(paths: list[str] | None = None, *, root: Path | None = None,
         res = BaselineResult(kept=findings)
         entries: list[dict] = []
     else:
-        findings = lint_project(root)
+        if changed:
+            dirty = changed_files(root)
+            if dirty is None:
+                print("lint: --changed needs git; running the full "
+                      "pass", file=sys.stderr)
+                findings = lint_project(root)
+            else:
+                cache = LintCache(root)
+                findings = lint_paths(dirty, root, cache=cache)
+                mod_rules, proj_rules = all_rules()
+                pctx = ProjectCtx(root, [])
+                for r in proj_rules:
+                    findings.extend(r.check_project(pctx))
+                findings.sort(key=lambda f: (f.path, f.line, f.rule))
+                cache_line = (f"lint: --changed: {len(dirty)} dirty "
+                              f"file(s), cache {cache.hits} hit(s)")
+        else:
+            findings = lint_project(root, cache=LintCache(root))
         bpath = Path(baseline) if baseline \
             else root / "lint_baseline.json"
         try:
@@ -391,10 +613,15 @@ def run(paths: list[str] | None = None, *, root: Path | None = None,
             print(f"lint: bad baseline: {e}", file=sys.stderr)
             return 254
         res = apply_baseline(findings, entries)
+        if changed:
+            # a partial view cannot judge staleness: an entry whose
+            # file simply wasn't dirty would be reported dead
+            res.stale = []
 
     if fmt == "json":
         print(json.dumps({
             "findings": [f.as_dict() for f in res.kept],
+            "findings_by_family": findings_by_family(res.kept),
             "suppressed": len(res.suppressed),
             "baseline_entries": len(entries),
             "baseline_stale": res.stale,
@@ -406,6 +633,8 @@ def run(paths: list[str] | None = None, *, root: Path | None = None,
         for e in res.stale:
             print(f"lint: stale baseline entry (matched nothing): "
                   f"{e['rule']} {e['path']} — remove it", file=out)
+        if cache_line:
+            print(cache_line, file=out)
         n = len(res.kept)
         print(f"lint: {n} finding{'s' if n != 1 else ''} "
               f"({len(res.suppressed)} baseline-suppressed, "
@@ -431,6 +660,11 @@ def add_args(p) -> None:
                         "the repo root)")
     p.add_argument("--root", default=None,
                    help="repo root (default: auto-detected)")
+    p.add_argument("--changed", action="store_true",
+                   help="analyze only files dirty vs the git "
+                        "merge-base, through the content-hash result "
+                        "cache (bench_artifacts/.lintcache); project "
+                        "rules still run in full")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule table and exit")
 
@@ -438,11 +672,15 @@ def add_args(p) -> None:
 def run_from_args(args) -> int:
     """Dispatch a namespace produced by an `add_args` parser."""
     if args.list_rules:
-        for r in rule_table():
-            print(f"{r['id']}: {r['doc']}")
+        if args.lint_format == "json":
+            print(json.dumps(rule_table(), indent=2))
+        else:
+            for r in rule_table():
+                print(f"{r['id']}: {r['doc']}")
         return 0
     return run(args.paths or None, root=args.root,
-               baseline=args.baseline, fmt=args.lint_format)
+               baseline=args.baseline, fmt=args.lint_format,
+               changed=getattr(args, "changed", False))
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -1,0 +1,175 @@
+"""The declared-contracts registry the cross-boundary analyses check
+against.
+
+`gates.py` proved the pattern: an invariant written down ONCE, in a
+typed table, is an invariant the linter can enforce everywhere it is
+consumed. This module does the same for the encode→pack→dispatch
+tensor contracts (JT-TENSOR), the lock/shared-state discipline of the
+sweep's thread graph (JT-LOCK), and the hot-path scoping both share.
+The ABI/layout contracts (JT-ABI) are NOT declared here — their source
+of truth is `native/hist_encode.cc` itself, parsed by `cparse.py` and
+cross-checked against `native_lib.py`/`store.py`; duplicating them in
+a third place would just add one more thing to drift.
+
+Every table is consumed by a rule in `rules_tensor.py` /
+`rules_lock.py`; tests/test_lint.py pins the registry's shape so an
+entry can't silently vanish.
+"""
+
+from __future__ import annotations
+
+# ---------------------------------------------------------------------------
+# JT-TENSOR — dtype/shape/fill contracts of the encode→pack→dispatch path
+# ---------------------------------------------------------------------------
+
+#: Canonical dtype per encoded-tensor field (numpy dtype names). The
+#: lean int64 index tensors and the int32 device (`d_*`) tensors are
+#: both declared — the narrowing between them is explicit below, so an
+#: UNdeclared cast anywhere on the path is a finding.
+TENSOR_DTYPES: dict[str, str] = {
+    "appends": "int32",
+    "reads": "int32",
+    "edges": "int32",
+    "status": "int32",
+    "process": "int32",
+    "invoke_index": "int64",
+    "complete_index": "int64",
+    "d_invoke": "int32",
+    "d_complete": "int32",
+    "n_txns": "int32",
+    "kid_to_pre": "int32",
+}
+
+#: Common local-variable spellings of the declared fields (the packers
+#: shorten `*_index` to `*_idx`); dataflow tags resolve through this.
+FIELD_ALIASES: dict[str, str] = {
+    "invoke_idx": "invoke_index",
+    "complete_idx": "complete_index",
+}
+
+#: Sanctioned narrowings: (source field, destination dtype). The v2
+#: sidecar's device tensors are the int32 narrowing of the lean int64
+#: index tensors — declared here because both writers (store.py's
+#: `_padded_arrays`, hist_encode.cc's `write_sidecar`) perform it; any
+#: OTHER cast of a contracted tensor is drift.
+DECLARED_NARROWINGS: frozenset[tuple[str, str]] = frozenset({
+    ("invoke_index", "int32"),
+    ("complete_index", "int32"),
+})
+
+#: pack_batch's fill convention: dead triple/process rows are -1 (no
+#: txn, no key), dead index rows 0. A `np.full` building a contracted
+#: tensor with any other fill silently corrupts the kernel's masking.
+FILL_VALUES: dict[str, int] = {
+    "appends": -1,
+    "reads": -1,
+    "edges": -1,
+    "process": -1,
+    "invoke_index": 0,
+    "complete_index": 0,
+    "d_invoke": 0,
+    "d_complete": 0,
+    "n_txns": 0,
+}
+
+#: Fields whose minor axis is a triple — a reshape of one of these to
+#: a literal shape must end in 3.
+TRIPLE_FIELDS: frozenset[str] = frozenset({"appends", "reads", "edges"})
+
+#: The bucket geometry: txn axis pads to the MXU tile, every minor
+#: axis to 8 — kernels.BatchShape.plan, store.dispatch_pad_plan and
+#: hist_encode.cc's pad_up all agree on these two numbers (JT-ABI-004
+#: proves the native side; JT-TENSOR-003 flags any literal pad
+#: multiple outside this set on the Python side).
+PAD_TXNS = 128
+PAD_MINOR = 8
+PAD_MULTIPLES: frozenset[int] = frozenset({PAD_TXNS, PAD_MINOR})
+
+#: Donated-arg positions of a single-device bucket dispatch: the six
+#: packed input tensors, nothing else. `donate_argnums` anywhere in
+#: the analyzed files must spell exactly this.
+DONATE_ARGNUMS: tuple[int, ...] = (0, 1, 2, 3, 4, 5)
+
+#: Files whose whole body is the pack/h2d hot path for the host-
+#: materialization rule (JT-TENSOR-002, ex-JT-JAX-005).
+HOT_PATH_FILES = ("jepsen_tpu/parallel/", "jepsen_tpu/shm.py")
+
+#: Function-name shapes treated as hot-path regardless of file — the
+#: packers and h2d stages (also what makes the rule fixture-testable).
+HOT_FN_PREFIXES = ("pack_", "_h2d", "_prep_bucket", "shard_batch")
+
+#: Files the tensor dataflow pass analyzes module-wide (beyond the
+#: hot-path scoping above): everywhere contracted tensors are built,
+#: persisted, or packed.
+TENSOR_FILES = (
+    "jepsen_tpu/checker/elle/kernels.py",
+    "jepsen_tpu/checker/knossos/kernels.py",
+    "jepsen_tpu/parallel/",
+    "jepsen_tpu/shm.py",
+    "jepsen_tpu/store.py",
+)
+
+
+def is_tensor_file(rel: str) -> bool:
+    return any(t in rel for t in TENSOR_FILES)
+
+
+def is_hot_path_file(rel: str) -> bool:
+    return any(h in rel for h in HOT_PATH_FILES)
+
+
+def field_of(name: str) -> str | None:
+    """The declared field a local name refers to, or None."""
+    name = FIELD_ALIASES.get(name, name)
+    return name if name in TENSOR_DTYPES else None
+
+
+# ---------------------------------------------------------------------------
+# JT-LOCK — shared state, its guarding locks, and blocking calls
+# ---------------------------------------------------------------------------
+
+#: Shared mutable state and the lock that must be held to WRITE it:
+#: (class name, attribute, lock). The lock is either a `self.<attr>`
+#: spelled as the attr name, or a module-global lock name. Reads are
+#: out of scope (the registry entries are all either monotonic
+#: counters or snapshot-read-by-design); `__init__` is exempt
+#: (construction is single-threaded by definition). These are exactly
+#: the structures the PR-6/7 review passes found raced by hand: the
+#: donated-slot ledger, the health snapshot's seq, the tracer's
+#: metric cells.
+SHARED_STATE: tuple[tuple[str, str, str], ...] = (
+    ("DeviceSlotLedger", "_inflight", "_lock"),
+    ("HealthSampler", "_seq", "_wlock"),
+    ("Counter", "value", "_MLOCK"),
+    ("Histogram", "count", "_MLOCK"),
+    ("Histogram", "total", "_MLOCK"),
+    ("Histogram", "min", "_MLOCK"),
+    ("Histogram", "max", "_MLOCK"),
+    ("_Injector", "_fired", "_lock"),
+)
+
+#: Calls that park the calling thread for unbounded/long time — doing
+#: one while holding a lock starves every other waiter (the "gauge
+#: published outside the lock" / "write_snapshot serialized" class,
+#: inverted). Consumed by rules_lock._is_blocking in three forms:
+#: exact dotted names, dotted-name prefixes, and attribute-call tails.
+#: `.join()` is deliberately NOT here: the spelling is shared with
+#: `str.join` (every f-string-averse formatter in the tree), and a
+#: receiver-type analysis precise enough to split them doesn't fit a
+#: lexical pass — thread joins under a lock surface via JT-LOCK-001's
+#: call-graph edges instead when the joined worker takes locks.
+BLOCKING_EXACT: frozenset[str] = frozenset({"time.sleep", "sleep"})
+BLOCKING_PREFIXES: tuple[str, ...] = ("subprocess.",)
+BLOCKING_METHOD_TAILS: frozenset[str] = frozenset({
+    "block_until_ready",   # unbounded device wait
+    "result",              # Future.result
+})
+
+#: Constructors whose instances are thread-safe by design: a Thread
+#: target may share these with its spawner freely (JT-LOCK-004's
+#: confinement rule skips them).
+THREADSAFE_CTORS: frozenset[str] = frozenset({
+    "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+    "Semaphore", "BoundedSemaphore", "Event", "Lock", "RLock",
+    "Condition", "Barrier", "deque",
+})

@@ -1,0 +1,487 @@
+"""JT-ABI — the ABI/layout prover across the C++/Python boundary.
+
+The native ABI churned v3→v4→v5 across three PRs, and every bump
+touched four places that nothing machine-checked against each other:
+the `extern "C"` exports in `native/*.cc`, the ctypes prototypes in
+`native_lib.py`, the version constant both sides pin, and the
+`encoded.v1/v2.bin` layout mirrored between `hist_encode.cc`'s
+`write_sidecar` and `store.py`. A half-landed bump — a new export
+with no prototype, an argtype that silently truncates, a pad constant
+changed on one side — either crashes at dlopen (the good case) or
+corrupts tensors at a distance (the case this family exists for).
+
+Four project rules, all driven by `cparse.parse_native` on the C side
+and plain `ast` extraction on the Python side:
+
+  JT-ABI-001  export/prototype coverage drift (symbol sets differ)
+  JT-ABI-002  ABI version constant drift (C return vs Python check)
+  JT-ABI-003  prototype drift (arity / incompatible ctypes per arg)
+  JT-ABI-004  sidecar layout drift (pad geometry, hash span, xxh64
+              primes, magic strings, field write order)
+
+Everything is path-relative to the ProjectCtx root, so the
+seeded-mutation harness (tests/test_contract_prover.py) can point the
+rules at a fixture tree whose .cc / native_lib.py / store.py copies
+carry exactly one induced drift each.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterator
+
+from . import Finding, ProjectCtx, ProjectRule, const_str, dotted
+from . import cparse, dataflow
+
+_NATIVE_SOURCES = ("native/hist_encode.cc", "native/wgl.cc",
+                   "native/graph_algo.cc")
+_NATIVE_LIB = "jepsen_tpu/native_lib.py"
+_STORE = "jepsen_tpu/store.py"
+_ENCODE = "jepsen_tpu/checker/elle/encode.py"
+
+#: Normalized C type → ctypes renders that faithfully bind it.
+CTYPES_COMPAT: dict[str, frozenset[str]] = {
+    "void": frozenset({"None"}),
+    "int32_t": frozenset({"c_int32"}),
+    "int64_t": frozenset({"c_int64"}),
+    "uint32_t": frozenset({"c_uint32"}),
+    "uint64_t": frozenset({"c_uint64"}),
+    "double": frozenset({"c_double"}),
+    "float": frozenset({"c_float"}),
+    "char*": frozenset({"c_char_p"}),
+    "void*": frozenset({"c_void_p"}),
+    "uint8_t*": frozenset({"c_char_p", "POINTER(c_uint8)"}),
+    "int32_t*": frozenset({"POINTER(c_int32)"}),
+    "int64_t*": frozenset({"POINTER(c_int64)"}),
+    "uint64_t*": frozenset({"POINTER(c_uint64)"}),
+}
+
+
+@dataclass
+class Proto:
+    """One ctypes prototype bound in native_lib.py."""
+
+    name: str
+    restype: str | None
+    argtypes: tuple[str, ...] | None
+    line: int
+
+
+# ---------------------------------------------------------------------------
+# Python-side extraction
+# ---------------------------------------------------------------------------
+
+def _render_ctype(node: ast.AST, aliases: dict[str, str]) -> str | None:
+    """'c_int64' / 'POINTER(c_int32)' / 'None' for a ctypes type
+    expression; None when unrenderable (dynamic)."""
+    if isinstance(node, ast.Constant) and node.value is None:
+        return "None"
+    if isinstance(node, ast.Name):
+        return aliases.get(node.id, node.id)
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Call):
+        d = dotted(node.func)
+        if d and d.split(".")[-1] == "POINTER" and node.args:
+            inner = _render_ctype(node.args[0], aliases)
+            return f"POINTER({inner})" if inner else None
+    return None
+
+
+def extract_ctypes(tree: ast.Module) -> tuple[dict[str, Proto],
+                                              dict[str, tuple[int, int]]]:
+    """(prototypes, version checks) from native_lib.py's AST.
+
+    Prototypes come from `L.jt_x.restype/argtypes = ...` assignments,
+    including the `for name in ("jt_a", "jt_b"): fn = getattr(L, name)`
+    batch form. Version checks are `if L.jt_x_abi_version() != N`
+    comparisons, mapped name → (N, line)."""
+    protos: dict[str, Proto] = {}
+    checks: dict[str, tuple[int, int]] = {}
+
+    def proto(name: str, line: int) -> Proto:
+        p = protos.get(name)
+        if p is None:
+            p = protos[name] = Proto(name, None, None, line)
+        return p
+
+    for fn in ast.walk(tree):
+        if not isinstance(fn, ast.FunctionDef):
+            continue
+        aliases: dict[str, str] = {}
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name):
+                r = _render_ctype(n.value, aliases)
+                if r is not None and ("POINTER" in r
+                                      or r.startswith("c_")):
+                    aliases[n.targets[0].id] = r
+
+        def record(name: str, attr: str, value: ast.AST,
+                   line: int) -> None:
+            p = proto(name, line)
+            if attr == "restype":
+                p.restype = _render_ctype(value, aliases)
+            elif attr == "argtypes":
+                if isinstance(value, (ast.List, ast.Tuple)):
+                    rendered = tuple(
+                        _render_ctype(e, aliases) or "?"
+                        for e in value.elts)
+                    p.argtypes = rendered
+
+        for n in ast.walk(fn):
+            # L.jt_x.restype = ... / L.jt_x.argtypes = [...]
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Attribute):
+                t = n.targets[0]
+                if t.attr in ("restype", "argtypes") \
+                        and isinstance(t.value, ast.Attribute) \
+                        and t.value.attr.startswith("jt_"):
+                    record(t.value.attr, t.attr, n.value, n.lineno)
+            # for name in ("jt_a", ...): fn = getattr(L, name); fn.restype = ...
+            elif isinstance(n, ast.For) \
+                    and isinstance(n.iter, (ast.Tuple, ast.List)):
+                names = [const_str(e) for e in n.iter.elts]
+                if not names or not all(
+                        s and s.startswith("jt_") for s in names):
+                    continue
+                bound: set[str] = set()
+                for b in ast.walk(n):
+                    if isinstance(b, ast.Assign) \
+                            and isinstance(b.value, ast.Call) \
+                            and dotted(b.value.func) == "getattr" \
+                            and isinstance(b.targets[0], ast.Name):
+                        bound.add(b.targets[0].id)
+                for b in ast.walk(n):
+                    if isinstance(b, ast.Assign) \
+                            and isinstance(b.targets[0], ast.Attribute):
+                        t = b.targets[0]
+                        if t.attr in ("restype", "argtypes") \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id in bound:
+                            for s in names:
+                                record(s, t.attr, b.value, b.lineno)
+            # if L.jt_x_abi_version() != N: ...
+            elif isinstance(n, ast.Compare) and len(n.ops) == 1 \
+                    and isinstance(n.ops[0], (ast.NotEq, ast.Eq)) \
+                    and isinstance(n.left, ast.Call):
+                d = dotted(n.left.func)
+                tail = d.split(".")[-1] if d else ""
+                c = n.comparators[0]
+                if tail.startswith("jt_") \
+                        and tail.endswith("abi_version") \
+                        and isinstance(c, ast.Constant) \
+                        and isinstance(c.value, int):
+                    checks[tail] = (c.value, n.lineno)
+    return protos, checks
+
+
+# ---------------------------------------------------------------------------
+# store.py / encode.py layout extraction
+# ---------------------------------------------------------------------------
+
+@dataclass
+class StoreLayout:
+    consts: dict[str, tuple[int, int]]          # name -> (value, line)
+    magics: dict[str, tuple[bytes, int]]        # name -> (value, line)
+    field_orders: dict[str, tuple[tuple[str, ...], int]]
+
+
+def _int_of(node: ast.AST, consts: dict[str, int]) -> int | None:
+    v = dataflow.int_value(node, consts)
+    if v is not None:
+        return v
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Pow):
+        b = dataflow.int_value(node.left, consts)
+        e = dataflow.int_value(node.right, consts)
+        if b is not None and e is not None and 0 <= e < 128:
+            return b ** e
+    if isinstance(node, ast.Call) and len(node.args) == 1:
+        # np.int64(2**30)-style wrap
+        return _int_of(node.args[0], consts)
+    return None
+
+
+def extract_store_layout(tree: ast.Module) -> StoreLayout:
+    lay = StoreLayout({}, {}, {})
+    known: dict[str, int] = {}
+    for n in tree.body:
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                and isinstance(n.targets[0], ast.Name):
+            name = n.targets[0].id
+            if isinstance(n.value, ast.Constant) \
+                    and isinstance(n.value.value, bytes):
+                lay.magics[name] = (n.value.value, n.lineno)
+                continue
+            v = _int_of(n.value, known)
+            if v is not None:
+                known[name] = v
+                lay.consts[name] = (v, n.lineno)
+                continue
+            if isinstance(n.value, ast.Dict):
+                fields: dict[str, tuple[str, ...]] = {}
+                for k, val in zip(n.value.keys, n.value.values):
+                    ks = const_str(k) if k is not None else None
+                    if ks and isinstance(val, (ast.Tuple, ast.List)) \
+                            and all(const_str(e) for e in val.elts):
+                        fields[ks] = tuple(const_str(e)
+                                           for e in val.elts)
+                for ks, fs in fields.items():
+                    lay.field_orders[f"ENCODED_FIELDS[{ks!r}]"] = \
+                        (fs, n.lineno)
+    for fn in ast.walk(tree):
+        if isinstance(fn, ast.FunctionDef) \
+                and fn.name == "_padded_arrays":
+            for r in ast.walk(fn):
+                if isinstance(r, ast.Return) \
+                        and isinstance(r.value, ast.List):
+                    names = []
+                    for e in r.value.elts:
+                        if isinstance(e, ast.Tuple) and e.elts \
+                                and const_str(e.elts[0]):
+                            names.append(const_str(e.elts[0]))
+                    if names:
+                        lay.field_orders["_padded_arrays"] = \
+                            (tuple(names), r.lineno)
+    return lay
+
+
+def _is_subsequence(sub: tuple[str, ...],
+                    full: tuple[str, ...]) -> bool:
+    it = iter(full)
+    return all(s in it for s in sub)
+
+
+# ---------------------------------------------------------------------------
+# The shared project-context cache and the four rules
+# ---------------------------------------------------------------------------
+
+def _parse_py(root: Path, rel: str) -> ast.Module | None:
+    """Parse one Python input of the prover, or None when missing or
+    unparseable. The prover must DEGRADE on a broken file, never
+    crash the run: the module pass already reports the syntax error
+    as a JT-PARSE finding, and a half-parsed ABI would only add false
+    drift on top of it."""
+    p = root / rel
+    if not p.is_file():
+        return None
+    try:
+        return ast.parse(p.read_text(encoding="utf-8",
+                                     errors="replace"))
+    except (OSError, SyntaxError, ValueError):
+        return None
+
+
+class _AbiState:
+    def __init__(self, root: Path):
+        self.native: dict[str, cparse.NativeABI] = {}
+        for rel in _NATIVE_SOURCES:
+            p = root / rel
+            if p.is_file():
+                try:
+                    self.native[rel] = cparse.parse_native(
+                        p.read_text(encoding="utf-8",
+                                    errors="replace"), rel)
+                except OSError:
+                    pass
+        self.protos: dict[str, Proto] = {}
+        self.checks: dict[str, tuple[int, int]] = {}
+        lib_tree = _parse_py(root, _NATIVE_LIB)
+        self.lib_present = lib_tree is not None
+        if lib_tree is not None:
+            self.protos, self.checks = extract_ctypes(lib_tree)
+        store_tree = _parse_py(root, _STORE)
+        self.store: StoreLayout | None = \
+            extract_store_layout(store_tree) \
+            if store_tree is not None else None
+        self.never_completed: int | None = None
+        etree = _parse_py(root, _ENCODE)
+        if etree is not None:
+            for n in etree.body:
+                if isinstance(n, ast.Assign) \
+                        and isinstance(n.targets[0], ast.Name) \
+                        and n.targets[0].id == "NEVER_COMPLETED":
+                    self.never_completed = _int_of(n.value, {})
+
+    def exports(self) -> dict[str, tuple[cparse.CSig, str]]:
+        out = {}
+        for rel, abi in self.native.items():
+            for name, sig in abi.exports.items():
+                out[name] = (sig, rel)
+        return out
+
+
+def _state(ctx: ProjectCtx) -> _AbiState:
+    st = getattr(ctx, "_abi_state", None)
+    if st is None:
+        st = _AbiState(Path(ctx.root))
+        ctx._abi_state = st
+    return st
+
+
+class ExportCoverageDrift(ProjectRule):
+    id = "JT-ABI-001"
+    doc = ("an exported `jt_*` symbol with no ctypes prototype in "
+           "native_lib.py, or a prototype for a symbol no .cc "
+           "exports — a half-landed ABI change")
+    hint = ("bind the new export in the matching _bind_* (restype + "
+            "argtypes), or delete the orphaned prototype")
+
+    def check_project(self, ctx: ProjectCtx) -> Iterator[Finding]:
+        st = _state(ctx)
+        if not st.native or not st.lib_present:
+            return
+        exports = st.exports()
+        bound = set(st.protos) | set(st.checks)
+        for name, (sig, rel) in sorted(exports.items()):
+            if name not in bound:
+                yield Finding(self.id, _NATIVE_LIB, 1,
+                              f"export `{name}` ({rel}:{sig.line}) "
+                              "has no ctypes prototype", self.hint)
+        for name, p in sorted(st.protos.items()):
+            if name not in exports:
+                yield Finding(self.id, _NATIVE_LIB, p.line,
+                              f"ctypes prototype for `{name}` but no "
+                              "native export", self.hint)
+
+
+class AbiVersionDrift(ProjectRule):
+    id = "JT-ABI-002"
+    doc = ("the ABI version a `jt_*_abi_version()` export returns "
+           "differs from (or is never checked against) the literal "
+           "native_lib.py compares at bind time")
+    hint = ("bump BOTH sides together: the C return and the "
+            "`!= N` guard in the matching _bind_*")
+
+    def check_project(self, ctx: ProjectCtx) -> Iterator[Finding]:
+        st = _state(ctx)
+        if not st.native or not st.lib_present:
+            return
+        for rel, abi in sorted(st.native.items()):
+            for name, cval in sorted(abi.abi_versions.items()):
+                chk = st.checks.get(name)
+                if chk is None:
+                    yield Finding(
+                        self.id, _NATIVE_LIB, 1,
+                        f"`{name}` ({rel}) returns {cval} but "
+                        "native_lib.py never checks it — a stale .so "
+                        "would bind silently", self.hint)
+                elif chk[0] != cval:
+                    yield Finding(
+                        self.id, _NATIVE_LIB, chk[1],
+                        f"ABI version drift for `{name}`: C++ returns "
+                        f"{cval}, native_lib checks {chk[0]}",
+                        self.hint)
+
+
+class PrototypeDrift(ProjectRule):
+    id = "JT-ABI-003"
+    doc = ("a ctypes prototype whose arity or types no longer match "
+           "the C signature — calls through it corrupt arguments "
+           "instead of failing")
+    hint = ("update restype/argtypes to mirror the C signature "
+            "(see rules_abi.CTYPES_COMPAT for the faithful binding)")
+
+    def check_project(self, ctx: ProjectCtx) -> Iterator[Finding]:
+        st = _state(ctx)
+        if not st.native or not st.lib_present:
+            return
+        exports = st.exports()
+        for name, p in sorted(st.protos.items()):
+            if name not in exports:
+                continue     # JT-ABI-001's finding
+            sig, rel = exports[name]
+            where = f"{rel}:{sig.line}"
+            if p.argtypes is not None:
+                if len(p.argtypes) != len(sig.args):
+                    yield Finding(
+                        self.id, _NATIVE_LIB, p.line,
+                        f"`{name}` takes {len(sig.args)} args in C "
+                        f"({where}) but argtypes declares "
+                        f"{len(p.argtypes)}", self.hint)
+                else:
+                    for i, (c, py) in enumerate(zip(sig.args,
+                                                    p.argtypes)):
+                        ok = CTYPES_COMPAT.get(c)
+                        if ok is not None and py not in ok:
+                            yield Finding(
+                                self.id, _NATIVE_LIB, p.line,
+                                f"`{name}` arg {i} is `{c}` in C "
+                                f"({where}) but bound as `{py}`",
+                                self.hint)
+            if p.restype is not None:
+                ok = CTYPES_COMPAT.get(sig.ret)
+                if ok is not None and p.restype not in ok:
+                    yield Finding(
+                        self.id, _NATIVE_LIB, p.line,
+                        f"`{name}` returns `{sig.ret}` in C ({where}) "
+                        f"but restype is `{p.restype}`", self.hint)
+
+
+#: (C constant in hist_encode.cc, Python constant in store.py)
+_CONST_PAIRS = (
+    ("PAD_TXNS", "_PAD_TXNS"), ("PAD_MINOR", "_PAD_MINOR"),
+    ("HASH_SPAN", "_HASH_SPAN"),
+    ("XP1", "_X1"), ("XP2", "_X2"), ("XP3", "_X3"),
+    ("XP4", "_X4"), ("XP5", "_X5"),
+)
+
+_HIST = "native/hist_encode.cc"
+
+
+class SidecarLayoutDrift(ProjectRule):
+    id = "JT-ABI-004"
+    doc = ("encoded.v1/v2.bin layout drift between hist_encode.cc and "
+           "store.py: pad geometry, hash span, xxh64 primes, magic "
+           "strings, or the field write order")
+    hint = ("the sidecar layout is defined in BOTH writers — change "
+            "them together (store.save_encoded/_padded_arrays and "
+            "hist_encode.cc write_sidecar)")
+
+    def check_project(self, ctx: ProjectCtx) -> Iterator[Finding]:
+        st = _state(ctx)
+        abi = st.native.get(_HIST)
+        if abi is None or st.store is None:
+            return
+        lay = st.store
+        for cname, pyname in _CONST_PAIRS:
+            cv = abi.constants.get(cname)
+            pv = lay.consts.get(pyname)
+            if cv is not None and pv is not None and cv != pv[0]:
+                yield Finding(
+                    self.id, _STORE, pv[1],
+                    f"layout constant drift: {pyname}={pv[0]} but "
+                    f"{_HIST} {cname}={cv}", self.hint)
+        sc = abi.constants.get("SC_NEVER")
+        if sc is not None and st.never_completed is not None \
+                and sc != st.never_completed:
+            yield Finding(
+                self.id, _ENCODE, 1,
+                f"NEVER_COMPLETED={st.never_completed} but {_HIST} "
+                f"SC_NEVER={sc} — effective completion keys diverge "
+                "between the writers", self.hint)
+        if abi.magics:
+            for name in ("ENCODED_MAGIC", "ENCODED_MAGIC_V2"):
+                m = lay.magics.get(name)
+                if m is not None and m[0] not in abi.magics:
+                    yield Finding(
+                        self.id, _STORE, m[1],
+                        f"{name}={m[0]!r} is not a magic the native "
+                        f"writer can produce ({sorted(abi.magics)})",
+                        self.hint)
+        if abi.sidecar_fields:
+            for label, (fields, line) in sorted(
+                    lay.field_orders.items()):
+                if not _is_subsequence(fields, abi.sidecar_fields):
+                    yield Finding(
+                        self.id, _STORE, line,
+                        f"sidecar field order drift: {label} = "
+                        f"{fields} is not written in this order by "
+                        f"{_HIST} write_sidecar "
+                        f"({abi.sidecar_fields})", self.hint)
+
+
+RULES = [ExportCoverageDrift(), AbiVersionDrift(), PrototypeDrift(),
+         SidecarLayoutDrift()]

@@ -168,51 +168,11 @@ class BlockUntilReadyOutsideWatchdog(ModuleRule):
                                        "unsanctioned block_until_ready")
 
 
-#: Files whose whole body is the pack/h2d hot path for JT-JAX-005.
-_HOT_PATH_FILES = ("jepsen_tpu/parallel/", "jepsen_tpu/shm.py")
-
-#: Function-name shapes treated as hot-path regardless of file — the
-#: packers and h2d stages (also what makes the rule fixture-testable).
-_HOT_FN_PREFIXES = ("pack_", "_h2d", "_prep_bucket", "shard_batch")
-
-_COPY_FNS = {"copy", "ascontiguousarray", "pad"}
-
-
-class HostCopyInHotPath(ModuleRule):
-    id = "JT-JAX-005"
-    doc = ("np.copy/np.ascontiguousarray/np.pad on the pack/h2d hot "
-           "path — a host-side array copy between the store and "
-           "device_put, exactly what the dispatch-shaped sidecars "
-           "exist to remove")
-    hint = ("feed device_put the mmap/shm view directly (v2 sidecar "
-            "dispatch views), or justify the copy inline with "
-            "`# jt-lint: ok JT-JAX-005 (reason)`")
-
-    def _hot_functions(self, ctx: ModuleCtx) -> Iterator[ast.AST]:
-        if any(h in ctx.rel for h in _HOT_PATH_FILES):
-            yield ctx.tree
-            return
-        for fn in ast.walk(ctx.tree):
-            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                    and any(fn.name.startswith(p)
-                            for p in _HOT_FN_PREFIXES):
-                yield fn
-
-    def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
-        seen: set[int] = set()
-        for scope in self._hot_functions(ctx):
-            for n in ast.walk(scope):
-                if isinstance(n, ast.Call) \
-                        and isinstance(n.func, ast.Attribute) \
-                        and n.func.attr in _COPY_FNS \
-                        and isinstance(n.func.value, ast.Name) \
-                        and n.func.value.id in _NP_NAMES \
-                        and id(n) not in seen:
-                    seen.add(id(n))
-                    yield self.finding(
-                        ctx, n,
-                        f"np.{n.func.attr}() host copy on the "
-                        "pack/h2d hot path")
+# JT-JAX-005 (host copy on the pack/h2d hot path) was SUBSUMED by
+# JT-TENSOR-002 in rules_tensor.py, which runs the same hot-path
+# scoping through the tensor dataflow pass (and additionally catches
+# np.array of a contracted tensor and .tolist() materializations).
+# The id is retired, not renumbered — see MIGRATING.md.
 
 
 class TracerBranch(ModuleRule):
@@ -241,5 +201,4 @@ class TracerBranch(ModuleRule):
 
 
 RULES = [ItemHostSync(), NumpyOnTraced(),
-         BlockUntilReadyOutsideWatchdog(), HostCopyInHotPath(),
-         TracerBranch()]
+         BlockUntilReadyOutsideWatchdog(), TracerBranch()]

@@ -27,10 +27,14 @@ _lock = threading.Lock()
 
 
 def _compile_so(src: Path, so: Path) -> bool:
-    """g++ -> temp file -> atomic rename, so concurrent builders (e.g.
-    spawn-pool ingest workers all finding the lib missing) can never
-    leave a torn .so for another process to dlopen."""
-    tmp = so.with_name(f".{so.name}.{os.getpid()}.tmp")
+    """g++ -> temp file -> atomic rename, so concurrent builders can
+    never leave a torn .so for another dlopen. The temp name carries
+    pid AND thread id: spawn-pool ingest workers race this across
+    processes, and since the build moved outside `_lock`, two threads
+    of one process can race it too — a pid-only name would have both
+    g++ runs interleaving onto the same file."""
+    tmp = so.with_name(
+        f".{so.name}.{os.getpid()}.{threading.get_ident()}.tmp")
     try:
         so.parent.mkdir(parents=True, exist_ok=True)
         subprocess.run(
@@ -113,41 +117,53 @@ def _cached_lib(src_name: str, so_name: str, bind) -> ctypes.CDLL | None:
             # running fully degraded
             count_fallback(src_name)
         return L
+    from . import gates
+    # the NO_NATIVE kill switch wins over an explicit lib dir — it
+    # must disable EVERY ctypes load, pinned or not
+    libdir = None if gates.get("JEPSEN_TPU_NO_NATIVE") \
+        else gates.get("JEPSEN_TPU_NATIVE_LIB_DIR")
+    # Build + dlopen OUTSIDE the lock: g++ can legitimately run for
+    # minutes, and holding the module-wide lock across it would stall
+    # every other native consumer (the warm-path hasher included) on
+    # an unrelated lib's first build — the JT-LOCK-003 class.
+    # _compile_so is temp+rename atomic precisely so concurrent
+    # builders (threads here, spawn-pool workers elsewhere) can race
+    # harmlessly: at worst the same lib builds twice, never torn.
+    if libdir:
+        # explicit lib dir (e.g. the sanitizer-instrumented builds):
+        # load exactly that lib or degrade to Python — never silently
+        # substitute the production build
+        try:
+            L = ctypes.CDLL(str(Path(libdir) / so_name))
+        except OSError as e:
+            log.debug("native lib load failed (%s from %s): %s",
+                      so_name, libdir, e)
+            L = None
+    else:
+        L = _load_so(_NATIVE_DIR / src_name,
+                     _NATIVE_DIR / "build" / so_name)
+    if L is not None:
+        try:
+            if not bind(L):
+                L = None
+        except AttributeError:
+            L = None
     with _lock:
-        if src_name in _cached:
-            return _cached[src_name]
-        from . import gates
-        # the NO_NATIVE kill switch wins over an explicit lib dir —
-        # it must disable EVERY ctypes load, pinned or not
-        libdir = None if gates.get("JEPSEN_TPU_NO_NATIVE") \
-            else gates.get("JEPSEN_TPU_NATIVE_LIB_DIR")
-        if libdir:
-            # explicit lib dir (e.g. the sanitizer-instrumented
-            # builds): load exactly that lib or degrade to Python —
-            # never silently substitute the production build
-            try:
-                L = ctypes.CDLL(str(Path(libdir) / so_name))
-            except OSError as e:
-                log.debug("native lib load failed (%s from %s): %s",
-                          so_name, libdir, e)
-                L = None
+        won = src_name not in _cached
+        if won:
+            _cached[src_name] = L
         else:
-            L = _load_so(_NATIVE_DIR / src_name,
-                         _NATIVE_DIR / "build" / so_name)
-        if L is not None:
-            try:
-                if not bind(L):
-                    L = None
-            except AttributeError:
-                L = None
-        if L is None:
+            L = _cached[src_name]   # first finisher won the publish
+    if L is None:
+        if won:
             note_fallback(
                 src_name,
                 "JEPSEN_TPU_NO_NATIVE set"
                 if gates.get("JEPSEN_TPU_NO_NATIVE")
                 else "build/load/ABI-bind failed")
-        _cached[src_name] = L
-        return L
+        else:
+            count_fallback(src_name)
+    return L
 
 
 def _bind_graph(L: ctypes.CDLL) -> bool:

@@ -72,6 +72,12 @@ METRICS: tuple[MetricSpec, ...] = (
                ("north_star", "mfu_measured"), True, 0.20),
     MetricSpec("lint_open", "lint open findings",
                ("lint", "findings_open"), False, 0.0),
+    # the analyzer's own wall time: the self-hosting gate runs every
+    # commit, so the engine growing (CFG/dataflow/ABI passes) must not
+    # quietly turn `make lint` into minutes — loose tolerance, CI
+    # boxes jitter, but a blowup past 2x the predecessor regresses
+    MetricSpec("lint_wall", "lint wall secs",
+               ("lint", "wall_secs"), False, 1.0),
 )
 
 

@@ -31,7 +31,5 @@ def unsanctioned_wait(out):
     return out.block_until_ready()                        # EXPECT: JT-JAX-003
 
 
-def pack_hot_batch(views):
-    padded = np.pad(views[0], 4)                          # EXPECT: JT-JAX-005
-    staged = np.ascontiguousarray(padded)                 # EXPECT: JT-JAX-005
-    return np.copy(staged)                                # EXPECT: JT-JAX-005
+# The hot-path host-copy rule (ex-JT-JAX-005) lives in the JT-TENSOR
+# family now — see tensor_bad.py's pack_host_copies.

@@ -30,14 +30,3 @@ def host_side(out):
 def pack_hot_views(views):
     # hot-path packer with NO host copies: the views go straight out
     return {"appends": views, "n": len(views)}
-
-
-def pack_hot_justified(tail):
-    # a sanctioned copy carries its reason inline
-    return np.pad(tail, 2)   # jt-lint: ok JT-JAX-005 (ragged tail: no view exists)
-
-
-def render_copy(arr):
-    # copies OUTSIDE the pack/h2d hot path are none of this rule's
-    # business (witness rendering, artifact writers, ...)
-    return np.copy(np.pad(arr, 1))

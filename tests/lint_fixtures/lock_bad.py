@@ -1,0 +1,66 @@
+"""Seeded JT-LOCK violations (lockset + thread-spawn analysis)."""
+import threading
+import time
+
+_a = threading.Lock()
+_b = threading.Lock()
+
+
+def takes_a_then_b():
+    with _a:
+        with _b:                                              # EXPECT: JT-LOCK-001
+            return 1
+
+
+def takes_b_then_a():
+    with _b:
+        with _a:
+            return 2
+
+
+def lexical_reentry():
+    with _a:
+        with _a:                                              # EXPECT: JT-LOCK-001
+            return 0
+
+
+def reenters():
+    with _a:
+        return helper_under_a()                               # EXPECT: JT-LOCK-001
+
+
+def helper_under_a():
+    with _a:
+        return 3
+
+
+class DeviceSlotLedger:
+    """Shadows the registry entry: _inflight is declared guarded."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight = 0    # __init__ is exempt (single-threaded)
+
+    def acquire(self):
+        self._inflight += 1                                   # EXPECT: JT-LOCK-002
+
+    def release(self):
+        with self._lock:
+            self._inflight -= 1
+
+
+def sleeps_under_lock():
+    with _a:
+        time.sleep(0.5)                                       # EXPECT: JT-LOCK-003
+
+
+def spawner():
+    results = []
+
+    def worker():                                             # EXPECT: JT-LOCK-004
+        results.append(1)
+
+    th = threading.Thread(target=worker)
+    th.start()
+    results.append(0)
+    return th, results

@@ -1,0 +1,52 @@
+"""Clean twin of lock_bad.py: one lock order, guarded writes, blocking
+work outside critical sections, queue-carried thread results."""
+import queue
+import threading
+import time
+
+_a = threading.Lock()
+_b = threading.Lock()
+
+
+def takes_a_then_b():
+    with _a:
+        with _b:    # the ONE order, everywhere
+            return 1
+
+
+def also_a_then_b():
+    with _a, _b:
+        return 2
+
+
+class DeviceSlotLedger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight = 0
+
+    def acquire(self):
+        with self._lock:
+            self._inflight += 1
+
+    def release(self):
+        with self._lock:
+            self._inflight -= 1
+
+
+def sleeps_outside_lock():
+    with _a:
+        deadline = 0.5
+    time.sleep(deadline)
+
+
+def spawner():
+    out = queue.Queue()
+    results = []
+
+    def worker():
+        out.put(1)    # thread-safe carrier crosses the boundary
+
+    th = threading.Thread(target=worker)
+    th.start()
+    results.append(out.get())
+    return th, results

@@ -1,0 +1,34 @@
+"""Seeded JT-TENSOR violations (tensor-contract dataflow)."""
+import jax
+import numpy as np
+
+
+def pack_wrong_fill(shape):
+    appends = np.full((4, shape.n_appends, 3), 0, np.int32)   # EXPECT: JT-TENSOR-003
+    reads = np.full((4, shape.n_reads, 3), -1, np.int64)      # EXPECT: JT-TENSOR-003
+    d_invoke = np.zeros((4, shape.n_txns), np.int64)          # EXPECT: JT-TENSOR-003
+    return appends, reads, d_invoke
+
+
+def pack_undeclared_cast(enc):
+    status = np.asarray(enc.status, np.float32)               # EXPECT: JT-TENSOR-001
+    narrowed = enc.invoke_index.astype(np.int16)              # EXPECT: JT-TENSOR-001
+    declared = enc.complete_index.astype(np.int32)   # the v2 narrowing: fine
+    return status, narrowed, declared
+
+
+def pack_bad_geometry(enc, pad_to):
+    flat = np.asarray(enc.appends, np.int32).reshape(-1, 4)   # EXPECT: JT-TENSOR-003
+    txns = pad_to(enc.n, 16)                                  # EXPECT: JT-TENSOR-003
+    return flat, txns
+
+
+def pack_host_copies(views):
+    staged = np.ascontiguousarray(views[0])                   # EXPECT: JT-TENSOR-002
+    reads = views[1]
+    listed = reads.tolist()                                   # EXPECT: JT-TENSOR-002
+    return np.copy(staged), listed                            # EXPECT: JT-TENSOR-002
+
+
+def wrong_donation(f):
+    return jax.jit(f, donate_argnums=(0, 1, 2))               # EXPECT: JT-TENSOR-004

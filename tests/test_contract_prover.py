@@ -1,0 +1,237 @@
+"""Differential tests for the JT-ABI contract prover.
+
+The analyzer that guards the ABI must itself be guarded: each test
+copies the REAL `native/*.cc` / `native_lib.py` / `store.py` into a
+fixture tree, applies exactly one seeded mutation — a .cc signature
+change, a sidecar layout constant, a ctypes prototype — and asserts
+the prover reports exactly the expected JT-ABI finding (and nothing
+else). The unmutated tree must be clean, so a prover that goes blind
+(parser regression) or trigger-happy (false drift) fails loudly
+either way.
+"""
+
+from __future__ import annotations
+
+import shutil
+from pathlib import Path
+
+import pytest
+
+from jepsen_tpu.lint import ProjectCtx, cparse, rules_abi
+
+REPO = Path(__file__).resolve().parents[1]
+
+_FIXTURE_FILES = (
+    "native/hist_encode.cc", "native/wgl.cc", "native/graph_algo.cc",
+    "jepsen_tpu/native_lib.py", "jepsen_tpu/store.py",
+    "jepsen_tpu/checker/elle/encode.py",
+)
+
+
+@pytest.fixture()
+def tree(tmp_path: Path) -> Path:
+    for rel in _FIXTURE_FILES:
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(REPO / rel, dst)
+    return tmp_path
+
+
+def prove(root: Path):
+    ctx = ProjectCtx(root, [])
+    out = []
+    for r in rules_abi.RULES:
+        out.extend(r.check_project(ctx))
+    return out
+
+
+def mutate(root: Path, rel: str, old: str, new: str) -> None:
+    p = root / rel
+    text = p.read_text()
+    assert old in text, f"mutation anchor not found in {rel}: {old!r}"
+    p.write_text(text.replace(old, new, 1))
+
+
+def test_unmutated_tree_is_clean(tree):
+    assert prove(tree) == []
+
+
+def test_real_repo_is_clean():
+    # the rules run against the live tree in the self-hosting gate
+    # too; this pins the direct path the mutation tests exercise
+    assert prove(REPO) == []
+
+
+# -- the three satellite-mandated drifts ------------------------------------
+
+def test_cc_signature_drift_is_caught(tree):
+    # the .cc signature table: an export grows an argument the ctypes
+    # side doesn't declare
+    mutate(tree, "native/hist_encode.cc",
+           "void jt_ks_dims(void* hp, int64_t out[4])",
+           "void jt_ks_dims(void* hp, int64_t out[4], int64_t flags)")
+    findings = prove(tree)
+    assert [f.rule for f in findings] == ["JT-ABI-003"]
+    assert "jt_ks_dims" in findings[0].message
+    assert "2" in findings[0].message and "3" in findings[0].message
+
+
+def test_sidecar_layout_constant_drift_is_caught(tree):
+    # a sidecar layout constant moved on ONE side only
+    mutate(tree, "native/hist_encode.cc",
+           "int64_t PAD_TXNS = 128", "int64_t PAD_TXNS = 64")
+    findings = prove(tree)
+    assert [f.rule for f in findings] == ["JT-ABI-004"]
+    assert "PAD_TXNS=64" in findings[0].message
+    assert "_PAD_TXNS=128" in findings[0].message
+
+
+def test_ctypes_prototype_drift_is_caught(tree):
+    # a ctypes prototype that silently truncates the return value
+    mutate(tree, "jepsen_tpu/native_lib.py",
+           "L.jt_xxh64_buf.restype = ctypes.c_uint64",
+           "L.jt_xxh64_buf.restype = ctypes.c_int32")
+    findings = prove(tree)
+    assert [f.rule for f in findings] == ["JT-ABI-003"]
+    assert "jt_xxh64_buf" in findings[0].message
+    assert "c_int32" in findings[0].message
+
+
+# -- the rest of the drift surface ------------------------------------------
+
+def test_abi_version_bump_must_land_on_both_sides(tree):
+    mutate(tree, "native/hist_encode.cc",
+           "int64_t jt_ha_abi_version() { return 5; }",
+           "int64_t jt_ha_abi_version() { return 6; }")
+    findings = prove(tree)
+    assert [f.rule for f in findings] == ["JT-ABI-002"]
+    assert "returns 6" in findings[0].message
+    assert "checks 5" in findings[0].message
+
+
+def test_wgl_abi_version_is_proved_too(tree):
+    mutate(tree, "native/wgl.cc",
+           "int64_t jt_wgl_abi_version() { return 2; }",
+           "int64_t jt_wgl_abi_version() { return 3; }")
+    assert [f.rule for f in prove(tree)] == ["JT-ABI-002"]
+
+
+def test_new_export_without_prototype_is_caught(tree):
+    mutate(tree, "native/hist_encode.cc",
+           "void jt_ks_free(void* hp) { delete (SplitHandle*)hp; }",
+           "void jt_ks_free(void* hp) { delete (SplitHandle*)hp; }\n"
+           "int64_t jt_ks_new_thing(void* hp) { return 0; }")
+    findings = prove(tree)
+    assert [f.rule for f in findings] == ["JT-ABI-001"]
+    assert "jt_ks_new_thing" in findings[0].message
+
+
+def test_orphaned_prototype_is_caught(tree):
+    # the export vanishes; its prototype and the renamed export are
+    # BOTH findings (each half of the rename half-landed)
+    mutate(tree, "native/hist_encode.cc",
+           "void jt_ks_free(void* hp)", "void jt_ks_free2(void* hp)")
+    rules = sorted(f.rule for f in prove(tree))
+    assert rules == ["JT-ABI-001", "JT-ABI-001"]
+
+
+def test_ctypes_argtype_drift_is_caught(tree):
+    mutate(tree, "jepsen_tpu/native_lib.py",
+           "L.jt_ha_encode_file.argtypes = [ctypes.c_char_p]",
+           "L.jt_ha_encode_file.argtypes = [ctypes.c_void_p]")
+    findings = prove(tree)
+    assert [f.rule for f in findings] == ["JT-ABI-003"]
+    assert "arg 0" in findings[0].message
+
+
+def test_magic_string_drift_is_caught(tree):
+    mutate(tree, "jepsen_tpu/store.py",
+           'ENCODED_MAGIC_V2 = b"JTENC02\\n"',
+           'ENCODED_MAGIC_V2 = b"JTENC03\\n"')
+    findings = prove(tree)
+    assert [f.rule for f in findings] == ["JT-ABI-004"]
+    assert "ENCODED_MAGIC_V2" in findings[0].message
+
+
+def test_field_order_drift_is_caught(tree):
+    # reordering the Python reader's canonical field list away from
+    # the native writer's push order is layout drift
+    mutate(tree, "jepsen_tpu/store.py",
+           '"append": ("appends", "reads", "status", "process",\n'
+           '               "invoke_index", "complete_index")',
+           '"append": ("reads", "appends", "status", "process",\n'
+           '               "invoke_index", "complete_index")')
+    findings = prove(tree)
+    assert [f.rule for f in findings] == ["JT-ABI-004"]
+    assert "field order drift" in findings[0].message
+
+
+def test_loop_bound_prototype_drift_is_caught(tree):
+    # prototypes bound via the `for name in (...)` batch form are
+    # part of the proved surface too
+    mutate(tree, "jepsen_tpu/native_lib.py",
+           'for name in ("jt_ha_appends", "jt_ha_reads", "jt_ha_edges"',
+           'for name in ("jt_ha_appends", "jt_ha_readz", "jt_ha_edges"')
+    rules = sorted(f.rule for f in prove(tree))
+    # jt_ha_readz: prototype without export; jt_ha_reads: export
+    # without prototype
+    assert rules == ["JT-ABI-001", "JT-ABI-001"]
+
+
+def test_missing_native_tree_proves_nothing(tmp_path):
+    # installed-package context: no native/ sources, no findings
+    (tmp_path / "jepsen_tpu").mkdir()
+    shutil.copy(REPO / "jepsen_tpu/native_lib.py",
+                tmp_path / "jepsen_tpu/native_lib.py")
+    assert prove(tmp_path) == []
+
+
+# -- cparse unit coverage ---------------------------------------------------
+
+def test_safe_int_eval():
+    assert cparse.safe_int_eval("64 * 1024") == 65536
+    assert cparse.safe_int_eval("int64_t(1) << 30") == 1 << 30
+    assert cparse.safe_int_eval("0x9E3779B185EBCA87ULL") \
+        == 0x9E3779B185EBCA87
+    assert cparse.safe_int_eval("INT64_MIN") is None
+    assert cparse.safe_int_eval("sizeof(x)") is None
+
+
+def test_normalize_type():
+    assert cparse.normalize_type("const char* p", with_name=True) \
+        == "char*"
+    assert cparse.normalize_type("int64_t out[8]", with_name=True) \
+        == "int64_t*"
+    assert cparse.normalize_type("const int32_t*") == "int32_t*"
+    assert cparse.normalize_type("void") == "void"
+
+
+def test_strip_comments_preserves_lines_and_strings():
+    src = ('int a = 1; // trailing\n'
+           '/* multi\n   line */ int b = 2;\n'
+           'const char* s = "// not a comment";\n')
+    out = cparse.strip_comments(src)
+    assert out.count("\n") == src.count("\n")
+    assert "trailing" not in out and "multi" not in out
+    assert '"// not a comment"' in out
+
+
+def test_magic_ternary_expansion():
+    abi = cparse.parse_native(
+        "static bool w() {\n"
+        "  const char MAGIC[8] = {'J', 'T', 'E', 'N', 'C', '0',\n"
+        "                         version == 2 ? '2' : '1', '\\n'};\n"
+        "  return true;\n}\n")
+    assert abi.magics == {b"JTENC01\n", b"JTENC02\n"}
+
+
+def test_parse_exports_sees_extern_c_only():
+    abi = cparse.parse_native(
+        'int64_t jt_internal(void* p) { return 0; }\n'
+        'extern "C" {\n'
+        'int64_t jt_public(const char* s, int64_t n) { return 1; }\n'
+        '}\n')
+    assert list(abi.exports) == ["jt_public"]
+    sig = abi.exports["jt_public"]
+    assert sig.ret == "int64_t"
+    assert sig.args == ("char*", "int64_t")

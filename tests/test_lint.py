@@ -44,7 +44,8 @@ def findings_of(path: Path) -> list[tuple[int, str]]:
                   for f in lint.lint_paths([path], root=REPO))
 
 
-FAMILIES = ["gates", "jax", "concurrency", "shm", "trace"]
+FAMILIES = ["gates", "jax", "concurrency", "shm", "trace", "tensor",
+            "lock"]
 
 
 @pytest.mark.parametrize("family", FAMILIES)
@@ -199,10 +200,55 @@ def test_gate_coverage_needs_word_boundary(tmp_path):
     assert missing == {"JEPSEN_TPU_TRACE"}
 
 
+# -- the lockset engine -----------------------------------------------------
+
+def test_blocking_call_in_a_later_with_item_is_under_the_lock(tmp_path):
+    # `with _lock, fut.result():` — the later context expressions
+    # evaluate AFTER the first lock is acquired; the With node's own
+    # lockset must include it (regression: the compute_locksets fixup
+    # once keyed this by the lock-id string instead of the node)
+    rules = _lint_at(
+        tmp_path, "pkg/m.py",
+        "import threading\n"
+        "_lock = threading.Lock()\n"
+        "def f(fut):\n"
+        "    with _lock, fut.result():\n"
+        "        pass\n")
+    assert rules == ["JT-LOCK-003"]
+
+
+def test_hot_file_tag_tracking_sees_local_aliases(tmp_path):
+    # inside a declared hot-path FILE, a contracted tensor bound to a
+    # local name must still be tracked (regression: a whole-module
+    # scope once left the tag map empty exactly there)
+    rules = _lint_at(
+        tmp_path, "jepsen_tpu/shm.py",
+        "def materialize(enc):\n"
+        "    arr = enc.appends\n"
+        "    return arr.tolist()\n")
+    assert rules == ["JT-TENSOR-002"]
+
+
+def test_blocking_registry_drives_the_rule(tmp_path):
+    from jepsen_tpu.lint import contracts, rules_lock
+    import ast as _ast
+    for name in sorted(contracts.BLOCKING_EXACT):
+        call = _ast.parse(f"{name}(1)").body[0].value
+        assert rules_lock._is_blocking(call) == name
+    call = _ast.parse("subprocess.check_output(['x'])").body[0].value
+    assert rules_lock._is_blocking(call) is not None
+    # str.join is deliberately outside the declared surface
+    call = _ast.parse("' '.join(xs)").body[0].value
+    assert rules_lock._is_blocking(call) is None
+
+
 # -- the self-hosting contract ---------------------------------------------
 
 def test_package_is_clean_against_baseline():
-    findings = lint.lint_project(REPO)
+    # the content-hash cache keeps this gate fast as the engine grows
+    # (and is itself exercised here: a poisoned entry would surface as
+    # a phantom finding)
+    findings = lint.lint_project(REPO, cache=lint.LintCache(REPO))
     entries = lint.load_baseline(REPO / "lint_baseline.json")
     res = lint.apply_baseline(findings, entries)
     assert res.kept == [], "\n" + "\n".join(f.render() for f in res.kept)
@@ -212,9 +258,171 @@ def test_package_is_clean_against_baseline():
 def test_rule_families_all_registered():
     ids = lint.rule_ids()
     assert len(ids) == len(set(ids))
-    for fam in ("JT-GATE", "JT-JAX", "JT-THREAD", "JT-SHM", "JT-TRACE"):
+    for fam in ("JT-GATE", "JT-JAX", "JT-THREAD", "JT-SHM", "JT-TRACE",
+                "JT-ABI", "JT-TENSOR", "JT-LOCK", "JT-META"):
         assert any(i.startswith(fam + "-") for i in ids), fam
-    assert len(ids) >= 15
+    assert len(ids) >= 29
+
+
+#: The GOLDEN rule-id table. Renumbering an existing rule, dropping
+#: one, or adding one without updating this list is a tier-1 failure
+#: — the rule surface changes only with a visible diff here. (The
+#: retired JT-JAX-005 is deliberately absent: subsumed by
+#: JT-TENSOR-002, see MIGRATING.md.)
+GOLDEN_RULE_IDS = [
+    "JT-ABI-001", "JT-ABI-002", "JT-ABI-003", "JT-ABI-004",
+    "JT-GATE-001", "JT-GATE-002", "JT-GATE-003", "JT-GATE-004",
+    "JT-JAX-001", "JT-JAX-002", "JT-JAX-003", "JT-JAX-004",
+    "JT-LOCK-001", "JT-LOCK-002", "JT-LOCK-003", "JT-LOCK-004",
+    "JT-META-001",
+    "JT-SHM-001",
+    "JT-TENSOR-001", "JT-TENSOR-002", "JT-TENSOR-003", "JT-TENSOR-004",
+    "JT-THREAD-001", "JT-THREAD-002", "JT-THREAD-003", "JT-THREAD-004",
+    "JT-TRACE-001", "JT-TRACE-002", "JT-TRACE-003",
+]
+
+
+def test_rule_id_table_is_pinned():
+    assert lint.rule_ids() == GOLDEN_RULE_IDS
+
+
+def test_jt_jax_005_is_retired_not_renumbered():
+    # the subsumption must not leave a dangling or reused id
+    assert "JT-JAX-005" not in lint.rule_ids()
+    docs = {r["id"]: r["doc"] for r in lint.rule_table()}
+    assert "JT-JAX-005" in docs["JT-TENSOR-002"]
+
+
+def test_family_of():
+    assert lint.family_of("JT-TENSOR-002") == "JT-TENSOR"
+    assert lint.family_of("JT-META-001") == "JT-META"
+
+
+def test_readme_rule_table_drift(tmp_path):
+    from jepsen_tpu.lint import rules_meta
+    rule = rules_meta.RuleTableDrift()
+    ctx = lint.ProjectCtx(tmp_path, [])
+    (tmp_path / "README.md").write_text(
+        lint.RULES_BEGIN + "\n| drifted |\n" + lint.RULES_END + "\n")
+    assert [f.rule for f in rule.check_project(ctx)] == ["JT-META-001"]
+    (tmp_path / "README.md").write_text(
+        "intro\n\n" + lint.render_rule_block() + "\n\noutro\n")
+    assert list(rule.check_project(ctx)) == []
+    (tmp_path / "README.md").write_text("no markers at all\n")
+    assert [f.rule for f in rule.check_project(ctx)] == ["JT-META-001"]
+
+
+# -- incremental mode (--changed + the content-hash cache) ------------------
+
+def test_lint_cache_roundtrip_and_invalidation(tmp_path):
+    src = tmp_path / "m.py"
+    src.write_text("import os\n"
+                   "x = os.environ['JEPSEN_TPU_TRACE']\n")
+    cache = lint.LintCache(tmp_path)
+    first = lint.lint_paths([src], tmp_path, cache=cache)
+    assert [f.rule for f in first] == ["JT-GATE-001"]
+    assert cache.hits == 0
+    # the second run over identical content is served from the cache,
+    # byte-identical findings included
+    cache2 = lint.LintCache(tmp_path)
+    second = lint.lint_paths([src], tmp_path, cache=cache2)
+    assert cache2.hits == 1
+    assert second == first
+    # editing the file invalidates its entry
+    src.write_text("x = 1\n")
+    cache3 = lint.LintCache(tmp_path)
+    assert lint.lint_paths([src], tmp_path, cache=cache3) == []
+    assert cache3.hits == 0
+
+
+def test_lint_cache_key_includes_the_path(tmp_path):
+    # findings are NOT a pure function of content: byte-identical
+    # files at different paths must not share a cache entry (path-
+    # scoped rules differ, and findings embed the path)
+    src = ("import numpy as np\n"
+           "def pack_x(v):\n"
+           "    return np.copy(v)\n")
+    hot = tmp_path / "jepsen_tpu" / "shm.py"        # hot-path file
+    hot.parent.mkdir(parents=True)
+    hot.write_text(src)
+    cold = tmp_path / "jepsen_tpu" / "render.py"    # same bytes
+    cold.write_text(src)
+    cache = lint.LintCache(tmp_path)
+    first = lint.lint_paths([hot], tmp_path, cache=cache)
+    assert {(f.rule, f.path) for f in first} \
+        == {("JT-TENSOR-002", "jepsen_tpu/shm.py")}
+    second = lint.lint_paths([cold], tmp_path, cache=cache)
+    assert cache.hits == 0          # different path -> different key
+    assert {(f.rule, f.path) for f in second} \
+        == {("JT-TENSOR-002", "jepsen_tpu/render.py")}
+
+
+def test_engine_fingerprint_covers_rule_inputs():
+    # the registries rules consult at check time are part of the
+    # fingerprint — editing gates.py must invalidate cached results
+    pkg = Path(lint.__file__).resolve().parent.parent
+    for rel in lint._RULE_INPUT_SOURCES:
+        assert (pkg / rel).is_file(), rel
+
+
+def test_lint_cache_corrupt_entry_is_a_miss(tmp_path):
+    src = tmp_path / "m.py"
+    src.write_text("import os\n")
+    cache = lint.LintCache(tmp_path)
+    lint.lint_paths([src], tmp_path, cache=cache)
+    for p in cache.dir.glob("*.json"):
+        p.write_text("{torn")
+    cache2 = lint.LintCache(tmp_path)
+    assert lint.lint_paths([src], tmp_path, cache=cache2) == []
+    assert cache2.hits == 0
+
+
+def test_changed_files_tracks_the_merge_base(tmp_path):
+    import subprocess
+
+    def git(*args):
+        subprocess.run(["git", "-C", str(tmp_path), *args],
+                       check=True, capture_output=True)
+
+    git("init", "-q", "-b", "main")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    pkg = tmp_path / "jepsen_tpu"
+    pkg.mkdir()
+    (pkg / "clean.py").write_text("x = 1\n")
+    (pkg / "dirty.py").write_text("y = 1\n")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    (pkg / "dirty.py").write_text("y = 2\n")
+    (pkg / "new.py").write_text("z = 1\n")
+    (tmp_path / "outside.py").write_text("w = 1\n")   # not the package
+    changed = lint.changed_files(tmp_path)
+    assert changed is not None
+    assert sorted(p.name for p in changed) == ["dirty.py", "new.py"]
+
+
+def test_run_changed_mode_end_to_end(tmp_path, capsys):
+    import subprocess
+
+    def git(*args):
+        subprocess.run(["git", "-C", str(tmp_path), *args],
+                       check=True, capture_output=True)
+
+    git("init", "-q", "-b", "main")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    pkg = tmp_path / "jepsen_tpu"
+    pkg.mkdir()
+    (pkg / "a.py").write_text("a = 1\n")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    (pkg / "b.py").write_text(
+        "import os\nx = os.environ['JEPSEN_TPU_TRACE']\n")
+    rc = lint.run(None, root=tmp_path, changed=True)
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "JT-GATE-001" in out and "1 dirty file(s)" in out
+    assert (tmp_path / "bench_artifacts" / ".lintcache").is_dir()
 
 
 # -- CLI --------------------------------------------------------------------
