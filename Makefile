@@ -8,7 +8,8 @@ ASAN_RT := $(shell gcc -print-file-name=libasan.so)
 TSAN_RT := $(shell gcc -print-file-name=libtsan.so)
 
 .PHONY: lint lint-json lint-changed env-table rule-table test native \
-	native-sanitize bench bench-report bench-warm obs-smoke
+	native-sanitize bench bench-report bench-warm obs-smoke \
+	trace-report
 
 # Self-hosted static analysis: gate registry, JAX hazards, concurrency
 # discipline, shm lifecycle, tracer discipline, plus the cross-boundary
@@ -100,8 +101,17 @@ bench-report:
 bench-warm:
 	JAX_PLATFORMS=cpu $(PY) -m jepsen_tpu.warm_bench
 
-# Live-telemetry smoke: a tiny sweep with the health sampler and the
-# /metrics endpoint force-enabled, one mid-flight scrape, and an
-# exposition<->metrics.json parity check. Exit 0/1.
+# Live-telemetry + trace-fabric smoke: a tiny POOLED sweep with the
+# health sampler, the /metrics endpoint and the attribution report
+# force-enabled, one mid-flight scrape, an exposition<->metrics.json
+# parity check, and the merged-trace/report contract (>=1 worker
+# track with encode spans; shares sum to ~1.0). Exit 0/1.
 obs-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m jepsen_tpu.obs.smoke
+
+# Convenience: re-sweep an existing store (STORE ?= store) and emit
+# the merged trace + critical-path attribution report
+# (<store>/trace.json, report.json, report.md).
+STORE ?= store
+trace-report:
+	$(PY) -m jepsen_tpu.cli analyze-store --store $(STORE) --report

@@ -835,12 +835,37 @@ def bench_north_star(n_dev: int, devices) -> dict:
                 if part is None:
                     break
                 pend = nxt
+            t1 = time.perf_counter()
             return {
-                "t_sweep": time.perf_counter() - t0,
+                "t_sweep": t1 - t0,
+                # the sweep's window on the round tracer's timeline,
+                # for the critical-path decomposition (the round
+                # tracer spans every bench block; attribution must
+                # see only THIS sweep's events)
+                "window_us": (_tr.rel_us(t0), _tr.rel_us(t1)),
                 "phases": phases, "pipe_info": pipe_info,
                 "dev_spans": dev_spans, "verdicts": verdicts,
                 "counters": {c: _ctr(c) - ctr0[c] for c in _CTRS},
             }
+
+        def sweep_attribution(sw: dict) -> dict | None:
+            """The serial-bottleneck decomposition of one sweep's
+            window (jepsen_tpu.obs.attribution over the round
+            tracer's events) — None with tracing off."""
+            if not getattr(_tr, "enabled", False):
+                return None
+            from jepsen_tpu.obs import attribution as _att
+            rep = _att.analyze(_tr.chrome_events(),
+                               window_us=sw["window_us"])
+            return {"shares": rep["shares"], "bound": rep["bound"],
+                    "ideal_wall_secs": rep["ideal_wall_secs"],
+                    "headroom_secs": rep["headroom_secs"],
+                    "stalls": {k: rep["stalls"][k]
+                               for k in ("device_busy_secs",
+                                         "ingest_starved_secs",
+                                         "pack_bound_secs",
+                                         "other_secs")
+                               if k in rep["stalls"]}}
 
         # Timed region = the COLD streaming sweep: every run dir
         # misses the encoded cache, parses, and leaves a sidecar.
@@ -906,6 +931,10 @@ def bench_north_star(n_dev: int, devices) -> dict:
                 "compile_cache_hit_rate": (
                     round(wk["compile_cache_hits"] / warm_dispatches, 3)
                     if warm_dispatches else None),
+                # the warm sweep's own bottleneck decomposition — the
+                # copy-free path's honesty check (a warm sweep whose
+                # parse share regrows is re-parsing)
+                "attribution": sweep_attribution(warm),
                 **wk,
             }
         else:
@@ -976,6 +1005,13 @@ def bench_north_star(n_dev: int, devices) -> dict:
             # the sum tracks sweep_secs up to loop glue.
             "phases": phase_out,
             "phases_sum_secs": round(sum(phase_out.values()), 3),
+            # the serial bottleneck decomposition of the timed (cold)
+            # sweep: every wall second charged to one stage by
+            # pipeline priority (device > h2d > pack > encode > parse
+            # > ... > idle), plus the bound stage and the ideal wall
+            # under perfect overlap — jepsen_tpu.obs.attribution,
+            # the same analysis `analyze-store --report` persists
+            "attribution": sweep_attribution(cold),
             # THE overlap number (one field, measured, replacing the
             # old pipeline_overlap/pipeline_overlap_measured pair):
             # seconds where a pool worker's parse span intersected a

@@ -191,6 +191,13 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
                               "runs this checker already verdicted "
                               "(results.json naming the checker, or "
                               "the fallback's .sweep-* sidecar)")
+    p_batch.add_argument("--report", action="store_true",
+                         help="write the critical-path attribution "
+                              "report (<store>/report.json + "
+                              "report.md) from the merged sweep "
+                              "timeline at exit (JEPSEN_TPU_REPORT=1 "
+                              "is the env equivalent; needs tracing "
+                              "on)")
     add_trace_opts(p_batch)
 
     p_serve = sub.add_parser("serve", help="serve the store over HTTP")
@@ -308,7 +315,8 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
             return worst
         if args.command == "analyze-store":
             return analyze_store(Store(args.store), checker=args.checker,
-                                 name=args.name, resume=args.resume)
+                                 name=args.name, resume=args.resume,
+                                 report=args.report or None)
         if args.command == "serve":
             from . import web
             web.serve(Store(args.store), host=args.host, port=args.port)
@@ -323,12 +331,20 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
 
 def analyze_store(store: Store, checker: str = "append",
                   name: str | None = None,
-                  resume: bool = False, obs_hook=None) -> int:
+                  resume: bool = False, obs_hook=None,
+                  report: bool | None = None) -> int:
     """`_analyze_store_impl` wrapped in a fresh sweep tracer: the whole
     sweep's spans (ingest parse, pack/h2d/dispatch/collect phases,
     device windows, per-checker fallbacks) export to
     `<store>/trace.json` + `metrics.json` at exit, printing the path —
     the sweep-level analogue of the per-run artifacts save_2 writes.
+    Since the trace fabric the exported trace.json is MERGED: each
+    pool worker's span spool (`trace-<pid>.jsonl`, written per task)
+    folds in as its own real-pid process track, so encode time is
+    visible per worker, not inferred from parent stalls. With
+    `report` (the `--report` flag; None defers to JEPSEN_TPU_REPORT)
+    the critical-path attribution report (`report.json` +
+    `report.md`) is derived from the same merged timeline.
 
     Sweep start also reclaims /dev/shm segments a previous crashed
     run's dead pid left behind (`shm_stale_reclaimed` counter), and
@@ -347,7 +363,19 @@ def analyze_store(store: Store, checker: str = "append",
     from . import obs
     from . import shm as _shm
     from .store import VerdictJournal
+    if report is None:
+        report = gates.get("JEPSEN_TPU_REPORT")
     tr = trace.fresh_run(f"analyze-store:{checker}", scope="sweep")
+    if getattr(tr, "enabled", False) and store.base.is_dir():
+        # point the worker trace fabric at the store: pool workers
+        # spool spans to <store>/trace-<pid>.jsonl; stale spools from
+        # a previous sweep are derived artifacts keyed by trace id —
+        # cleared here so the store holds exactly this sweep's set
+        trace.clean_spools(store.base)
+        tr.spool_dir = store.base
+    elif report:
+        print("attribution report needs tracing on "
+              "(JEPSEN_TPU_TRACE=0 set); skipping", file=sys.stderr)
     tr.counter("shm_stale_reclaimed").inc(_shm.reclaim_stale())
     journal = VerdictJournal(store.base / "verdicts.jsonl",
                              base=store.base)
@@ -378,9 +406,20 @@ def analyze_store(store: Store, checker: str = "append",
         obs.reset_events()
         if getattr(tr, "enabled", False) and store.base.is_dir():
             try:
-                p = tr.export(store.base / "trace.json")
+                # the merged export: parent events + every worker
+                # spool of THIS sweep, one real-pid track per worker
+                evs = trace.merge_traces(tr, store.base)
+                p = trace.atomic_write_text(
+                    store.base / "trace.json",
+                    json.dumps({"traceEvents": evs,
+                                "displayTimeUnit": "ms"}))
                 tr.export_metrics(store.base / "metrics.json")
                 print(f"trace written to {p}", file=sys.stderr)
+                if report:
+                    from .obs import attribution
+                    rj, _rmd = attribution.write_report(
+                        store.base, evs, tr.metrics_dict())
+                    print(f"report written to {rj}", file=sys.stderr)
             except Exception:
                 log.warning("sweep trace export failed", exc_info=True)
 

@@ -143,6 +143,15 @@ _g("JEPSEN_TPU_TRACE", "bool", True,
 _g("JEPSEN_TPU_TRACE_MAX_EVENTS", "int", 200_000,
    "bounded tracer event buffer; overflow is counted "
    "(`dropped_events`), never silent")
+_g("JEPSEN_TPU_WORKER_TRACE", "bool", True,
+   "`0`: ingest pool workers record no spans and write no "
+   "`trace-<pid>.jsonl` spools (the merged sweep trace then carries "
+   "only parent-side tracks); moot when `JEPSEN_TPU_TRACE=0` — no "
+   "tracer means no spools either way")
+_g("JEPSEN_TPU_REPORT", "bool", False,
+   "set: `analyze-store` writes the critical-path attribution report "
+   "(`<store>/report.json` + `report.md`) at sweep end, as if "
+   "`--report` were passed")
 _g("JEPSEN_TPU_JAX_PROFILE", "bool", False,
    "`1`: wrap the run in a `jax.profiler` capture "
    "(`<run-dir>/jax-profile`; `--jax-profile` sets it)")

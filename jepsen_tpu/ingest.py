@@ -50,7 +50,8 @@ def encode_run_dir(run_dir: str | os.PathLike, checker: str = "append",
     `info`, when given, gets info["cache"] set to "hit"/"miss" (None
     when the encoded sidecar cache didn't apply) so pooled callers can
     aggregate cache counters in the PARENT tracer — pool workers'
-    tracers are process-local and never exported."""
+    COUNTERS are process-local and never exported (their spans spool
+    to the trace fabric, but counters relay only via this dict)."""
     from . import supervisor, trace
     # self-nemesis (JEPSEN_TPU_FAULT_INJECT): deterministic encode
     # faults / worker kills land here, ahead of the cache, so every
@@ -62,7 +63,8 @@ def encode_run_dir(run_dir: str | os.PathLike, checker: str = "append",
     if cacheable:
         from . import store as _store
         if _store.encode_cache_enabled():
-            enc = _store.load_encoded(run_dir, checker)
+            with trace.span("cache_probe"):
+                enc = _store.load_encoded(run_dir, checker)
             if enc is not None:
                 trace.counter("cache_hits").inc()
                 if info is not None:
@@ -92,30 +94,36 @@ def encode_run_dir(run_dir: str | os.PathLike, checker: str = "append",
             if _store.encode_cache_enabled() \
                     and _store.encode_cache_write_enabled():
                 sidecar = _store.encoded_cache_path(run_dir, checker)
-            enc = (ne.encode_history_file(jl, sidecar_path=sidecar)
-                   if checker == "append"
-                   else ne.encode_wr_history_file(jl,
-                                                  sidecar_path=sidecar))
+            with trace.span("encode_native"):
+                enc = (ne.encode_history_file(jl, sidecar_path=sidecar)
+                       if checker == "append"
+                       else ne.encode_wr_history_file(
+                           jl, sidecar_path=sidecar))
             if enc is not None:
                 return enc
-    hist = load_history_dir(run_dir)
-    if checker == "append":
-        from .checker.elle.encode import encode_history, lean_anomalies
-        enc = encode_history(hist)
-        if lean:
-            enc.anomalies = lean_anomalies(enc)
-    elif checker == "wr":
-        from .checker.elle.wr import encode_wr_history, lean_wr_anomalies
-        enc = encode_wr_history(hist)
-        if lean:
-            enc.anomalies = lean_wr_anomalies(enc)
-    else:
-        raise ValueError(f"unknown checker {checker!r}")
+    with trace.span("load_history"):
+        hist = load_history_dir(run_dir)
+    with trace.span("encode_py"):
+        if checker == "append":
+            from .checker.elle.encode import (encode_history,
+                                              lean_anomalies)
+            enc = encode_history(hist)
+            if lean:
+                enc.anomalies = lean_anomalies(enc)
+        elif checker == "wr":
+            from .checker.elle.wr import (encode_wr_history,
+                                          lean_wr_anomalies)
+            enc = encode_wr_history(hist)
+            if lean:
+                enc.anomalies = lean_wr_anomalies(enc)
+        else:
+            raise ValueError(f"unknown checker {checker!r}")
     if lean:
         enc.txn_ops = []
         if cacheable:
             from . import store as _store
-            _store.save_encoded(run_dir, checker, enc)
+            with trace.span("sidecar_write"):
+                _store.save_encoded(run_dir, checker, enc)
     return enc
 
 
@@ -130,28 +138,11 @@ def _worker(args):
 def overlap_seconds(spans_a: list, spans_b: list) -> float:
     """Total seconds where some span in `a` intersects some span in
     `b` (both lists of (start, end) wall-clock pairs). Used to report
-    honest pipeline overlap: worker parse spans x caller device spans."""
-    if not spans_a or not spans_b:
-        return 0.0
-    # merge each side first so double-counting can't inflate the number
-    def merge(spans):
-        out = []
-        for s, e in sorted(spans):
-            if out and s <= out[-1][1]:
-                out[-1] = (out[-1][0], max(out[-1][1], e))
-            else:
-                out.append((s, e))
-        return out
-    total, bi = 0.0, 0
-    b = merge(spans_b)
-    for s, e in merge(spans_a):
-        while bi < len(b) and b[bi][1] <= s:
-            bi += 1
-        j = bi
-        while j < len(b) and b[j][0] < e:
-            total += max(0.0, min(e, b[j][1]) - max(s, b[j][0]))
-            j += 1
-    return total
+    honest pipeline overlap: worker parse spans x caller device
+    spans. Delegates to the one shared interval implementation in
+    `trace` (the attribution report walks the same arithmetic)."""
+    from . import trace
+    return trace.overlap_seconds(spans_a, spans_b)
 
 
 def _stream_worker(args):
@@ -162,12 +153,24 @@ def _stream_worker(args):
     descriptor, the encoding itself, or the per-run Exception. The
     (t0, t1) parse span uses time.monotonic: CLOCK_MONOTONIC is
     system-wide on Linux, so spans compare across processes (the
-    measured-overlap contract) and an NTP step can't corrupt them."""
-    idx, run_dir, checker, seg_name = args
+    measured-overlap contract) and an NTP step can't corrupt them.
+
+    With worker tracing on (`tctx` non-None — parent tracing enabled,
+    JEPSEN_TPU_WORKER_TRACE on, a spool dir registered), the worker
+    records its own spans into a process-local Tracer, spools them to
+    `<store>/trace-<pid>.jsonl` per task (torn-tail-safe), and ships
+    a compact digest back in einfo["tdigest"] — the parent folds the
+    digest into its metrics and merge_traces folds the spool into
+    the sweep's trace.json as this worker's own pid track."""
+    idx, run_dir, checker, seg_name, tctx = args
+    from . import trace
+    trace.ensure_worker_tracer(tctx)
     t0 = time.monotonic()
     einfo: dict = {}
     try:
-        enc = encode_run_dir(run_dir, checker, info=einfo)
+        with trace.span("encode",
+                        run=os.path.basename(str(run_dir).rstrip("/"))):
+            enc = encode_run_dir(run_dir, checker, info=einfo)
         from . import shm
         from . import store as _store
         if _store.sidecar_version(checker) == 2 \
@@ -186,12 +189,17 @@ def _stream_worker(args):
             # its own mapping for the pack stage to stay copy-free
             payload = shm.sidecar_ref(run_dir, checker)
         elif seg_name is not None:
-            payload = shm.export(enc, seg_name, checker)
+            with trace.span("shm_export"):
+                payload = shm.export(enc, seg_name, checker)
         else:
             payload = enc
     except Exception as e:
         payload = e
-    return idx, payload, einfo, t0, time.monotonic()
+    t1 = time.monotonic()
+    digest = trace.flush_worker_spool()
+    if digest:
+        einfo["tdigest"] = digest
+    return idx, payload, einfo, t0, t1
 
 
 def _load_worker(run_dir):
@@ -350,8 +358,13 @@ def iter_encode_chunks(run_dirs: Sequence[str | os.PathLike],
             if info is not None:
                 info["pooled"] = True
             tr = trace.get_current()
+            # worker trace fabric: one context per sweep (trace id +
+            # spool dir + monotonic send stamp); None when tracing or
+            # worker tracing is off — the worker then skips the whole
+            # fabric for free
+            tctx = trace.worker_ctx()
             futs = [ex.submit(_stream_worker,
-                              (i, d, checker, names[i]))
+                              (i, d, checker, names[i], tctx))
                     for i, d in enumerate(dirs)]
             pending: dict = {}   # idx -> ((dir, enc), span)
             frontier = 0         # next idx to yield
@@ -370,10 +383,22 @@ def iter_encode_chunks(run_dirs: Sequence[str | os.PathLike],
                     tr.counter("cache_hits").inc()
                 elif einfo.get("cache") == "miss":
                     tr.counter("cache_misses").inc()
+                td = einfo.get("tdigest")
+                if td:
+                    # the worker's span digest, relayed through the
+                    # einfo path like the cache counters: span count
+                    # plus per-stage seconds per task (full spans live
+                    # in the worker's spool for merge_traces)
+                    tr.counter("worker_spans").inc(
+                        int(td.get("spans", 0)))
+                    for k, secs in (td.get("stage_secs")
+                                    or {}).items():
+                        tr.histogram(f"worker.{k}").observe(secs)
                 if einfo.get("upgraded"):
                     # the worker's v1->v2 upgrade telemetry relayed
-                    # into THIS process (worker tracers/events are
-                    # process-local and never exported)
+                    # into THIS process (worker counters/events are
+                    # process-local and never exported; only spans
+                    # ride the spool)
                     tr.counter("sidecar_upgrades").inc()
                     from .obs import events as obs_events
                     obs_events.emit(

@@ -1,4 +1,5 @@
-"""JT-TRACE — tracer/span, metric-name and obs-event discipline.
+"""JT-TRACE — tracer/span, metric-name, obs-event and trace-spool
+discipline.
 
 Spans must be context-managed (`with trace.span(...)`): a span object
 held open across an exception never records, and manual enter/exit
@@ -11,12 +12,17 @@ diff downstream reads half the events. Flight-recorder events must go
 through the typed `obs.emit` API with a kind declared in
 `obs.events.EVENT_KINDS` — an ad-hoc dict append to `events.jsonl`
 (or a typoed kind) forks the event stream exactly the way an
-undeclared metric forks a series.
+undeclared metric forks a series. Worker trace spools
+(`trace-<pid>.jsonl`) are a wire format owned end to end by
+`jepsen_tpu.trace` (writer, loader, merger): a module hand-rolling
+the path or the line format forks the spool protocol the same way —
+the merge would silently skip (or mis-parse) its files.
 """
 
 from __future__ import annotations
 
 import ast
+import re
 from typing import Iterator
 
 from . import Finding, ModuleCtx, ModuleRule, const_str
@@ -156,5 +162,46 @@ class AdHocObsEvent(ModuleRule):
                         ctx, n, f"undeclared obs event kind {kind!r}")
 
 
+#: The spool-name shape trace.py owns (SPOOL_PREFIX + "<pid>.jsonl",
+#: or the glob over it). Matches "trace-123.jsonl", "trace-*.jsonl"
+#: and path-suffixed forms like "store/trace-9.jsonl".
+_SPOOL_RE = re.compile(r"(^|/)trace-[^/]*\.jsonl$")
+
+
+class AdHocSpoolWrite(ModuleRule):
+    id = "JT-TRACE-004"
+    doc = ("a `trace-<pid>.jsonl` worker-spool path built outside "
+           "jepsen_tpu.trace — the spool naming and line format are "
+           "a wire protocol owned by trace.py; an ad-hoc writer or "
+           "globber forks it and the merge silently skips its files")
+    hint = ("go through the trace API (worker_ctx/ensure_worker_"
+            "tracer/flush_worker_spool to write, merge_traces/"
+            "iter_spools/load_spool/clean_spools to read — the "
+            "naming lives in trace.SPOOL_PREFIX)")
+
+    def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
+        if ctx.rel.endswith(_TRACE_FILE):
+            return
+        for n in ast.walk(ctx.tree):
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                if _SPOOL_RE.search(n.value):
+                    yield self.finding(
+                        ctx, n, f"ad-hoc spool path {n.value!r}")
+            elif isinstance(n, ast.JoinedStr) and n.values:
+                parts = [const_str(v) for v in n.values]
+                tail = parts[-1]
+                # any constant segment ending in a path component that
+                # starts "trace-" (covers both f"trace-{pid}.jsonl"
+                # and f"{store}/trace-{pid}.jsonl"), with the literal
+                # ".jsonl" tail — an interpolated directory prefix
+                # can't evade the rule
+                if tail is not None and tail.endswith(".jsonl") \
+                        and any(p is not None
+                                and re.search(r"(^|/)trace-[^/]*$", p)
+                                for p in parts[:-1]):
+                    yield self.finding(
+                        ctx, n, "ad-hoc f-string spool path")
+
+
 RULES = [SpanNotContextManaged(), UndeclaredMetricName(),
-         AdHocObsEvent()]
+         AdHocObsEvent(), AdHocSpoolWrite()]

@@ -35,6 +35,12 @@ This package is that layer, in four stdlib-only pieces:
     bench-report` loads the `BENCH_*.json` series, prints a per-metric
     trend table, and exits non-zero when the latest round regresses
     past a declared threshold vs its same-backend predecessor.
+  * `attribution` — the critical-path report over the MERGED sweep
+    timeline (parent phases + per-worker spool tracks + device
+    windows): serial bottleneck decomposition, device-gap stall
+    accounting, and what-if headroom, persisted by `analyze-store
+    --report` as `<store>/report.json` + `report.md` and embedded in
+    the bench's north_star/cache_warm blocks.
 
 The whole package imports nothing but the stdlib (plus `gates` and
 `trace`, themselves stdlib-only); jax is never touched. Everything is
@@ -44,14 +50,14 @@ one `gates.get` per entry point.
 
 from __future__ import annotations
 
-from . import events
+from . import attribution, events
 from .events import EVENT_KINDS, emit, install_events, load_events, reset_events
 from .health import HealthSampler, health_snapshot, maybe_start_health_sampler
 from .prom import MetricsServer, maybe_start_metrics_server, render_prometheus
 
 __all__ = [
-    "EVENT_KINDS", "HealthSampler", "MetricsServer", "emit", "events",
-    "health_snapshot", "install_events", "load_events",
-    "maybe_start_health_sampler", "maybe_start_metrics_server",
-    "render_prometheus", "reset_events",
+    "EVENT_KINDS", "HealthSampler", "MetricsServer", "attribution",
+    "emit", "events", "health_snapshot", "install_events",
+    "load_events", "maybe_start_health_sampler",
+    "maybe_start_metrics_server", "render_prometheus", "reset_events",
 ]

@@ -40,6 +40,11 @@ class MetricSpec:
     path: tuple         # path into the parsed bench dict
     higher_is_better: bool
     tolerance: float    # allowed relative slack before "regressed"
+    # absolute ceiling (lower-is-better metrics only): the latest
+    # value exceeding it regresses even with no predecessor to
+    # compare against — "a stage silently regrowing past a declared
+    # share fails the gate"
+    ceiling: float | None = None
 
 
 #: The declared trajectory metrics and their regression thresholds.
@@ -78,6 +83,21 @@ METRICS: tuple[MetricSpec, ...] = (
     # boxes jitter, but a blowup past 2x the predecessor regresses
     MetricSpec("lint_wall", "lint wall secs",
                ("lint", "wall_secs"), False, 1.0),
+    # the critical-path decomposition (obs.attribution, embedded in
+    # the north_star block since the trace fabric): the device share
+    # should grow or hold as overlap improves, and the two host-stall
+    # shares must not silently regrow — each also carries an absolute
+    # ceiling, so a stage creeping past its declared share fails the
+    # gate even on the first round that reports it
+    MetricSpec("ns_device_share", "north-star device share",
+               ("north_star", "attribution", "shares", "device"),
+               True, 0.30),
+    MetricSpec("ns_parse_share", "north-star parse-stall share",
+               ("north_star", "attribution", "shares", "parse"),
+               False, 0.25, ceiling=0.95),
+    MetricSpec("warm_idle_share", "warm-sweep idle share",
+               ("north_star", "cache_warm", "attribution", "shares",
+                "idle"), False, 0.30, ceiling=0.90),
 )
 
 
@@ -163,6 +183,18 @@ def report(paths, out=print) -> int:
             groups.setdefault(backend, []).append((name, v))
         notes = []
         for backend, vals in groups.items():
+            # the absolute ceiling applies to each group's LATEST
+            # value, predecessor or not — a newly-reported share
+            # already past its declared bound must not ride in free
+            if spec.ceiling is not None and vals:
+                c_name, c_last = vals[-1]
+                if c_last > spec.ceiling:
+                    notes.append(f"[{backend} {c_last:g} > ceiling "
+                                 f"{spec.ceiling:g}] REGRESSED")
+                    regressions.append(
+                        f"{spec.label} ({backend}): {c_last:g} "
+                        f"({c_name}) exceeds the declared ceiling "
+                        f"{spec.ceiling:g}")
             if len(vals) < 2:
                 continue
             (p_name, prev), (l_name, last) = vals[-2], vals[-1]

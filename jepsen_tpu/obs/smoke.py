@@ -1,14 +1,18 @@
 """`python -m jepsen_tpu.obs.smoke` — the one-command live-telemetry
 smoke behind `make obs-smoke`.
 
-Builds a tiny throwaway store, runs a real `analyze-store` sweep with
-the health sampler and the `/metrics` endpoint force-enabled (interval
-0.2 s, ephemeral port), scrapes `/metrics` and `/healthz` once
-mid-flight via a hook, and asserts the contract the acceptance
-criteria pin: health.json snapshots exist and parse, the scraped
-counters match the final metrics.json, and the flight recorder holds
-the sweep's start/end events. Exit 0 on success, 1 with a reason on
-any violation. CPU-only, a few seconds end to end.
+Builds a tiny throwaway store, runs a real POOLED `analyze-store`
+sweep (JEPSEN_TPU_PIPELINE=1 forces the worker pool even on 1-core
+boxes) with the health sampler, the `/metrics` endpoint and the
+attribution report force-enabled (interval 0.2 s, ephemeral port),
+scrapes `/metrics` and `/healthz` once mid-flight via a hook, and
+asserts the contract the acceptance criteria pin: health.json
+snapshots exist and parse, the scraped counters match the final
+metrics.json, the flight recorder holds the sweep's start/end events,
+the merged trace.json carries at least one worker-process track with
+encode spans, and report.json exists with stage shares summing to
+~1.0. Exit 0 on success, 1 with a reason on any violation. CPU-only,
+a few seconds end to end.
 """
 
 from __future__ import annotations
@@ -30,6 +34,9 @@ def main() -> int:
 
     gates.export("JEPSEN_TPU_HEALTH_INTERVAL_S", 0.2)
     gates.export("JEPSEN_TPU_METRICS_PORT", 0)    # ephemeral
+    # a REAL pooled sweep, even on a 1-core box: the trace-fabric
+    # assertions below need actual worker processes spooling spans
+    gates.export("JEPSEN_TPU_PIPELINE", 1)
 
     root = Path(tempfile.mkdtemp(prefix="obs-smoke-"))
     try:
@@ -55,7 +62,7 @@ def main() -> int:
                 scraped["healthz"] = json.loads(r.read().decode())
 
         rc = cli.analyze_store(store, checker="append",
-                               obs_hook=on_obs_up)
+                               obs_hook=on_obs_up, report=True)
         if rc != 0:
             print(f"obs-smoke: sweep failed rc={rc}")
             return 1
@@ -101,10 +108,44 @@ def main() -> int:
         if "sweep_start" not in evs or "sweep_end" not in evs:
             print(f"obs-smoke: flight recorder incomplete: {evs}")
             return 1
+        # -- trace fabric + attribution report contract ---------------
+        if not trace.iter_spools(store.base):
+            print("obs-smoke: pooled sweep left no worker trace "
+                  "spools")
+            return 1
+        tj = json.loads((store.base / "trace.json").read_text())
+        worker_pids = {e["pid"] for e in tj["traceEvents"]
+                       if e.get("ph") == "M"
+                       and e.get("name") == "process_name"
+                       and "worker" in str(e["args"].get("name", ""))}
+        if not worker_pids:
+            print("obs-smoke: merged trace has no worker-process "
+                  "track")
+            return 1
+        if not any(e.get("ph") == "X" and e.get("name") == "encode"
+                   and e.get("pid") in worker_pids
+                   for e in tj["traceEvents"]):
+            print("obs-smoke: no encode span on any worker track")
+            return 1
+        rep = json.loads((store.base / "report.json").read_text())
+        share_sum = sum(rep["shares"].values())
+        if abs(share_sum - 1.0) > 0.02:
+            print(f"obs-smoke: report shares sum to {share_sum:.4f}, "
+                  "not 1.0 +/- 0.02")
+            return 1
+        if not (store.base / "report.md").is_file():
+            print("obs-smoke: report.md missing")
+            return 1
+        if final["counters"].get("worker_spans", 0) < 1:
+            print("obs-smoke: worker_spans digest never reached the "
+                  "parent tracer")
+            return 1
         print("obs-smoke: OK — health.json "
               f"(seq {health['heartbeat']['seq']}), /metrics scraped "
               f"({len(scraped['metrics'].splitlines())} lines), "
-              f"{len(evs)} flight-recorder events")
+              f"{len(evs)} flight-recorder events, "
+              f"{len(worker_pids)} worker track(s), report bound="
+              f"{rep.get('bound')}")
         return 0
     finally:
         trace.reset()

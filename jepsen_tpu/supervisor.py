@@ -286,18 +286,30 @@ def maybe_inject_encode_fault(run_dir) -> None:
     """The encode-side nemesis hook (called at the top of
     `ingest.encode_run_dir`): raises InjectedFault, or SIGKILLs the
     current POOL WORKER for kill-mode (in the parent, kill degrades to
-    a raise — the nemesis must never kill the sweep itself)."""
+    a raise — the nemesis must never kill the sweep itself). Each
+    injection leaves an instant mark on the CURRENT tracer — in a
+    pool worker that is the worker's own tracer, so the merged sweep
+    trace shows the fault on the process it actually hit; kill-mode
+    flushes the worker spool first, because a SIGKILLed process gets
+    no second chance to write its own post-mortem."""
     inj = _get_injector()
     if inj is None:
         return
     name = os.path.basename(str(run_dir).rstrip("/"))
     if inj.selects("kill", name):
+        from . import trace
+        trace.get_current().instant("fault_inject", kind="kill",
+                                    run=name)
         if _in_pool_worker():
+            trace.flush_worker_spool()
             import signal
             os.kill(os.getpid(), signal.SIGKILL)
         raise InjectedFault(f"injected worker kill for {name!r} "
                             "(parent process: degraded to encode fault)")
     if inj.selects("encode", name):
+        from . import trace
+        trace.get_current().instant("fault_inject", kind="encode",
+                                    run=name)
         raise InjectedFault(f"injected encode fault for {name!r}")
 
 

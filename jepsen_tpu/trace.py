@@ -19,11 +19,24 @@ This module makes every run self-attributing:
     wraps a run in a real `jax.profiler` capture behind
     `JEPSEN_TPU_JAX_PROFILE=1`.
 
+Since the trace fabric (ISSUE 10) the tracer is also CROSS-PROCESS:
+ingest pool workers get their own `Tracer` seeded with the parent's
+trace id plus a monotonic clock handshake (`worker_ctx` /
+`ensure_worker_tracer`), spool their spans to a per-worker
+`trace-<pid>.jsonl` in the store (flushed per encode task, torn tails
+skipped on load exactly like the VerdictJournal), and ship a compact
+digest back through the existing einfo descriptor path.
+`merge_traces` folds the spools into one Chrome trace whose events
+carry each contributing process's REAL pid — one process track per
+worker, Perfetto-ready — and the attribution report
+(jepsen_tpu/obs/attribution.py) walks that merged timeline.
+
 `JEPSEN_TPU_TRACE=0` (or `--no-trace`) swaps in the `NullTracer`:
-no file is written and a disabled span costs well under a microsecond
-— the dp8-efficiency floor is unaffected. The module imports nothing
-but the stdlib (plus the stdlib-only `gates` registry); `jax` is
-touched only inside an explicitly enabled profiler session.
+no file is written (no worker spool files either) and a disabled span
+costs well under a microsecond — the dp8-efficiency floor is
+unaffected. The module imports nothing but the stdlib (plus the
+stdlib-only `gates` registry); `jax` is touched only inside an
+explicitly enabled profiler session.
 """
 
 from __future__ import annotations
@@ -34,6 +47,7 @@ import math
 import os
 import threading
 import time
+import uuid
 from pathlib import Path
 
 from . import gates
@@ -54,6 +68,7 @@ DECLARED_METRICS: dict[str, frozenset] = {
         "quarantined", "runs_verdicted", "shm_bytes",
         "shm_stale_reclaimed", "sidecar_upgrades", "split.native",
         "split.python", "warm_copy_bytes", "watchdog_timeouts",
+        "worker_spans",
     }),
     "gauges": frozenset({"donate_slots_inflight", "inflight_depth",
                          "reorder_depth", "runs_total"}),
@@ -62,8 +77,9 @@ DECLARED_METRICS: dict[str, frozenset] = {
 
 #: Sanctioned dynamic-name families: an f-string metric name must
 #: start with one of these (`phase.<key>`, `device.<kernel>`,
-#: `native_fallback.<component>`).
-METRIC_PREFIXES = ("phase.", "device.", "native_fallback.")
+#: `native_fallback.<component>`, `worker.<stage>` — the per-task
+#: stage-seconds digests ingest relays from pool workers).
+METRIC_PREFIXES = ("phase.", "device.", "native_fallback.", "worker.")
 
 #: Synthetic tid for the device track (real thread idents are pthread
 #: addresses, nowhere near this; named tracks count down from here).
@@ -221,9 +237,15 @@ class NullTracer:
     enabled = False
     run = None
     scope = "run"
+    trace_id = None
+    spool_dir = None
+    pid = None
 
     def span(self, name: str, **args):
         return _NULL_CM
+
+    def rel_us(self, t_perf: float) -> float:
+        return 0.0
 
     def phase(self, key: str, t0: float) -> float:
         return time.perf_counter() - t0
@@ -252,6 +274,9 @@ class NullTracer:
     def export(self, path) -> None:
         return None
 
+    def export_merged(self, path, spool_dir=None) -> None:
+        return None
+
     def export_metrics(self, path) -> None:
         return None
 
@@ -272,6 +297,20 @@ class Tracer:
         # whole sweep's events, re-serialized O(runs) times) — the
         # sweep owner exports once at the end.
         self.scope = scope
+        # The RECORDING process's pid, captured at construction — the
+        # Chrome export stamps events with this, never with the
+        # exporter's os.getpid() at export time (a tracer exported
+        # post-fork, or folded into another process's merge, must keep
+        # attributing its events to the process that recorded them).
+        self.pid = os.getpid()
+        # Sweep-unique id: worker spools record it, and merge_traces
+        # folds only spools carrying THIS id (a stale spool from a
+        # previous sweep in the same store never contaminates).
+        self.trace_id = uuid.uuid4().hex[:16]
+        # Where pool workers spool their spans (trace-<pid>.jsonl);
+        # None = workers don't spool. The sweep owner (analyze-store)
+        # points this at the store base.
+        self.spool_dir = None
         # Bounded event buffer: a day-long soak (or an embedded caller
         # that never rotates the tracer) must not OOM the process it
         # observes — 200k events is ~50MB retained worst case and far
@@ -453,11 +492,28 @@ class Tracer:
 
     # -- export -----------------------------------------------------------
 
+    def rel_us(self, t_perf: float) -> float:
+        """A perf_counter time as µs on this tracer's export timeline
+        — the public window-conversion callers (bench attribution)
+        use instead of reaching into `_origin`."""
+        return (t_perf - self._origin) * 1e6
+
+    def origin_mono(self) -> float:
+        """This tracer's ts=0 expressed in CLOCK_MONOTONIC seconds —
+        the reference point worker spools (recorded with
+        time.monotonic, which is system-wide on Linux) align to."""
+        return self._origin - self._mono_off
+
     def chrome_events(self) -> list[dict]:
         """The Chrome trace-event list: one metadata-named track per
         recording thread plus the synthetic device/external tracks;
-        every timed event is a complete ("X") event, sorted by ts."""
-        pid = os.getpid()
+        every timed event is a complete ("X") event, sorted by ts.
+        Metadata and events carry the RECORDING process's pid
+        (`self.pid`), and an event that already carries an explicit
+        "pid" (a foreign-process event folded in) keeps it — the
+        multi-process merge depends on per-event pids never being
+        overwritten with the exporter's."""
+        pid = self.pid
         ev: list[dict] = [{
             "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
             "args": {"name": self.run or "jepsen-tpu"}}]
@@ -467,7 +523,7 @@ class Tracer:
         for tname, tid in sorted(self._tracks.items()):
             ev.append({"name": "thread_name", "ph": "M", "pid": pid,
                        "tid": tid, "args": {"name": tname}})
-        ev.extend({**e, "pid": pid}
+        ev.extend({**e, "pid": e.get("pid", pid)}
                   for e in sorted(list(self._events),
                                   key=lambda e: e["ts"]))
         return ev
@@ -478,6 +534,16 @@ class Tracer:
         return atomic_write_text(
             path, json.dumps({"traceEvents": self.chrome_events(),
                               "displayTimeUnit": "ms"}))
+
+    def export_merged(self, path, spool_dir=None) -> Path:
+        """`export`, but with every matching worker spool under
+        `spool_dir` (default: this tracer's spool_dir) folded in as
+        its own per-process pid track (`merge_traces`). Falls back to
+        a plain export when there is nothing to merge."""
+        return atomic_write_text(
+            path, json.dumps({
+                "traceEvents": merge_traces(self, spool_dir),
+                "displayTimeUnit": "ms"}))
 
     def metrics_dict(self) -> dict:
         with _MLOCK:
@@ -565,6 +631,318 @@ def gauge(name: str):
 
 def histogram(name: str):
     return get_current().histogram(name)
+
+
+# ---------------------------------------------------------------------------
+# The cross-process trace fabric: per-worker span spools + merge.
+#
+# Pool workers are separate (spawned) processes: their tracers were
+# process-local and silently discarded, so every worker-side second of
+# a pooled sweep was invisible to trace.json — only counters crossed
+# the pipe. Now the parent hands each worker a tiny context
+# (`worker_ctx`: trace id + spool dir + a monotonic send stamp); the
+# worker installs its own Tracer (`ensure_worker_tracer`), records
+# spans normally, and `flush_worker_spool` (called per encode task)
+# appends them to `<spool_dir>/trace-<pid>.jsonl` — one JSON line per
+# event, flushed as written, torn tails skipped on load — and returns
+# a compact digest the parent folds into its own metrics. Timestamps
+# are raw CLOCK_MONOTONIC seconds: monotonic is system-wide on Linux,
+# so `merge_traces` aligns them against the parent tracer's
+# `origin_mono()` with no cross-clock arithmetic; the send/recv
+# handshake recorded in the spool's meta line bounds the residual
+# alignment error (it can only be scheduling latency, not clock skew).
+# ---------------------------------------------------------------------------
+
+def merge_intervals(spans):
+    """Sorted union of (start, end) wall-clock pairs — THE interval
+    merge shared by `ingest.overlap_seconds` (the measured-overlap
+    contract) and the attribution report's stage unions, so the two
+    can never disagree about the same timeline."""
+    out: list = []
+    for s, e in sorted(spans):
+        if out and s <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], e))
+        else:
+            out.append((s, e))
+    return out
+
+
+def overlap_seconds(spans_a, spans_b) -> float:
+    """Total seconds where some span in `a` intersects some span in
+    `b` (both lists of (start, end) pairs). Each side is merged first
+    so double-counting can't inflate the number."""
+    if not spans_a or not spans_b:
+        return 0.0
+    total, bi = 0.0, 0
+    b = merge_intervals(spans_b)
+    for s, e in merge_intervals(spans_a):
+        while bi < len(b) and b[bi][1] <= s:
+            bi += 1
+        j = bi
+        while j < len(b) and b[j][0] < e:
+            total += max(0.0, min(e, b[j][1]) - max(s, b[j][0]))
+            j += 1
+    return total
+
+
+#: Worker spool naming — this module is the ONLY place the convention
+#: exists (lint rule JT-TRACE-004 flags the literal anywhere else).
+SPOOL_PREFIX = "trace-"
+SPOOL_VERSION = 1
+
+
+def worker_trace_enabled() -> bool:
+    """The JEPSEN_TPU_WORKER_TRACE gate (default on; moot when
+    JEPSEN_TPU_TRACE=0 — no tracer, no spools)."""
+    return gates.get("JEPSEN_TPU_WORKER_TRACE")
+
+
+def spool_path(spool_dir, pid: int) -> Path:
+    return Path(spool_dir) / f"{SPOOL_PREFIX}{pid}.jsonl"
+
+
+def iter_spools(spool_dir):
+    """The worker spool files under a directory, sorted."""
+    return sorted(Path(spool_dir).glob(f"{SPOOL_PREFIX}*.jsonl"))
+
+
+def clean_spools(spool_dir) -> int:
+    """Remove stale worker spools (sweep start: spools are per-sweep
+    derived artifacts keyed by trace id; old ones only cost merge
+    filtering and disk). Returns the count removed."""
+    n = 0
+    try:
+        for p in iter_spools(spool_dir):
+            try:
+                p.unlink()
+                n += 1
+            except OSError:
+                pass
+    except OSError:
+        pass
+    return n
+
+
+def worker_ctx() -> dict | None:
+    """The context the parent hands each pool worker, or None when
+    workers should not spool (tracing off, worker tracing gated off,
+    or no spool dir registered on the current tracer) — None costs
+    the worker nothing (`ensure_worker_tracer` returns immediately)."""
+    t = get_current()
+    if not t.enabled or t.spool_dir is None \
+            or not worker_trace_enabled():
+        return None
+    return {"trace_id": t.trace_id, "dir": str(t.spool_dir),
+            "t_send": time.monotonic()}
+
+
+#: Worker-process spool state: {"f": file|None, "trace_id": str,
+#: "thr": set of tids whose names were already spooled, "tracer": T}.
+_wspool: dict | None = None
+
+
+def ensure_worker_tracer(tctx: dict | None) -> None:
+    """Install this worker process's spooling tracer (idempotent per
+    trace id). Called at the top of every pooled encode task; a None
+    context (or tracing disabled in the inherited env) is a no-op, so
+    the JEPSEN_TPU_TRACE=0 path creates no tracer and no file."""
+    global _wspool
+    if not tctx or not enabled():
+        # not spooling: in a POOL WORKER, park the NullTracer so the
+        # per-task spans don't accumulate in an enabled tracer's
+        # buffer nobody ever flushes or exports (up to _max_events
+        # retained per worker over a long sweep, pure waste). Only in
+        # a real child process — an in-process caller (tests, the
+        # serial path) must keep its own current tracer.
+        if _wspool is None:
+            import multiprocessing as mp
+            if mp.parent_process() is not None:
+                set_current(_NULL)
+        return
+    ws = _wspool
+    if ws is not None and ws["trace_id"] == tctx["trace_id"]:
+        set_current(ws["tracer"])
+        return
+    close_worker_spool()
+    tr = Tracer(run=f"ingest-worker-{os.getpid()}", scope="worker")
+    f = None
+    try:
+        p = spool_path(tctx["dir"], os.getpid())
+        f = open(p, "w")
+        f.write(json.dumps({
+            "k": "meta", "v": SPOOL_VERSION, "pid": os.getpid(),
+            "trace_id": tctx["trace_id"], "proc": "ingest-worker",
+            # the clock handshake: t_recv - t_send bounds the spawn/
+            # queue latency; on a shared CLOCK_MONOTONIC (Linux) the
+            # alignment error is zero and this is pure diagnostics
+            "t_send": tctx.get("t_send"),
+            "t_recv": time.monotonic()}) + "\n")
+        f.flush()
+    except OSError:
+        log.debug("worker spool open failed", exc_info=True)
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
+        f = None   # spans still feed the einfo digest
+    _wspool = {"f": f, "trace_id": tctx["trace_id"], "thr": set(),
+               "tracer": tr}
+    set_current(tr)
+
+
+def flush_worker_spool() -> dict | None:
+    """Spool every event recorded since the last flush (one JSON line
+    each, flushed — the torn-tail discipline) and return the compact
+    digest the parent aggregates: span count + per-name stage seconds.
+    The flushed events are dropped from the in-memory buffer, so a
+    long sweep's worker holds one task's events, not the sweep's."""
+    ws = _wspool
+    if ws is None:
+        return None
+    tr: Tracer = ws["tracer"]
+    evs = list(tr._events)
+    tr._events.clear()
+    om = tr.origin_mono()
+    stage: dict[str, float] = {}
+    lines: list[dict] = []
+    for tid, name in list(tr._threads.items()):
+        if tid not in ws["thr"]:
+            ws["thr"].add(tid)
+            lines.append({"k": "thr", "tid": tid, "name": name})
+    for name, tid in list(tr._tracks.items()):
+        if tid not in ws["thr"]:
+            ws["thr"].add(tid)
+            lines.append({"k": "thr", "tid": tid, "name": name})
+    spans = 0
+    for e in evs:
+        t0 = om + e["ts"] / 1e6
+        rec = {"k": "ev", "name": e["name"], "cat": e["cat"],
+               "ph": e["ph"], "tid": e["tid"], "t0": round(t0, 6)}
+        if e["ph"] == "X":
+            spans += 1
+            rec["t1"] = round(t0 + e["dur"] / 1e6, 6)
+            stage[e["name"]] = stage.get(e["name"], 0.0) \
+                + e["dur"] / 1e6
+        if e.get("args"):
+            rec["args"] = e["args"]
+        lines.append(rec)
+    if ws["f"] is not None and lines:
+        try:
+            ws["f"].write("".join(json.dumps(ln) + "\n"
+                                  for ln in lines))
+            ws["f"].flush()
+        except OSError:
+            log.debug("worker spool append failed", exc_info=True)
+    return {"spans": spans,
+            "stage_secs": {k: round(v, 6) for k, v in stage.items()}}
+
+
+def close_worker_spool() -> None:
+    """Drop the worker spool state (tests, or a worker re-seeded for a
+    different sweep)."""
+    global _wspool
+    ws = _wspool
+    _wspool = None
+    if ws is not None and ws["f"] is not None:
+        try:
+            ws["f"].close()
+        except OSError:
+            pass
+
+
+def load_spool(path):
+    """One spool file -> (meta | None, {tid: name}, [event dicts]).
+    Unparseable or incomplete lines — the crash-torn tail — are
+    skipped, mirroring VerdictJournal.load; a spool whose meta line
+    never landed returns meta None (the merge then ignores it)."""
+    meta = None
+    threads: dict[int, str] = {}
+    events: list[dict] = []
+    try:
+        lines = Path(path).read_text().splitlines()
+    except OSError:
+        return None, threads, events
+    for ln in lines:
+        ln = ln.strip()
+        if not ln:
+            continue
+        try:
+            rec = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if not isinstance(rec, dict):
+            continue
+        k = rec.get("k")
+        if k == "meta" and meta is None:
+            if "pid" in rec and "trace_id" in rec:
+                meta = rec
+        elif k == "thr":
+            try:
+                threads[int(rec["tid"])] = str(rec["name"])
+            except (KeyError, TypeError, ValueError):
+                continue
+        elif k == "ev":
+            if "name" in rec and "t0" in rec \
+                    and isinstance(rec["t0"], (int, float)):
+                events.append(rec)
+    return meta, threads, events
+
+
+def merge_traces(tracer, spool_dir=None) -> list[dict]:
+    """The merged Chrome trace-event list: the parent tracer's own
+    events plus every worker spool under `spool_dir` (default: the
+    tracer's registered spool_dir) whose trace id matches — each
+    worker becomes its own REAL-pid process track with process/thread
+    name metadata, and its monotonic timestamps align to the parent's
+    timeline via `origin_mono()` (clamped at 0: a span that somehow
+    predates the parent origin must not produce a negative ts Chrome
+    renders at the epoch). Metadata events lead, timed events follow
+    sorted by ts — the same golden shape as a single-process export."""
+    if not getattr(tracer, "enabled", False):
+        return []
+    evs = tracer.chrome_events()
+    d = spool_dir if spool_dir is not None \
+        else getattr(tracer, "spool_dir", None)
+    if d is None:
+        return evs
+    meta_evs = [e for e in evs if e["ph"] == "M"]
+    x_evs = [e for e in evs if e["ph"] != "M"]
+    om = tracer.origin_mono()
+    try:
+        spools = iter_spools(d)
+    except OSError:
+        spools = []
+    for p in spools:
+        meta, threads, wevents = load_spool(p)
+        if meta is None or meta.get("trace_id") != tracer.trace_id:
+            continue
+        try:
+            pid = int(meta["pid"])
+        except (TypeError, ValueError):
+            continue
+        meta_evs.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": f"{meta.get('proc', 'worker')} {pid}"}})
+        for tid, name in sorted(threads.items()):
+            meta_evs.append({"name": "thread_name", "ph": "M",
+                             "pid": pid, "tid": tid,
+                             "args": {"name": name}})
+        for w in wevents:
+            ts = max(0.0, (float(w["t0"]) - om) * 1e6)
+            e = {"name": w["name"], "cat": w.get("cat", "span"),
+                 "ph": w.get("ph", "X"), "pid": pid,
+                 "tid": w.get("tid", 0), "ts": ts}
+            if e["ph"] == "X":
+                t1 = float(w.get("t1", w["t0"]))
+                e["dur"] = max(0.0, (t1 - float(w["t0"])) * 1e6)
+            else:
+                e["s"] = "t"
+            if w.get("args"):
+                e["args"] = w["args"]
+            x_evs.append(e)
+    x_evs.sort(key=lambda e: e["ts"])
+    return meta_evs + x_evs
 
 
 # ---------------------------------------------------------------------------

@@ -30,3 +30,19 @@ def typoed_event_kind():
 def imported_emit_typo():
     from jepsen_tpu.obs.events import emit
     emit("quarantene", cause="boom")                      # EXPECT: JT-TRACE-003
+
+
+def adhoc_spool_write(store, pid):
+    return open(store / "trace-1234.jsonl", "a")          # EXPECT: JT-TRACE-004
+
+
+def adhoc_spool_glob(store):
+    return store.glob("trace-*.jsonl")                    # EXPECT: JT-TRACE-004
+
+
+def adhoc_spool_fstring(store, pid):
+    return store / f"trace-{pid}.jsonl"                   # EXPECT: JT-TRACE-004
+
+
+def adhoc_spool_fstring_dir(store, pid):
+    return open(f"{store}/trace-{pid}.jsonl", "w")        # EXPECT: JT-TRACE-004

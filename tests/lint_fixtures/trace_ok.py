@@ -23,3 +23,22 @@ def declared_metrics(component):
     trace.gauge("inflight_depth").set(2)
     trace.histogram("bucket_cells").observe(1024)
     trace.counter(f"native_fallback.{component}").inc()
+    trace.histogram(f"worker.{component}").observe(0.5)
+
+
+def spools_via_api(tracer, store):
+    # the sanctioned spool surface: naming stays inside trace.py
+    trace.clean_spools(store)
+    return trace.merge_traces(tracer, store)
+
+
+def unrelated_jsonl(store):
+    # plain .jsonl artifacts (journal, events) are not spools
+    return open(store / "verdicts.jsonl", "a")
+
+
+def unrelated_fstring_jsonl(store, name):
+    # interpolated .jsonl paths without the spool prefix are fine,
+    # as is a component merely CONTAINING "trace-"
+    open(f"{store}/shard-{name}.jsonl", "a")
+    return f"{store}/backtrace-{name}.jsonl"

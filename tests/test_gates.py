@@ -26,6 +26,8 @@ REPO = Path(__file__).resolve().parents[1]
 ALL_GATES = [
     "JEPSEN_TPU_TRACE",
     "JEPSEN_TPU_TRACE_MAX_EVENTS",
+    "JEPSEN_TPU_WORKER_TRACE",
+    "JEPSEN_TPU_REPORT",
     "JEPSEN_TPU_JAX_PROFILE",
     "JEPSEN_TPU_HEALTH_INTERVAL_S",
     "JEPSEN_TPU_METRICS_PORT",

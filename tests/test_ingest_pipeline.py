@@ -260,7 +260,7 @@ class TestPipeline:
                 self.task = task
 
             def result(self):
-                idx, _d, checker, name = self.task
+                idx, _d, checker, name, _tctx = self.task
                 if self.k >= delivered:
                     raise RuntimeError("pool died mid-stream")
                 if name is not None and self.k == delivered - 1:

@@ -278,7 +278,7 @@ GOLDEN_RULE_IDS = [
     "JT-SHM-001",
     "JT-TENSOR-001", "JT-TENSOR-002", "JT-TENSOR-003", "JT-TENSOR-004",
     "JT-THREAD-001", "JT-THREAD-002", "JT-THREAD-003", "JT-THREAD-004",
-    "JT-TRACE-001", "JT-TRACE-002", "JT-TRACE-003",
+    "JT-TRACE-001", "JT-TRACE-002", "JT-TRACE-003", "JT-TRACE-004",
 ]
 
 

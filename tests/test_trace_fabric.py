@@ -1,0 +1,396 @@
+"""The cross-process trace fabric + attribution report (ISSUE 10):
+merged multi-process Chrome traces (two fake worker spools + parent),
+clock-offset alignment bounds, torn spool tails skipped, the
+worker-side spool API roundtrip, a pinned attribution decomposition
+on a synthetic timeline where the answer is known exactly,
+single-process busy↔phases parity, and the pooled analyze-store
+integration (worker tracks + report.json) with its gate-off twins."""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+from jepsen_tpu import parallel, trace
+from jepsen_tpu.checker.elle import encode as elle_encode
+from jepsen_tpu.checker.elle.synth import synth_append_history
+from jepsen_tpu.obs import attribution
+
+
+@pytest.fixture(autouse=True)
+def _fresh_trace():
+    trace.reset()
+    trace.close_worker_spool()
+    yield
+    trace.close_worker_spool()
+    trace.reset()
+
+
+def make_encs(n=3, T=60):
+    return [elle_encode.encode_history(
+        synth_append_history(T=T + 30 * i, K=6, seed=i))
+        for i in range(n)]
+
+
+def _write_spool(spool_dir, pid, trace_id, events, proc="ingest-worker",
+                 threads=None, t_send=None, t_recv=None,
+                 torn_tail=False):
+    """A fake worker spool in the documented line format (this IS a
+    format-stability test: trace.load_spool must keep reading it)."""
+    p = trace.spool_path(spool_dir, pid)
+    lines = [{"k": "meta", "v": trace.SPOOL_VERSION, "pid": pid,
+              "trace_id": trace_id, "proc": proc,
+              "t_send": t_send, "t_recv": t_recv}]
+    for tid, name in (threads or {}).items():
+        lines.append({"k": "thr", "tid": tid, "name": name})
+    lines.extend(events)
+    text = "".join(json.dumps(ln) + "\n" for ln in lines)
+    if torn_tail:
+        text += '{"k": "ev", "name": "torn", "cat": "span", "ph": "X'
+    p.write_text(text)
+    return p
+
+
+def _ev(name, t0, t1, tid=1, cat="span"):
+    return {"k": "ev", "name": name, "cat": cat, "ph": "X",
+            "tid": tid, "t0": t0, "t1": t1}
+
+
+# ---------------------------------------------------------------------------
+# Merged multi-process export
+# ---------------------------------------------------------------------------
+
+def _validate_chrome_events(evs):
+    assert evs
+    last_ts = None
+    for e in evs:
+        assert "pid" in e, e
+        if e["ph"] == "M":
+            assert "name" in e["args"]
+            continue
+        assert e["ph"] in ("X", "i")
+        assert e["ts"] >= 0
+        if last_ts is not None:
+            assert e["ts"] >= last_ts, "events must be ts-sorted"
+        last_ts = e["ts"]
+
+
+def test_merged_trace_golden_shape(tmp_path):
+    tr = trace.fresh_run("merge-golden")
+    with tr.span("parent-span"):
+        time.sleep(0.001)
+    om = tr.origin_mono()
+    _write_spool(tmp_path, 70001, tr.trace_id,
+                 [_ev("encode", om + 0.010, om + 0.020)],
+                 threads={1: "MainThread"})
+    _write_spool(tmp_path, 70002, tr.trace_id,
+                 [_ev("encode", om + 0.015, om + 0.030),
+                  _ev("cache_probe", om + 0.015, om + 0.016)],
+                 threads={1: "MainThread"})
+    evs = trace.merge_traces(tr, tmp_path)
+    _validate_chrome_events(evs)
+    pids = {e["pid"] for e in evs}
+    assert {tr.pid, 70001, 70002} <= pids
+    procs = {e["args"]["name"] for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert "ingest-worker 70001" in procs
+    assert "ingest-worker 70002" in procs
+    # thread-name metadata per process, not just the exporter's
+    thr_pids = {e["pid"] for e in evs
+                if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert {70001, 70002} <= thr_pids
+    # worker encode spans land with their own pid
+    enc = [e for e in evs if e["ph"] == "X" and e["name"] == "encode"]
+    assert {e["pid"] for e in enc} == {70001, 70002}
+    # export_merged writes the same thing, atomically
+    p = tr.export_merged(tmp_path / "trace.json", tmp_path)
+    obj = json.loads(p.read_text())
+    assert obj["traceEvents"] == evs
+
+
+def test_clock_offset_alignment_bounds(tmp_path):
+    """Spool timestamps are CLOCK_MONOTONIC; merge aligns them to the
+    parent origin exactly, clamping anything that predates it."""
+    tr = trace.fresh_run("align")
+    om = tr.origin_mono()
+    _write_spool(tmp_path, 70010, tr.trace_id,
+                 [_ev("encode", om + 0.500, om + 0.750),
+                  _ev("early", om - 1.0, om - 0.5)],
+                 t_send=om + 0.1, t_recv=om + 0.1004)
+    evs = trace.merge_traces(tr, tmp_path)
+    by_name = {e["name"]: e for e in evs if e["ph"] == "X"}
+    assert by_name["encode"]["ts"] == pytest.approx(500_000, abs=1)
+    assert by_name["encode"]["dur"] == pytest.approx(250_000, abs=1)
+    # a span predating the parent origin clamps to ts 0, never
+    # negative (Chrome would render it at the epoch)
+    assert by_name["early"]["ts"] == 0.0
+    meta, _thr, _evs = trace.load_spool(
+        trace.spool_path(tmp_path, 70010))
+    # the handshake bound: recv - send is latency, not clock skew
+    assert 0 <= meta["t_recv"] - meta["t_send"] < 1.0
+
+
+def test_merge_skips_foreign_and_torn(tmp_path):
+    tr = trace.fresh_run("torn")
+    om = tr.origin_mono()
+    # a stale spool from some other sweep: filtered by trace id
+    _write_spool(tmp_path, 70020, "deadbeefdeadbeef",
+                 [_ev("encode", om, om + 1)])
+    # a crash-torn spool: complete lines survive, the tail is skipped
+    _write_spool(tmp_path, 70021, tr.trace_id,
+                 [_ev("encode", om + 0.001, om + 0.002)],
+                 torn_tail=True)
+    evs = trace.merge_traces(tr, tmp_path)
+    assert 70020 not in {e["pid"] for e in evs}
+    worker_x = [e for e in evs if e["ph"] == "X"
+                and e["pid"] == 70021]
+    assert [e["name"] for e in worker_x] == ["encode"]
+
+
+def test_export_pid_is_recorder_not_exporter(tmp_path):
+    """Satellite: events and metadata carry the RECORDING process's
+    pid — exporting a tracer must not restamp with os.getpid()."""
+    tr = trace.fresh_run("pids")
+    with tr.span("s"):
+        pass
+    tr.pid = 4242   # simulate a tracer recorded in another process
+    evs = tr.chrome_events()
+    assert {e["pid"] for e in evs} == {4242}
+
+
+# ---------------------------------------------------------------------------
+# The worker-side spool API
+# ---------------------------------------------------------------------------
+
+def test_worker_spool_roundtrip(tmp_path, monkeypatch):
+    import os
+    parent = trace.fresh_run("parent")
+    parent.spool_dir = tmp_path
+    tctx = trace.worker_ctx()
+    assert tctx is not None and tctx["trace_id"] == parent.trace_id
+    # the worker side (same process here; the API is process-agnostic)
+    trace.ensure_worker_tracer(tctx)
+    wtr = trace.get_current()
+    assert wtr is not parent and wtr.scope == "worker"
+    with trace.span("encode", run="r1"):
+        with trace.span("load_history"):
+            time.sleep(0.001)
+    digest = trace.flush_worker_spool()
+    assert digest["spans"] == 2
+    assert digest["stage_secs"]["encode"] >= \
+        digest["stage_secs"]["load_history"] > 0
+    # idempotent re-seed with the same trace id keeps the tracer
+    trace.ensure_worker_tracer(tctx)
+    assert trace.get_current() is wtr
+    # the spool parses back: meta + thread names + both events
+    meta, threads, evs = trace.load_spool(
+        trace.spool_path(tmp_path, os.getpid()))
+    assert meta["trace_id"] == parent.trace_id
+    assert meta["pid"] == os.getpid()
+    assert threads and [e["name"] for e in evs] == ["load_history",
+                                                    "encode"]
+    # a second flush with nothing new spools nothing new
+    assert trace.flush_worker_spool()["spans"] == 0
+    trace.close_worker_spool()
+    # and the parent can fold it in
+    trace.set_current(parent)
+    evs = trace.merge_traces(parent, tmp_path)
+    assert any(e.get("name") == "encode" and e["ph"] == "X"
+               for e in evs)
+
+
+def test_worker_ctx_none_when_disabled(tmp_path, monkeypatch):
+    # no spool dir registered -> no fabric
+    trace.fresh_run("nodir")
+    assert trace.worker_ctx() is None
+    # worker-trace gate off -> no fabric
+    tr = trace.fresh_run("gated")
+    tr.spool_dir = tmp_path
+    monkeypatch.setenv("JEPSEN_TPU_WORKER_TRACE", "0")
+    assert trace.worker_ctx() is None
+    monkeypatch.delenv("JEPSEN_TPU_WORKER_TRACE")
+    assert trace.worker_ctx() is not None
+    # tracing off entirely -> no fabric, and the worker side is a
+    # no-op that creates no file
+    monkeypatch.setenv("JEPSEN_TPU_TRACE", "0")
+    trace.reset()
+    assert trace.worker_ctx() is None
+    trace.ensure_worker_tracer({"trace_id": "x", "dir": str(tmp_path),
+                                "t_send": 0.0})
+    assert trace.flush_worker_spool() is None
+    assert trace.iter_spools(tmp_path) == []
+
+
+def test_clean_spools(tmp_path):
+    tr = trace.fresh_run("clean")
+    _write_spool(tmp_path, 70030, tr.trace_id, [])
+    _write_spool(tmp_path, 70031, tr.trace_id, [])
+    (tmp_path / "unrelated.jsonl").write_text("{}\n")
+    assert trace.clean_spools(tmp_path) == 2
+    assert trace.iter_spools(tmp_path) == []
+    assert (tmp_path / "unrelated.jsonl").exists()
+
+
+# ---------------------------------------------------------------------------
+# Attribution: pinned decomposition on a synthetic timeline
+# ---------------------------------------------------------------------------
+
+def _synthetic_events():
+    """10 s window, exact answer: parse [0,2], worker encode [0.5,1.5]
+    (charged over parse), device [2,5], collect [4,6] (charged only
+    where the device is idle), render [6,6.5], idle elsewhere."""
+    s = 1e6
+    return [
+        {"name": "process_name", "ph": "M", "pid": 1000, "tid": 0,
+         "args": {"name": "analyze-store:append"}},
+        {"name": "process_name", "ph": "M", "pid": 7001, "tid": 0,
+         "args": {"name": "ingest-worker 7001"}},
+        {"name": "parse", "cat": "phase", "ph": "X", "pid": 1000,
+         "tid": 1, "ts": 0.0, "dur": 2.0 * s},
+        {"name": "encode", "cat": "span", "ph": "X", "pid": 7001,
+         "tid": 1, "ts": 0.5 * s, "dur": 1.0 * s},
+        {"name": "bucket", "cat": "device", "ph": "X", "pid": 1000,
+         "tid": 99, "ts": 2.0 * s, "dur": 3.0 * s},
+        {"name": "collect", "cat": "phase", "ph": "X", "pid": 1000,
+         "tid": 1, "ts": 4.0 * s, "dur": 2.0 * s},
+        {"name": "render", "cat": "phase", "ph": "X", "pid": 1000,
+         "tid": 1, "ts": 6.0 * s, "dur": 0.5 * s},
+    ]
+
+
+def test_attribution_pinned_decomposition():
+    rep = attribution.analyze(_synthetic_events(),
+                              window_us=(0.0, 7.0e6))
+    assert rep["wall_secs"] == pytest.approx(7.0)
+    sh = rep["shares"]
+    assert sh["device"] == pytest.approx(3.0 / 7, abs=1e-3)
+    assert sh["encode"] == pytest.approx(1.0 / 7, abs=1e-3)
+    assert sh["parse"] == pytest.approx(1.0 / 7, abs=1e-3)
+    assert sh["collect"] == pytest.approx(1.0 / 7, abs=1e-3)
+    assert sh["render"] == pytest.approx(0.5 / 7, abs=1e-3)
+    assert sh["idle"] == pytest.approx(0.5 / 7, abs=1e-3)
+    assert sum(sh.values()) == pytest.approx(1.0, abs=0.02)
+    # busy unions are presence, not charge: parse's full 2 s
+    assert rep["busy_secs"]["parse"] == pytest.approx(2.0)
+    assert rep["busy_secs"]["collect"] == pytest.approx(2.0)
+    # bound + what-if: device is the longest stage
+    assert rep["bound"] == "device"
+    assert rep["ideal_wall_secs"] == pytest.approx(3.0)
+    assert rep["headroom_secs"] == pytest.approx(4.0)
+    # stall accounting: one gap (0 -> first dispatch), ingest-starved
+    st = rep["stalls"]
+    assert st["dispatches"] == 1 and st["gaps"] == 1
+    assert st["ingest_starved_secs"] == pytest.approx(2.0)
+    assert st["device_busy_secs"] == pytest.approx(3.0)
+    assert rep["workers"] == 1
+
+
+def test_attribution_report_files(tmp_path):
+    jp, mp = attribution.write_report(
+        tmp_path, _synthetic_events(),
+        metrics={"counters": {"runs_verdicted": 3}},
+        window_us=(0.0, 7.0e6))
+    rep = json.loads(jp.read_text())
+    assert rep["v"] == 1 and rep["bound"] == "device"
+    assert rep["counters"]["runs_verdicted"] == 3
+    md = mp.read_text()
+    assert "device-bound" in md and "| parse |" in md
+
+
+def test_attribution_empty_timeline():
+    rep = attribution.analyze([])
+    assert rep["wall_secs"] == 0.0 and rep["bound"] is None
+
+
+def test_attribution_single_process_parity():
+    """Acceptance: on a single-process sweep the un-prioritized busy
+    unions equal the tracer-derived `phases` dict (nothing overlaps,
+    so presence == the phase totals)."""
+    tr = trace.fresh_run("parity")
+    encs = make_encs()
+    phases: dict = {}
+    pv = parallel.check_bucketed_async(encs, phases=phases)
+    pv.result(phases)
+    rep = attribution.analyze(tr.chrome_events())
+    for k in ("pack", "h2d", "dispatch", "collect"):
+        assert rep["busy_secs"][k] == pytest.approx(phases[k],
+                                                    rel=0.02), k
+    assert sum(rep["shares"].values()) == pytest.approx(1.0, abs=0.02)
+
+
+# ---------------------------------------------------------------------------
+# Pooled analyze-store integration (the acceptance sweep)
+# ---------------------------------------------------------------------------
+
+def _mk_store(tmp_path, n=3):
+    from jepsen_tpu.store import Store
+    store = Store(tmp_path / "store")
+    for i in range(n):
+        d = store.base / "fab" / f"2020010{1 + i}T000000"
+        d.mkdir(parents=True)
+        hist = synth_append_history(T=40, K=4, seed=i)
+        (d / "history.jsonl").write_text(
+            "\n".join(json.dumps(o) for o in hist) + "\n")
+    return store
+
+
+def test_pooled_sweep_merged_trace_and_report(tmp_path, monkeypatch):
+    """A REAL pooled analyze-store --report sweep: worker spools in
+    the store, >=1 worker-process track with encode spans in the
+    merged trace.json, a report whose shares sum to ~1.0, and the
+    worker span digests folded into the parent's metrics."""
+    from jepsen_tpu import cli
+    monkeypatch.setenv("JEPSEN_TPU_PIPELINE", "1")
+    store = _mk_store(tmp_path)
+    rc = cli.analyze_store(store, checker="append", report=True)
+    assert rc == 0
+    assert trace.iter_spools(store.base), "no worker spools"
+    obj = json.loads((store.base / "trace.json").read_text())
+    worker_pids = {e["pid"] for e in obj["traceEvents"]
+                   if e.get("ph") == "M"
+                   and e.get("name") == "process_name"
+                   and "worker" in str(e["args"].get("name", ""))}
+    assert worker_pids, "no worker-process track in the merged trace"
+    assert any(e.get("ph") == "X" and e.get("name") == "encode"
+               and e.get("pid") in worker_pids
+               for e in obj["traceEvents"]), "no worker encode span"
+    rep = json.loads((store.base / "report.json").read_text())
+    assert sum(rep["shares"].values()) == pytest.approx(1.0, abs=0.02)
+    assert rep["workers"] >= 1
+    assert (store.base / "report.md").is_file()
+    m = json.loads((store.base / "metrics.json").read_text())
+    assert m["counters"].get("worker_spans", 0) >= 3
+    assert any(k.startswith("worker.") for k in m["histograms"])
+
+
+def test_trace_off_means_no_spools_no_report(tmp_path, monkeypatch):
+    """Acceptance: JEPSEN_TPU_TRACE=0 still means zero spool files
+    (and no report), even with --report and a forced pool."""
+    from jepsen_tpu import cli
+    monkeypatch.setenv("JEPSEN_TPU_TRACE", "0")
+    monkeypatch.setenv("JEPSEN_TPU_PIPELINE", "1")
+    trace.reset()
+    store = _mk_store(tmp_path, n=2)
+    rc = cli.analyze_store(store, checker="append", report=True)
+    assert rc == 0
+    assert trace.iter_spools(store.base) == []
+    assert not (store.base / "trace.json").exists()
+    assert not (store.base / "report.json").exists()
+
+
+def test_worker_trace_gate_off_keeps_parent_trace(tmp_path,
+                                                  monkeypatch):
+    from jepsen_tpu import cli
+    monkeypatch.setenv("JEPSEN_TPU_PIPELINE", "1")
+    monkeypatch.setenv("JEPSEN_TPU_WORKER_TRACE", "0")
+    store = _mk_store(tmp_path, n=2)
+    rc = cli.analyze_store(store, checker="append")
+    assert rc == 0
+    assert trace.iter_spools(store.base) == []
+    obj = json.loads((store.base / "trace.json").read_text())
+    assert not any("worker" in str(e["args"].get("name", ""))
+                   for e in obj["traceEvents"] if e.get("ph") == "M")
+    names = {e["name"] for e in obj["traceEvents"]}
+    assert {"parse", "pack", "dispatch"} <= names
