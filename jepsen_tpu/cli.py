@@ -198,6 +198,16 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
                               "timeline at exit (JEPSEN_TPU_REPORT=1 "
                               "is the env equivalent; needs tracing "
                               "on)")
+    p_batch.add_argument("--mesh", action="store_true",
+                         help="run as ONE SHARD of a multi-host mesh "
+                              "sweep (JEPSEN_TPU_MESH=1 is the env "
+                              "equivalent): deterministic shard of "
+                              "the run dirs, per-shard "
+                              "verdicts-<shard>.jsonl journal + "
+                              "trace-shard<k>.json artifacts, "
+                              "coordinator merge on shard 0; shard "
+                              "identity from JEPSEN_TPU_MESH_SHARD/"
+                              "_SHARDS or the jax.distributed job")
     add_trace_opts(p_batch)
 
     p_serve = sub.add_parser("serve", help="serve the store over HTTP")
@@ -314,9 +324,14 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
                 worst = max(worst, code)
             return worst
         if args.command == "analyze-store":
+            if args.mesh:
+                # flag→env export, like --backend: embedded callers
+                # and subprocesses of this sweep see the same choice
+                gates.export("JEPSEN_TPU_MESH", True)
             return analyze_store(Store(args.store), checker=args.checker,
                                  name=args.name, resume=args.resume,
-                                 report=args.report or None)
+                                 report=args.report or None,
+                                 mesh=args.mesh or None)
         if args.command == "serve":
             from . import web
             web.serve(Store(args.store), host=args.host, port=args.port)
@@ -332,7 +347,8 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
 def analyze_store(store: Store, checker: str = "append",
                   name: str | None = None,
                   resume: bool = False, obs_hook=None,
-                  report: bool | None = None) -> int:
+                  report: bool | None = None,
+                  mesh: bool | None = None) -> int:
     """`_analyze_store_impl` wrapped in a fresh sweep tracer: the whole
     sweep's spans (ingest parse, pack/h2d/dispatch/collect phases,
     device windows, per-checker fallbacks) export to
@@ -359,29 +375,64 @@ def analyze_store(store: Store, checker: str = "append",
     sampler (`<store>/health.json`, atomic, every N s) and
     `JEPSEN_TPU_METRICS_PORT` the `/metrics`+`/healthz` endpoint —
     both off by default, costing nothing when unset. `obs_hook(server,
-    sampler)` is a test/smoke seam called once the obs layer is up."""
+    sampler)` is a test/smoke seam called once the obs layer is up.
+
+    With `mesh` (the `--mesh` flag; None defers to JEPSEN_TPU_MESH)
+    this process sweeps ONE SHARD of a multi-host mesh sweep
+    (jepsen_tpu.mesh): a deterministic hash-split of the run dirs,
+    journaled to `verdicts-<shard>.jsonl` (resume stays strictly
+    per-shard), dispatched on this host's LOCAL devices via the same
+    warm path, traced to `trace-shard<k>.json` with the shard id in
+    every track name; the coordinator (shard 0) then merges journals,
+    traces, metrics and — with `report` — the per-shard attribution
+    report once the fleet's done markers land. A shard that never
+    reports is LOST (exit ≥2, runs unverdicted) and re-assignable
+    with `JEPSEN_TPU_MESH_SHARD=<k> --mesh --resume` — the
+    supervisor's degradation contract at fleet scale."""
+    from . import mesh as meshmod
     from . import obs
     from . import shm as _shm
+    from . import supervisor as sv
     from .store import VerdictJournal
     if report is None:
         report = gates.get("JEPSEN_TPU_REPORT")
-    tr = trace.fresh_run(f"analyze-store:{checker}", scope="sweep")
+    if mesh is None:
+        mesh = meshmod.mesh_enabled()
+    shard = n_shards = None
+    run_name = f"analyze-store:{checker}"
+    if mesh:
+        shard, n_shards = meshmod.resolve_shard()
+        # the shard id rides the tracer's run name, so every process
+        # track of this shard's trace carries it after the merge
+        run_name = f"{run_name}@shard{shard}/{n_shards}"
+    tr = trace.fresh_run(run_name, scope="sweep")
     if getattr(tr, "enabled", False) and store.base.is_dir():
         # point the worker trace fabric at the store: pool workers
-        # spool spans to <store>/trace-<pid>.jsonl; stale spools from
-        # a previous sweep are derived artifacts keyed by trace id —
-        # cleared here so the store holds exactly this sweep's set
-        trace.clean_spools(store.base)
-        tr.spool_dir = store.base
+        # spool spans to <spool_dir>/trace-<pid>.jsonl; stale spools
+        # from a previous sweep are derived artifacts keyed by trace
+        # id — cleared here so the dir holds exactly this sweep's set.
+        # Mesh shards share the store CONCURRENTLY and two hosts'
+        # workers can even share a pid, so each shard owns its own
+        # spool subdirectory (trace.shard_spool_dir) — cleaning it
+        # can't race a sibling, and spool names can't collide.
+        sd = store.base if not mesh \
+            else trace.shard_spool_dir(store.base, shard)
+        sd.mkdir(exist_ok=True)
+        trace.clean_spools(sd)
+        tr.spool_dir = sd
     elif report:
         print("attribution report needs tracing on "
               "(JEPSEN_TPU_TRACE=0 set); skipping", file=sys.stderr)
     tr.counter("shm_stale_reclaimed").inc(_shm.reclaim_stale())
-    journal = VerdictJournal(store.base / "verdicts.jsonl",
-                             base=store.base)
+    journal = VerdictJournal(
+        meshmod.shard_journal_path(store.base, shard) if mesh
+        else store.base / "verdicts.jsonl", base=store.base)
+    if mesh and store.base.is_dir():
+        sv.mark_shard_start(store.base, shard)
     obs.install_events(store.base)
     obs.emit("sweep_start", checker=checker, resume=bool(resume),
-             store=str(store.base))
+             store=str(store.base),
+             **({"shard": shard, "shards": n_shards} if mesh else {}))
     sampler = obs.maybe_start_health_sampler(store.base)
     server = obs.maybe_start_metrics_server(
         health_fn=(sampler.write_snapshot if sampler is not None
@@ -393,8 +444,8 @@ def analyze_store(store: Store, checker: str = "append",
         with trace.jax_profile_session(store.base / "jax-profile"):
             rc = _analyze_store_impl(store, checker=checker,
                                      name=name, resume=resume,
-                                     journal=journal)
-            return rc
+                                     journal=journal, shard=shard,
+                                     n_shards=n_shards)
     finally:
         journal.close()
         obs.emit("sweep_end",
@@ -407,38 +458,75 @@ def analyze_store(store: Store, checker: str = "append",
         if getattr(tr, "enabled", False) and store.base.is_dir():
             try:
                 # the merged export: parent events + every worker
-                # spool of THIS sweep, one real-pid track per worker
-                evs = trace.merge_traces(tr, store.base)
-                p = trace.atomic_write_text(
-                    store.base / "trace.json",
-                    json.dumps({"traceEvents": evs,
-                                "displayTimeUnit": "ms"}))
-                tr.export_metrics(store.base / "metrics.json")
-                print(f"trace written to {p}", file=sys.stderr)
-                if report:
-                    from .obs import attribution
-                    rj, _rmd = attribution.write_report(
-                        store.base, evs, tr.metrics_dict())
-                    print(f"report written to {rj}", file=sys.stderr)
+                # spool of THIS sweep (from this sweep's own spool
+                # dir), one real-pid track per worker
+                evs = trace.merge_traces(tr)
+                if mesh:
+                    # a resume that re-checked nothing records no
+                    # timed events: keep the PREVIOUS shard trace —
+                    # it is still the evidence for how this shard's
+                    # journaled verdicts were produced, and the
+                    # coordinator's per-shard attribution needs it
+                    timed = any(e.get("ph") != "M" for e in evs)
+                    sp = trace.shard_trace_path(store.base, shard)
+                    if timed or not sp.exists():
+                        p = trace.export_shard_trace(
+                            tr, store.base, shard, n_shards, evs)
+                        tr.export_metrics(
+                            store.base / f"metrics-shard{shard}.json")
+                        print(f"shard trace written to {p}",
+                              file=sys.stderr)
+                    else:
+                        print(f"shard {shard}: no new events; "
+                              f"keeping {sp}", file=sys.stderr)
+                else:
+                    p = trace.atomic_write_text(
+                        store.base / "trace.json",
+                        json.dumps({"traceEvents": evs,
+                                    "displayTimeUnit": "ms"}))
+                    tr.export_metrics(store.base / "metrics.json")
+                    print(f"trace written to {p}", file=sys.stderr)
+                    if report:
+                        from .obs import attribution
+                        rj, _rmd = attribution.write_report(
+                            store.base, evs, tr.metrics_dict())
+                        print(f"report written to {rj}",
+                              file=sys.stderr)
             except Exception:
                 log.warning("sweep trace export failed", exc_info=True)
+        if mesh and store.base.is_dir():
+            # the done marker is the LAST artifact: a coordinator that
+            # sees it may merge this shard's journal + trace right away
+            sv.mark_shard_done(store.base, shard, {
+                "shard": shard, "shards": n_shards, "checker": checker,
+                "exit_code": rc if rc is not None else "crashed"})
+    if mesh:
+        return meshmod.coordinator_merge(store, checker, shard,
+                                         n_shards, rc, report=report,
+                                         tracer=tr, name=name)
+    return rc
 
 
 def _analyze_store_impl(store: Store, checker: str = "append",
                         name: str | None = None,
                         resume: bool = False,
-                        journal=None) -> int:
+                        journal=None, shard: int | None = None,
+                        n_shards: int | None = None) -> int:
     """Batch re-check every stored run — the north-star batch path
     (SURVEY.md §3.4, §7 stage 8): encodable histories are packed,
     length-bucketed, and dispatched across the device mesh in one sweep;
     the rest (or --checker stored) re-run their own checker host-side.
 
     Writes `results.json`/`results.edn` into each run dir and prints one
-    JSON summary line per run. Exit code: worst validity across runs."""
+    JSON summary line per run. Exit code: worst validity across runs.
+    With `shard`/`n_shards` (a mesh sweep) only this shard's
+    deterministic slice of the run dirs is walked — the store iterator
+    applies the hash split during the (lazy) listing itself, so no
+    host ever builds the other shards' run list."""
     from .store import VerdictJournal
-    run_dirs = sorted(store.all_run_dirs())
-    if name is not None:
-        run_dirs = [d for d in run_dirs if d.parent.name == name]
+    run_dirs = list(store.iter_run_dirs(
+        name=name, shard=shard,
+        n_shards=n_shards if n_shards is not None else 1))
     prior_worst = 0
     if resume:
         # resumable analysis (SURVEY.md §5.4): skip runs THIS sweep
@@ -450,7 +538,13 @@ def _analyze_store_impl(store: Store, checker: str = "append",
         # recorded validity to the exit code — an invalid verdict
         # from the completed part of an interrupted sweep must not
         # read as success.
-        journaled = VerdictJournal.load(store.base / "verdicts.jsonl")
+        # per-shard resume reads THIS shard's journal only (the
+        # journal threaded in is already verdicts-<shard>.jsonl):
+        # cross-host resume must never read — or race — another
+        # shard's evidence
+        journaled = VerdictJournal.load(
+            journal.path if journal is not None
+            else store.base / "verdicts.jsonl")
         rel = journal.rel if journal is not None else str
         pending = []
         for d in run_dirs:
@@ -465,12 +559,22 @@ def _analyze_store_impl(store: Store, checker: str = "append",
         from . import obs
         obs.emit("sweep_resume", skipped=len(run_dirs) - len(pending),
                  pending=len(pending))
-        if not pending:
+        if not pending and run_dirs:
             print(f"all {len(run_dirs)} runs already verdicted "
                   f"({checker}); nothing to resume", file=sys.stderr)
-            return prior_worst if run_dirs else 254
+            return prior_worst
         run_dirs = pending
     if not run_dirs:
+        if shard is not None \
+                and next(store.iter_run_dirs(name=name), None) \
+                is not None:
+            # a legitimate mesh assignment, not a usage error: the
+            # hash split left this shard nothing (tiny store, many
+            # shards) — the shard completes empty so the coordinator
+            # can still merge the fleet
+            print(f"shard {shard}/{n_shards}: no runs assigned",
+                  file=sys.stderr)
+            return prior_worst
         print("no stored runs", file=sys.stderr)
         return 254
     # live-telemetry progress denominators: the health sampler reads
@@ -577,7 +681,12 @@ def _analyze_store_impl(store: Store, checker: str = "append",
         def get_mesh():
             if not mesh_box:
                 try:
-                    mesh_box.append(parallel.make_mesh())
+                    # a mesh-sweep shard dispatches on ITS OWN host's
+                    # chips only: the cross-host axis is the shard
+                    # split of run dirs, never a global dispatch mesh
+                    mesh_box.append(parallel.host_local_mesh()
+                                    if shard is not None
+                                    else parallel.make_mesh())
                 except Exception:
                     mesh_box.append(None)
             return mesh_box[0]

@@ -225,6 +225,24 @@ _g("JEPSEN_TPU_AOT_CACHE", "bool", True,
 _g("JEPSEN_TPU_COMPILE_CACHE_DIR", "str", None,
    "directory for the persistent AOT executable cache (default "
    "`~/.cache/jepsen_tpu/executables`)")
+# -- multi-host mesh --------------------------------------------------------
+_g("JEPSEN_TPU_MESH", "bool", False,
+   "set: `analyze-store` runs as ONE SHARD of a multi-host mesh sweep "
+   "(the `--mesh` flag exports it): deterministic shard of the run "
+   "dirs, per-shard `verdicts-<shard>.jsonl` journal and "
+   "`trace-shard<k>.json` artifacts, coordinator merge on shard 0")
+_g("JEPSEN_TPU_MESH_SHARD", "int", None,
+   "mesh shard index override (re-assign a dead host's shard to "
+   "another host); default: `jax.process_index()` on a distributed "
+   "job, else 0")
+_g("JEPSEN_TPU_MESH_SHARDS", "int", None,
+   "mesh shard-count override — set on every host to shard a store "
+   "WITHOUT a jax.distributed coordinator; default: "
+   "`jax.process_count()` on a distributed job, else 1")
+_g("JEPSEN_TPU_MESH_WAIT_S", "float", 600.0,
+   "seconds the mesh coordinator (shard 0) waits for the other "
+   "shards' done markers before declaring them lost (re-assignable, "
+   "exit code ≥2) and merging what exists; `0` merges immediately")
 # -- robustness -------------------------------------------------------------
 _g("JEPSEN_TPU_STRICT", "bool", False,
    "set: restore fail-fast — no quarantine, no OOM backdown; the "

@@ -1,0 +1,290 @@
+"""Multi-host sharded sweeps: `analyze-store --mesh` coordination.
+
+One store, a whole slice (ROADMAP item 1). Checking cost grows
+superlinearly with history size but is embarrassingly parallel across
+independent histories (arxiv 1908.04509), and the per-history
+dependency-graph analysis partitions cleanly by run dir — so the
+cross-HOST axis of a mesh sweep is a deterministic shard split of the
+store's run dirs, not a global dispatch mesh. Each shard (host) runs
+the existing warm path (sidecar mmap → views → donated buffers →
+AOT-cached dispatch) over its own shard on its own local devices,
+journals to its own `verdicts-<shard>.jsonl`, and exports its own
+merged trace; the coordinator (shard 0) folds journals, traces and
+metrics into the store-level artifacts once every shard's done marker
+lands (or its bounded wait expires — a dead host's shard is LOST and
+re-assignable, never a dead sweep).
+
+Shard identity resolves in this order:
+
+  1. `JEPSEN_TPU_MESH_SHARDS` (+ optional `JEPSEN_TPU_MESH_SHARD`) —
+     the coordinator-free mode: set the count on every host, the
+     index per host. Also how an operator RE-ASSIGNS a dead host's
+     shard (`JEPSEN_TPU_MESH_SHARD=<k> ... --resume`).
+  2. a jax.distributed job (`JAX_COORDINATOR_ADDRESS` et al.):
+     `jax.process_index()` / `jax.process_count()` after
+     `parallel.init_distributed()`.
+  3. neither → one shard (a mesh sweep of one host is an ordinary
+     sweep with a per-shard journal).
+
+The shard assignment itself (`store.shard_of`) hashes the
+store-relative run key, so every host computes the same partition
+from nothing but its own directory listing — resume, re-assignment
+and the verdict journal all key on the same string.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+from pathlib import Path
+
+from . import gates
+
+log = logging.getLogger(__name__)
+
+
+def mesh_enabled() -> bool:
+    """The JEPSEN_TPU_MESH gate (default off; `--mesh` exports it)."""
+    return gates.get("JEPSEN_TPU_MESH")
+
+
+def resolve_shard() -> tuple[int, int]:
+    """(shard index, shard count) for this process — see the module
+    doc for the resolution order. An out-of-range explicit index is
+    an error (wrapping a mistyped re-assignment onto another LIVE
+    shard would race its journal), and so is a failed distributed
+    init when a coordinator address is set (degrading to a full-store
+    single-shard sweep would have every host of the fleet sweep
+    everything, racing each other's artifacts)."""
+    override = gates.get("JEPSEN_TPU_MESH_SHARD")
+    shards = gates.get("JEPSEN_TPU_MESH_SHARDS")
+
+    def ranged(shard: int, count: int) -> tuple[int, int]:
+        if not 0 <= shard < count:
+            raise ValueError(
+                f"JEPSEN_TPU_MESH_SHARD={shard} out of range for "
+                f"a {count}-shard mesh (indices are 0..{count - 1})")
+        return shard, count
+
+    if shards is not None and shards > 0:
+        return ranged(0 if override is None else override, shards)
+    try:
+        from . import parallel
+        joined = parallel.init_distributed()
+        if joined:
+            import jax
+            # the documented re-assignment override applies here too:
+            # MESH_SHARD replaces process_index so a replacement host
+            # inside a distributed job can take a dead host's shard
+            return ranged(jax.process_index() if override is None
+                          else override, jax.process_count())
+    except Exception as e:
+        if isinstance(e, ValueError):
+            raise
+        raise RuntimeError(
+            "mesh shard identity unresolvable: a coordinator address "
+            "is set but jax.distributed init failed — refusing to "
+            "degrade to a full-store single-shard sweep (every host "
+            "would sweep everything, racing the same journals). Set "
+            "JEPSEN_TPU_MESH_SHARDS/_SHARD for coordinator-free "
+            "identity instead.") from e
+    if override is not None:
+        raise ValueError(
+            f"JEPSEN_TPU_MESH_SHARD={override} set with no shard "
+            "count: set JEPSEN_TPU_MESH_SHARDS too (or run inside a "
+            "jax.distributed job) — a bare index cannot define a "
+            "partition")
+    return 0, 1
+
+
+def shard_journal_path(store_base, shard: int) -> Path:
+    """This shard's resumable verdict journal. Per-shard files keep
+    resume strictly local: a killed fleet resumes each shard from its
+    OWN journal with zero reads of (or writes racing) any other
+    shard's, and a replacement host for a dead shard needs exactly one
+    file."""
+    return Path(store_base) / f"verdicts-{shard}.jsonl"
+
+
+def merge_journals(store_base, n_shards: int, checker: str) -> dict:
+    """{store-relative run dir: last journal entry} for `checker`
+    across every per-shard journal — the coordinator's one verdict
+    set. Shards partition the run dirs, so keys can't collide across
+    journals; within one journal, last entry wins (the resume
+    semantics)."""
+    from .store import VerdictJournal
+    out: dict[str, dict] = {}
+    for k in range(n_shards):
+        loaded = VerdictJournal.load(shard_journal_path(store_base, k))
+        for (d, c), e in loaded.items():
+            if c == checker:
+                out[d] = e
+    return out
+
+
+def merge_shard_metrics(store_base, n_shards: int) -> dict:
+    """Fleet-level metrics: counters summed across every present
+    `metrics-shard<k>.json` (gauges/histograms stay per shard under
+    `per_shard` — a max inflight_depth summed across hosts would mean
+    nothing)."""
+    counters: dict[str, int] = {}
+    per_shard: dict[str, dict] = {}
+    for k in range(n_shards):
+        p = Path(store_base) / f"metrics-shard{k}.json"
+        try:
+            m = json.loads(p.read_text())
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not isinstance(m, dict):
+            continue
+        per_shard[str(k)] = m
+        for name, v in (m.get("counters") or {}).items():
+            if isinstance(v, (int, float)):
+                counters[name] = counters.get(name, 0) + v
+    return {"counters": counters, "per_shard": per_shard}
+
+
+def coordinator_merge(store, checker: str, shard: int, n_shards: int,
+                      own_rc: int | None, report: bool = False,
+                      tracer=None, name: str | None = None) -> int:
+    """The mesh sweep's merge step. Non-coordinator shards return
+    their own exit code untouched; shard 0 waits (bounded) for the
+    fleet's done markers, folds the per-shard journals into one
+    verdict set (the merged exit code — an invalid verdict on ANY
+    shard fails the fleet), merges the per-shard traces into one
+    cross-host `trace.json` + `metrics.json`, and — with `report` —
+    writes the merged attribution report with per-shard stage shares.
+
+    Degradation, never silent success: the TRUTH about each shard is
+    its journal's COVERAGE of its hash assignment (one store walk
+    computes every shard's expected run set), not its done marker —
+    markers are only the liveness hint the bounded wait polls, so a
+    stale marker from a previous sweep, or a marker stamped by a
+    shard that CRASHED mid-sweep (analyze_store's finally records
+    exit "crashed"), can end the wait early but can never make a
+    partial shard read as complete. A shard that is lost (no
+    marker), crashed (non-validity exit code) or incomplete (journal
+    missing assigned runs) floors the merged exit at 2, is named in
+    the summary, and is re-assignable; only a fully-covered fleet
+    lets the merge clean the worker spools."""
+    own_rc = 255 if own_rc is None else own_rc
+    if shard != 0 or n_shards <= 0:
+        return own_rc
+    import os
+
+    from . import obs
+    from . import supervisor as sv
+    from .cli import validity_exit_code
+    from .store import VerdictJournal, shard_of
+    obs.install_events(store.base)
+    try:
+        others = [k for k in range(n_shards) if k != shard]
+        done, lost = sv.wait_for_shards(
+            store.base, others,
+            timeout_s=gates.get("JEPSEN_TPU_MESH_WAIT_S"))
+        # ONE walk derives every shard's expected assignment — the
+        # same split every shard computed for itself
+        expected: dict[int, set] = {k: set() for k in range(n_shards)}
+        for d in store.iter_run_dirs(name=name):
+            key = os.path.relpath(d, store.base)
+            expected[shard_of(key, n_shards)].add(key)
+        journaled: dict[int, dict] = {}
+        for k in range(n_shards):
+            journaled[k] = {
+                d: e for (d, c), e in VerdictJournal.load(
+                    shard_journal_path(store.base, k)).items()
+                if c == checker}
+        crashed, incomplete = [], []
+        for k in sorted(done):
+            ec = done[k].get("exit_code")
+            obs.emit("shard_done", shard=k, exit_code=ec)
+            if not isinstance(ec, int) or ec not in (0, 1, 2):
+                crashed.append(k)
+            elif not expected[k] <= set(journaled[k]):
+                # a marker without the journal to back it: stale from
+                # a previous sweep, or a partial re-sweep — the
+                # journal is the evidence, the marker just a hint
+                incomplete.append(k)
+        for k in lost:
+            obs.emit("shard_lost", shard=k, shards=n_shards)
+        for k in lost + crashed + incomplete:
+            log.warning(
+                "shard %d/%d %s: its runs are unverdicted; re-assign "
+                "it with JEPSEN_TPU_MESH_SHARD=%d "
+                "JEPSEN_TPU_MESH_SHARDS=%d analyze-store --mesh "
+                "--resume", k, n_shards,
+                "missing at merge" if k in lost
+                else "crashed" if k in crashed
+                else "incompletely journaled",
+                k, n_shards)
+        merged: dict[str, dict] = {}
+        for k in range(n_shards):
+            merged.update(journaled[k])
+        worst = own_rc
+        counts = {0: 0, 1: 0, 2: 0}
+        for e in merged.values():
+            c = validity_exit_code(e)
+            worst = max(worst, c)
+            counts[c if c in counts else 2] += 1
+        total = sum(len(v) for v in expected.values())
+        unaccounted = max(0, total - len(merged))
+        if lost or crashed or incomplete or unaccounted:
+            worst = max(worst, 2)
+        print(json.dumps({
+            "mesh": True, "checker": checker, "shards": n_shards,
+            "runs_total": total, "runs_verdicted": len(merged),
+            "unaccounted": unaccounted, "valid": counts[0],
+            "invalid": counts[1], "unknown": counts[2],
+            "lost_shards": lost, "crashed_shards": crashed,
+            "incomplete_shards": incomplete,
+            "valid?": worst == 0}))
+        if tracer is not None and getattr(tracer, "enabled", False) \
+                and Path(store.base).is_dir():
+            try:
+                _merge_trace_artifacts(
+                    store.base, n_shards, report,
+                    fleet_complete=not (lost or crashed or incomplete
+                                        or unaccounted))
+            except Exception:
+                log.warning("mesh trace merge failed", exc_info=True)
+        return worst
+    finally:
+        obs.reset_events()
+
+
+def _merge_trace_artifacts(store_base, n_shards: int, report: bool,
+                           fleet_complete: bool = True) -> None:
+    """trace.json / metrics.json / report.{json,md} from the per-shard
+    exports (a lost shard's missing files are skipped, not fatal)."""
+    from . import trace as _trace
+    evs, per_shard = _trace.merge_shard_traces(store_base,
+                                               range(n_shards))
+    if not evs:
+        return
+    p = _trace.atomic_write_text(
+        Path(store_base) / "trace.json",
+        json.dumps({"traceEvents": evs, "displayTimeUnit": "ms"}))
+    print(f"merged mesh trace written to {p}", file=sys.stderr)
+    metrics = merge_shard_metrics(store_base, n_shards)
+    _trace.atomic_write_text(Path(store_base) / "metrics.json",
+                             json.dumps(metrics, indent=2))
+    if report:
+        from .obs import attribution
+        rj, _md = attribution.write_report(
+            store_base, evs, metrics, per_shard_events=per_shard)
+        print(f"merged mesh report written to {rj}", file=sys.stderr)
+    # every shard's spans now live in its trace-shard<k>.json export —
+    # but ONLY when the whole fleet is accounted for: a lost/crashed/
+    # incomplete shard may still be sweeping, and deleting its live
+    # spool dir would strip the worker spans from the shard trace it
+    # eventually exports. With stragglers outstanding the spool dirs
+    # stay (each shard cleans its own at its next sweep start).
+    if fleet_complete:
+        for k in range(n_shards):
+            sd = _trace.shard_spool_dir(store_base, k)
+            _trace.clean_spools(sd)
+            try:
+                sd.rmdir()
+            except OSError:
+                pass

@@ -285,6 +285,23 @@ def render_report_md(rep: dict) -> str:
                   f"pack-bound {st.get('pack_bound_secs', 0.0):.3f} s "
                   f"· other {st.get('other_secs', 0.0):.3f} s "
                   f"across {st.get('gaps', 0)} gap(s)"]
+    per_shard = rep.get("per_shard") or {}
+    if per_shard:
+        lines += ["", "## Per-shard decomposition (mesh sweep)", "",
+                  "| shard | wall s | bound | device | encode | idle |",
+                  "|---|---|---|---|---|---|"]
+        # numeric-aware order: '10' after '2', not between '1' and '2'
+        for k in sorted(per_shard,
+                        key=lambda s: (0, int(s)) if str(s).isdigit()
+                        else (1, str(s))):
+            sr = per_shard[k]
+            ss = sr.get("shares", {})
+            lines.append(
+                f"| {k} | {sr.get('wall_secs', 0.0):.3f} | "
+                f"{sr.get('bound') or '—'} | "
+                f"{ss.get('device', 0.0):.1%} | "
+                f"{ss.get('encode', 0.0):.1%} | "
+                f"{ss.get('idle', 0.0):.1%} |")
     lines += ["", "## What-if", "", f"- {summary_line(rep)}"]
     if rep.get("counters"):
         keep = ("runs_verdicted", "buckets_dispatched", "cache_hits",
@@ -297,14 +314,29 @@ def render_report_md(rep: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
+def analyze_shards(per_shard_events: dict) -> dict:
+    """Per-shard attribution for a mesh sweep: each shard's report is
+    computed over ITS OWN event list (its own timeline — cross-host
+    clock alignment never touches the shares), so per-shard shares sum
+    to 1.0 per shard by the same construction as the merged report."""
+    return {str(k): analyze(evs)
+            for k, evs in sorted(per_shard_events.items())}
+
+
 def write_report(store_base, events: list, metrics: dict | None = None,
-                 window_us=None):
+                 window_us=None, per_shard_events: dict | None = None):
     """Write `<store>/report.json` + `report.md` (atomically — the
-    journal discipline) and return their paths."""
+    journal discipline) and return their paths. With
+    `per_shard_events` ({shard: event list} — a mesh sweep's
+    coordinator merge) the report additionally carries `per_shard`:
+    each shard's own stage-share decomposition, so `bench-report` and
+    operators can pin per-shard ceilings, not just fleet-wide ones."""
     base = Path(store_base)
     rep = analyze(events, window_us=window_us,
                   counters=(metrics or {}).get("counters"))
     rep = {"v": 1, **rep}
+    if per_shard_events:
+        rep["per_shard"] = analyze_shards(per_shard_events)
     jp = trace.atomic_write_text(base / "report.json",
                                  json.dumps(rep, indent=2))
     mp = trace.atomic_write_text(base / "report.md",

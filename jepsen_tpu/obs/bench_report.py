@@ -45,6 +45,11 @@ class MetricSpec:
     # compare against — "a stage silently regrowing past a declared
     # share fails the gate"
     ceiling: float | None = None
+    # absolute floor (higher-is-better metrics only): the latest value
+    # falling below it regresses even with no predecessor — the
+    # mesh scaling-efficiency contract ("2 shards must buy ≥1.4x")
+    # holds from the first round that reports it
+    floor: float | None = None
 
 
 #: The declared trajectory metrics and their regression thresholds.
@@ -98,6 +103,15 @@ METRICS: tuple[MetricSpec, ...] = (
     MetricSpec("warm_idle_share", "warm-sweep idle share",
                ("north_star", "cache_warm", "attribution", "shares",
                 "idle"), False, 0.30, ceiling=0.90),
+    # the multi-host mesh block: store->verdict throughput of the
+    # 2-shard simulated mesh, and its scaling efficiency vs the
+    # single-process sweep of the same store — the dp8-style gate for
+    # scale-OUT. The 0.70 floor is the declared contract: 2 shards
+    # must buy ≥1.4x, first round included.
+    MetricSpec("mesh_rate", "mesh sweep hist/s", ("mesh", "value"),
+               True, 0.30),
+    MetricSpec("mesh_eff", "mesh 2-shard scaling efficiency",
+               ("mesh", "scaling_efficiency"), True, 0.15, floor=0.70),
 )
 
 
@@ -195,6 +209,18 @@ def report(paths, out=print) -> int:
                         f"{spec.label} ({backend}): {c_last:g} "
                         f"({c_name}) exceeds the declared ceiling "
                         f"{spec.ceiling:g}")
+            # the floor is the ceiling's higher-is-better twin: a
+            # newly-reported efficiency already below its declared
+            # bound must not ride in free either
+            if spec.floor is not None and vals:
+                f_name, f_last = vals[-1]
+                if f_last < spec.floor:
+                    notes.append(f"[{backend} {f_last:g} < floor "
+                                 f"{spec.floor:g}] REGRESSED")
+                    regressions.append(
+                        f"{spec.label} ({backend}): {f_last:g} "
+                        f"({f_name}) falls below the declared floor "
+                        f"{spec.floor:g}")
             if len(vals) < 2:
                 continue
             (p_name, prev), (l_name, last) = vals[-2], vals[-1]

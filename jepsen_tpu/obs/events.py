@@ -49,6 +49,9 @@ EVENT_KINDS = frozenset({
     "cache_rebuild",      # path (corrupt/stale sidecar discarded)
     "health_sample",      # seq (periodic heartbeat mark, first+last)
     "metrics_serve",      # port (endpoint came up)
+    "shard_done",         # shard, exit_code (mesh shard completed)
+    "shard_lost",         # shard, shards (no done marker at merge —
+    #                       re-assignable via JEPSEN_TPU_MESH_SHARD)
 })
 
 _lock = threading.Lock()

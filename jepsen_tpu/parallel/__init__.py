@@ -36,6 +36,7 @@ from .. import trace
 from ..obs import events as obs_events
 from ..checker.elle import kernels as K
 from ..devices import default_devices, ensure_platform_pin
+from . import residency
 
 ensure_platform_pin()
 from ..util import pad_to_multiple
@@ -58,6 +59,19 @@ def make_mesh(devices: Sequence | None = None,
     devices = list(devices if devices is not None else default_devices())
     dp, mp = factor2(len(devices))
     return Mesh(np.asarray(devices).reshape(dp, mp), axes)
+
+
+def host_local_mesh() -> Mesh:
+    """A dp×mp mesh over THIS process's local devices only — the
+    per-shard dispatch mesh of `analyze-store --mesh`. On a
+    distributed job `make_mesh()`'s default devices span every host,
+    but a mesh-sweep shard checks ITS OWN run dirs on ITS OWN chips:
+    the cross-host axis is the deterministic shard split of the store,
+    never a global dispatch (host-local batches aren't addressable on
+    a cross-process mesh without collective array assembly, and the
+    shard split already extracts the parallelism)."""
+    import jax
+    return make_mesh(jax.local_devices())
 
 
 def init_distributed() -> bool:
@@ -138,20 +152,14 @@ def sharded_check_fn(mesh: Mesh | None, shape: K.BatchShape, *,
                                     fused, donate)
 
 
-def _filter_cpu_donation_warning() -> None:
-    """On CPU — where XLA has no donation and ALWAYS warns — suppress
-    the 'donated buffers were not usable' warning. Installed at the
-    DISPATCH site (_donate_active), not inside the lru-cached compile:
-    anything may reset the warnings filters between dispatches (pytest
-    does, per test) and the warning fires at trace/lowering time, so
-    only a per-dispatch install actually covers every donated call;
-    filterwarnings de-duplicates identical entries itself. On real
-    accelerators the warning stays live: there it means a donation
-    actually failed, which is an actionable signal."""
-    if jax.default_backend() == "cpu":
-        import warnings
-        warnings.filterwarnings(
-            "ignore", message="Some donated buffers were not usable")
+# Executable residency + donated-slot ownership live in
+# parallel.residency (the split ROADMAP items 1 and 2 share: the mesh
+# sweep's per-shard dispatch loops and the future serve daemon both
+# hold executables and donated buffers resident without re-owning this
+# bookkeeping). The dispatcher below is pure scheduling; these two
+# objects are its residency/ownership seams.
+_residency = residency.ExecutableResidency()
+_slots = residency.DeviceSlots()
 
 
 @functools.lru_cache(maxsize=64)
@@ -564,34 +572,20 @@ def _dispatch_fn(bucket_mesh, shape: K.BatchShape, kw: dict, args,
                  donate: bool):
     """The callable for one bucket dispatch: the jitted check fn, or —
     single-device with the AOT cache on — a persistent compiled
-    executable (jepsen_tpu.aot) keyed by the input avals + kernel
-    flags + formulation, so a repeat sweep pays zero XLA compiles."""
+    executable (residency.ExecutableResidency over jepsen_tpu.aot)
+    keyed by the input avals + kernel flags + formulation, so a
+    repeat sweep pays zero XLA compiles."""
     fn = sharded_check_fn(bucket_mesh, shape, donate=donate, **kw)
-    if bucket_mesh is not None:
-        return fn
-    from .. import aot
-    if not aot.enabled():
-        return fn
-    use_pallas, use_int8 = K.resolve_formulation(single_device=True)
-    key = (kw.get("classify", True), kw.get("realtime", False),
-           kw.get("process_order", False), kw.get("fused"),
-           use_pallas, use_int8, donate,
-           shape.n_keys, shape.max_pos, shape.n_txns)
-    return aot.compiled_for(fn, args, key)
+    return _residency.dispatch_fn(fn, bucket_mesh, shape, kw, args,
+                                  donate)
 
 
 def _donate_active(bucket_mesh) -> bool:
-    active = bucket_mesh is None and sv.donate_buffers_enabled()
-    if active:
-        _filter_cpu_donation_warning()
-    return active
+    return _slots.donate_active(bucket_mesh)
 
 
 def _note_donation(tr) -> None:
-    """One donated dispatch: six input buffers handed to XLA, one
-    ledger slot until the dispatch resolves."""
-    sv.slot_ledger.acquire()
-    tr.counter("buffers_donated").inc(6)
+    _slots.note_donation(tr)
 
 
 def _sync_check(encs, idx: list, mesh, budget_cells: int, kw: dict,
@@ -616,7 +610,7 @@ def _sync_check(encs, idx: list, mesh, budget_cells: int, kw: dict,
         arr = np.asarray(_block_flags(fn(*args), tr))
     finally:
         if donate:
-            sv.slot_ledger.release()
+            _slots.release()
     tr.device_complete("bucket", t_disp, histories=len(idx))
     return arr
 
@@ -680,12 +674,12 @@ def _finish_part(encs, idx: list, flags, mesh, budget_cells: int,
     try:
         arr = np.asarray(_block_flags(flags, tr))
         if donated:
-            sv.slot_ledger.release()
+            _slots.release()
         tr.device_complete("bucket", t_disp, histories=len(idx))
         return [int(w) for w in arr[:len(idx)]]
     except BaseException as e:
         if donated:
-            sv.slot_ledger.release()
+            _slots.release()
         if isinstance(e, sv.WatchdogTimeout) and not sv.strict_enabled():
             return _quarantine_bucket(idx, "watchdog", e, tr)
         if sv.is_oom_error(e) and not sv.strict_enabled():

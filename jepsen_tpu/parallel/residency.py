@@ -1,0 +1,117 @@
+"""Executable residency + device-slot ownership, split out of sweep
+orchestration (the unlocking refactor ROADMAP items 1 and 2 share).
+
+The bucket dispatcher in `parallel/__init__.py` used to own three
+unrelated concerns at once: bucket scheduling (its real job), WHICH
+compiled executables are resident for repeat dispatches, and WHO holds
+the donated device-buffer slots while a dispatch is in flight. The
+multi-host mesh sweep (analyze-store --mesh) runs one long-lived
+dispatch loop per shard, and the future `serve` daemon (ROADMAP item
+2) runs one per process forever — both need executables and donated
+buffers held resident across requests without re-owning the
+bookkeeping, so the two non-scheduling concerns live here:
+
+  * `ExecutableResidency` — resolves the callable for one dispatch:
+    the jitted fn as-is for mesh-sharded dispatches (XLA must insert
+    the collectives), or the persistent AOT-compiled executable
+    (jepsen_tpu.aot) for single-device dispatches, keyed by kernel
+    flags + resolved formulation + batch geometry, so a warm owner
+    pays zero XLA compiles however many dispatch loops it runs.
+  * `DeviceSlots` — ownership of donated device-buffer slots: the
+    donation policy gate (single-device only, JEPSEN_TPU_DONATE_
+    BUFFERS) plus the supervisor's process-wide slot ledger. A slot
+    is acquired per donated dispatch and MUST be released exactly once
+    when the dispatch's fate is decided — success, watchdog
+    quarantine, or OOM backdown re-plan (the split halves acquire
+    their own slots; an ancestor's is never held through recovery).
+
+Both are plain objects so a second dispatch owner (a serve daemon's
+continuous batcher) can hold its own `DeviceSlots` over a different
+ledger while sharing the one process-wide executable residency.
+"""
+
+from __future__ import annotations
+
+
+class ExecutableResidency:
+    """Which compiled executables are resident for repeat dispatches.
+
+    jax's in-memory jit cache already dedups same-shape compiles within
+    a process; this layer adds the cross-process persistence (the AOT
+    executable cache) behind one stable key, so callers ask for "the
+    callable for this dispatch" and never learn how executables are
+    stored."""
+
+    def dispatch_fn(self, fn, bucket_mesh, shape, kw: dict, args,
+                    donate: bool):
+        """The callable for one bucket dispatch: `fn` (the jitted
+        check fn) for mesh-sharded dispatches, else the persistent
+        compiled executable when the AOT cache is on."""
+        if bucket_mesh is not None:
+            return fn
+        from .. import aot
+        if not aot.enabled():
+            return fn
+        return aot.compiled_for(
+            fn, args, self.dispatch_key(kw, shape, donate))
+
+    @staticmethod
+    def dispatch_key(kw: dict, shape, donate: bool) -> tuple:
+        """The stable half of the AOT cache key for a single-device
+        dispatch: kernel flags + the RESOLVED closure formulation +
+        batch geometry (aot itself adds input avals, backend topology
+        and jax/jaxlib versions)."""
+        from ..checker.elle import kernels as K
+        use_pallas, use_int8 = K.resolve_formulation(single_device=True)
+        return (kw.get("classify", True), kw.get("realtime", False),
+                kw.get("process_order", False), kw.get("fused"),
+                use_pallas, use_int8, donate,
+                shape.n_keys, shape.max_pos, shape.n_txns)
+
+
+class DeviceSlots:
+    """Donated device-buffer slot ownership for one dispatch owner.
+
+    Wraps the donation policy (the gate + the single-device-only rule)
+    and a `supervisor.DeviceSlotLedger` so every acquire/release pair
+    goes through one object — a drained owner with nonzero inflight is
+    a leak, which the warm-path tests pin to zero."""
+
+    def __init__(self, ledger=None):
+        if ledger is None:
+            from .. import supervisor as sv
+            ledger = sv.slot_ledger
+        self.ledger = ledger
+
+    def donate_active(self, bucket_mesh) -> bool:
+        """Does donation apply to this dispatch? Single-device only
+        (the mesh flag is normalized away so it can't split the
+        compile cache) and gated by JEPSEN_TPU_DONATE_BUFFERS; on CPU
+        the spurious 'donated buffers not usable' warning is filtered
+        at this dispatch site (pytest resets warning filters per test,
+        so a one-time install would not survive)."""
+        from .. import supervisor as sv
+        active = bucket_mesh is None and sv.donate_buffers_enabled()
+        if active:
+            self._filter_cpu_donation_warning()
+        return active
+
+    @staticmethod
+    def _filter_cpu_donation_warning() -> None:
+        import jax
+        if jax.default_backend() == "cpu":
+            import warnings
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+
+    def note_donation(self, tr) -> None:
+        """One donated dispatch: six input buffers handed to XLA, one
+        ledger slot held until the dispatch resolves."""
+        self.ledger.acquire()
+        tr.counter("buffers_donated").inc(6)
+
+    def release(self) -> None:
+        self.ledger.release()
+
+    def inflight(self) -> int:
+        return self.ledger.inflight()

@@ -215,8 +215,49 @@ class Store:
                 out[name_dir.name] = runs
         return out
 
+    def iter_run_dirs(self, name: str | None = None,
+                      shard: int | None = None, n_shards: int = 1):
+        """Lazy, shard-assignable store walk: yields run dirs in the
+        same order as `sorted(all_run_dirs())` without materializing
+        the whole store's Path list up front — one `os.scandir` per
+        test-name directory (dirent type answers is_dir for real
+        dirs; only symlinked entries pay a stat), so directory
+        listing doesn't dominate at 10^6 run dirs (ROADMAP item 5's
+        walk side). The `latest`/`current` links are skipped by NAME,
+        exactly like the legacy tests() walk — other symlinked dirs
+        (a store assembled by linking runs from another volume) are
+        followed as before. With `shard`/`n_shards` only the dirs
+        whose `shard_of` key lands on `shard` are yielded — the mesh
+        sweep's deterministic partition: every host derives the SAME
+        split from nothing but the store listing, no coordinator
+        round trip."""
+        base = self.base
+        try:
+            with os.scandir(base) as it:
+                names = sorted(
+                    e.name for e in it
+                    if e.name not in ("latest", "current")
+                    and e.is_dir())
+        except OSError:
+            return
+        for nm in names:
+            if name is not None and nm != name:
+                continue
+            try:
+                with os.scandir(base / nm) as it:
+                    runs = sorted(
+                        e.name for e in it
+                        if e.name != "latest" and e.is_dir())
+            except OSError:
+                continue
+            for rn in runs:
+                if shard is not None \
+                        and shard_of(f"{nm}/{rn}", n_shards) != shard:
+                    continue
+                yield base / nm / rn
+
     def all_run_dirs(self) -> list[Path]:
-        return [d for runs in self.tests().values() for d in runs.values()]
+        return list(self.iter_run_dirs())
 
     def latest(self) -> Path | None:
         link = self.base / "latest"
@@ -590,6 +631,20 @@ def _buf_xxh64(data: bytes) -> int:
     except Exception:
         pass
     return xxh64(data)
+
+
+def shard_of(key: str, n_shards: int) -> int:
+    """Deterministic shard assignment for a run dir: a stable hash of
+    the store-relative run key (``<test-name>/<start-time>`` — the
+    same string the verdict journal records), so every host of a mesh
+    sweep derives the SAME partition from nothing but the store
+    listing, and the partition survives the store moving between
+    hosts or sweeps. xxh64 keeps it independent of PYTHONHASHSEED and
+    bit-identical whether the native or the Python hasher computed
+    it."""
+    if n_shards <= 1:
+        return 0
+    return _buf_xxh64(str(key).encode()) % n_shards
 
 
 def bounded_file_xxh64(path: Path, size: int) -> int:

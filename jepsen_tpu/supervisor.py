@@ -187,6 +187,82 @@ def is_oom_error(e: BaseException) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# Mesh-fleet survivability: per-shard done markers (analyze-store --mesh).
+#
+# A multi-host sweep must treat a dead host the way a sweep treats a
+# quarantined history: the REST of the fleet's work survives and the
+# missing piece is re-assignable, never a dead sweep. Each shard
+# writes an atomic `.shard-<k>.done` marker (its exit code + shard
+# geometry) when its journal and trace artifacts are final; the
+# coordinator polls the markers with a bounded wait
+# (JEPSEN_TPU_MESH_WAIT_S) and classifies the still-missing shards as
+# LOST — their runs count as `unknown` toward the merged exit code,
+# and the operator re-runs just that shard anywhere with
+# `JEPSEN_TPU_MESH_SHARD=<k> ... --resume` (the per-shard journal is
+# the resume evidence, so the replacement host re-checks nothing the
+# dead host already verdicted).
+# ---------------------------------------------------------------------------
+
+def shard_done_path(store_base, shard: int):
+    from pathlib import Path
+    return Path(store_base) / f".shard-{shard}.done"
+
+
+def mark_shard_start(store_base, shard: int) -> None:
+    """Clear this shard's stale done marker (a previous sweep's) so
+    the coordinator can't merge against last sweep's completion."""
+    try:
+        shard_done_path(store_base, shard).unlink(missing_ok=True)
+    except OSError:
+        pass
+
+
+def mark_shard_done(store_base, shard: int, payload: dict) -> None:
+    """Atomically persist this shard's completion marker (best-effort:
+    a read-only store must not turn a finished shard into a crash)."""
+    import json
+
+    from . import trace
+    try:
+        trace.atomic_write_text(shard_done_path(store_base, shard),
+                                json.dumps(payload))
+    except OSError:
+        pass
+
+
+def load_shard_done(store_base, shard: int) -> dict | None:
+    import json
+    try:
+        v = json.loads(shard_done_path(store_base, shard).read_text())
+        return v if isinstance(v, dict) else None
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def wait_for_shards(store_base, shards, timeout_s: float,
+                    poll_s: float = 0.25):
+    """Poll for the done markers of `shards` until all land or
+    `timeout_s` expires. Returns (done: {shard: marker payload},
+    lost: [shard, ...]) — lost shards are re-assignable, not fatal."""
+    import time
+
+    shards = list(shards)
+    deadline = time.monotonic() + max(0.0, float(timeout_s or 0.0))
+    done: dict[int, dict] = {}
+    while True:
+        for k in shards:
+            if k not in done:
+                p = load_shard_done(store_base, k)
+                if p is not None:
+                    done[k] = p
+        missing = [k for k in shards if k not in done]
+        if not missing or time.monotonic() >= deadline:
+            return done, missing
+        time.sleep(min(poll_s, max(0.01,
+                                   deadline - time.monotonic())))
+
+
+# ---------------------------------------------------------------------------
 # Self-nemesis: deterministic fault injection (JEPSEN_TPU_FAULT_INJECT)
 # ---------------------------------------------------------------------------
 #

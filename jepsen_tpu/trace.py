@@ -946,6 +946,123 @@ def merge_traces(tracer, spool_dir=None) -> list[dict]:
 
 
 # ---------------------------------------------------------------------------
+# Cross-HOST trace merge (analyze-store --mesh): per-shard exports.
+#
+# The worker-spool fabric above merges per-PROCESS spools on one host;
+# a mesh sweep spans hosts, whose processes cannot share a spool
+# directory's lifecycle (concurrent shards must not clean each other's
+# live spools) and whose pids collide. Each shard therefore exports
+# its own ALREADY-MERGED Chrome event list (parent + its workers) as
+# `<store>/trace-shard<k>.json`, stamped with the tracer's
+# CLOCK_MONOTONIC origin; the coordinator folds the shard files into
+# one cross-host trace.json, offsetting each shard's timestamps to the
+# earliest origin and remapping pids into per-shard strides so tracks
+# never collide. On one machine (the simulated-mesh harness) monotonic
+# is system-wide, so the merged timeline is exact; across real hosts
+# the residual error is clock skew between their monotonic clocks —
+# fine for attribution (per-shard shares use each shard's own events)
+# and for eyeballing, not for cross-host causality.
+# ---------------------------------------------------------------------------
+
+#: Per-shard merged-trace artifact naming — owned here like the spool
+#: convention (note: `.json`, not a `.jsonl` spool).
+SHARD_TRACE_PREFIX = "trace-shard"
+
+#: pid stride separating shard tracks in the merged trace: real pids
+#: stay readable modulo the stride, and two hosts' identical pids
+#: can't fold into one track.
+_SHARD_PID_STRIDE = 1 << 24
+
+
+def shard_trace_path(store_base, shard: int) -> Path:
+    return Path(store_base) / f"{SHARD_TRACE_PREFIX}{shard}.json"
+
+
+def shard_spool_dir(store_base, shard: int) -> Path:
+    """Worker-spool subdirectory for ONE mesh shard. Spool files are
+    keyed by pid, and two HOSTS' pool workers can share a pid (small
+    container pid namespaces), so concurrent shards spooling into the
+    store root would truncate each other's live files — each shard
+    spools into (and cleans, at its own sweep start) its own
+    subdirectory instead; the coordinator removes the dirs after a
+    fully-covered merge."""
+    return Path(store_base) / f"spool-shard{shard}"
+
+
+def export_shard_trace(tracer, store_base, shard: int, n_shards: int,
+                       events: list | None = None) -> Path:
+    """Write one shard's merged Chrome events (its own spans + its
+    worker spools) as `trace-shard<k>.json`, carrying the shard
+    geometry and the tracer's monotonic origin for the cross-host
+    merge."""
+    if events is None:
+        events = merge_traces(tracer, store_base)
+    return atomic_write_text(
+        shard_trace_path(store_base, shard),
+        json.dumps({"traceEvents": events, "displayTimeUnit": "ms",
+                    "shard": shard, "shards": n_shards,
+                    "origin_mono": tracer.origin_mono()}))
+
+
+def load_shard_trace(path) -> dict | None:
+    """One shard trace file -> its dict, or None on any miss/parse
+    failure (a lost shard's file simply never landed)."""
+    try:
+        v = json.loads(Path(path).read_text())
+    except (OSError, json.JSONDecodeError):
+        return None
+    return v if isinstance(v, dict) and "traceEvents" in v else None
+
+
+def merge_shard_traces(store_base, shards):
+    """Fold every present `trace-shard<k>.json` under `store_base`
+    into one cross-host Chrome event list. Returns (merged events,
+    {shard: that shard's own UNSHIFTED events}) — the per-shard map
+    feeds the attribution report's per-shard stage shares, which must
+    be computed on each shard's own timeline."""
+    per_shard: dict[int, list] = {}
+    loads = []
+    for k in shards:
+        d = load_shard_trace(shard_trace_path(store_base, k))
+        if d is None:
+            continue
+        per_shard[k] = d["traceEvents"]
+        loads.append((k, d))
+    if not loads:
+        return [], per_shard
+    origins = [d["origin_mono"] for _k, d in loads
+               if isinstance(d.get("origin_mono"), (int, float))]
+    o0 = min(origins) if origins else 0.0
+    meta_evs: list[dict] = []
+    x_evs: list[dict] = []
+    for k, d in loads:
+        om = d.get("origin_mono")
+        shift_us = (om - o0) * 1e6 \
+            if isinstance(om, (int, float)) else 0.0
+        for e in d["traceEvents"]:
+            if not isinstance(e, dict):
+                continue
+            e = dict(e)
+            try:
+                e["pid"] = k * _SHARD_PID_STRIDE + int(e.get("pid", 0))
+            except (TypeError, ValueError):
+                e["pid"] = k * _SHARD_PID_STRIDE
+            if e.get("ph") == "M":
+                if e.get("name") == "process_name":
+                    args = dict(e.get("args") or {})
+                    # the host id rides the track name: every process
+                    # track of shard k reads "shard<k>:<name>"
+                    args["name"] = f"shard{k}:{args.get('name', '')}"
+                    e["args"] = args
+                meta_evs.append(e)
+            else:
+                e["ts"] = float(e.get("ts", 0.0)) + shift_us
+                x_evs.append(e)
+    x_evs.sort(key=lambda e: e["ts"])
+    return meta_evs + x_evs, per_shard
+
+
+# ---------------------------------------------------------------------------
 # Optional jax.profiler capture (JEPSEN_TPU_JAX_PROFILE=1)
 # ---------------------------------------------------------------------------
 

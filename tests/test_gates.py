@@ -50,6 +50,10 @@ ALL_GATES = [
     "JEPSEN_TPU_DONATE_BUFFERS",
     "JEPSEN_TPU_AOT_CACHE",
     "JEPSEN_TPU_COMPILE_CACHE_DIR",
+    "JEPSEN_TPU_MESH",
+    "JEPSEN_TPU_MESH_SHARD",
+    "JEPSEN_TPU_MESH_SHARDS",
+    "JEPSEN_TPU_MESH_WAIT_S",
     "JEPSEN_TPU_STRICT",
     "JEPSEN_TPU_DISPATCH_TIMEOUT_S",
     "JEPSEN_TPU_FAULT_INJECT",

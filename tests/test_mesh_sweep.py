@@ -1,0 +1,327 @@
+"""Multi-host sharded sweeps (`analyze-store --mesh`): shard identity,
+per-shard journals, cross-host resume after SIGKILL, lost-shard
+degradation, and the merged attribution report.
+
+The simulated fleet here is env-shard mode (JEPSEN_TPU_MESH_SHARDS /
+_SHARD per process — the coordinator-free identity path); the
+jax.distributed identity path is exercised by the multihost dryrun
+(tests/test_multihost.py / __graft_entry__._dryrun_mesh_sweep)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from jepsen_tpu import mesh as meshmod  # noqa: E402
+from jepsen_tpu.checker.elle.synth import write_synth_store  # noqa: E402
+from jepsen_tpu.store import Store, shard_of  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def shard_env(shard: int, shards: int = 2, **extra) -> dict:
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "JEPSEN_TPU_PLATFORM": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+           "JEPSEN_TPU_MESH_SHARDS": str(shards),
+           "JEPSEN_TPU_MESH_SHARD": str(shard),
+           "JEPSEN_TPU_MESH_WAIT_S": "0",
+           # slow, cache-free encodes: the SIGKILL below must land
+           # mid-sweep, and resume evidence must come from the
+           # journal, not warm sidecars
+           "JEPSEN_TPU_ENCODE_CACHE": "0",
+           "JEPSEN_TPU_NO_NATIVE": "1",
+           **{k: str(v) for k, v in extra.items()}}
+    for k in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+              "JAX_NUM_PROCESSES", "JAX_PROCESS_ID"):
+        env.pop(k, None)
+    return env
+
+
+def run_shard(store: Path, shard: int, *args, shards: int = 2,
+              timeout: float = 600, **envx):
+    return subprocess.run(
+        [sys.executable, "-m", "jepsen_tpu.cli", "analyze-store",
+         "--store", str(store), "--mesh", *args],
+        cwd=REPO, env=shard_env(shard, shards, **envx),
+        capture_output=True, text=True, timeout=timeout)
+
+
+def dir_lines(out: str) -> list[str]:
+    """The per-run verdict lines a sweep printed (journal-style
+    {"dir": ...} JSON), as store-relative run keys."""
+    got = []
+    for ln in (out or "").splitlines():
+        try:
+            e = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(e, dict) and "dir" in e and "mesh" not in e:
+            got.append(e["dir"])
+    return got
+
+
+def rel_keys(store: Path, dirs) -> set[str]:
+    return {os.path.relpath(d, store) for d in dirs}
+
+
+def journal_dirs(store: Path, shard: int) -> set[str]:
+    p = meshmod.shard_journal_path(store, shard)
+    out = set()
+    if p.exists():
+        for ln in p.read_text().splitlines():
+            try:
+                out.add(json.loads(ln)["dir"])
+            except (json.JSONDecodeError, KeyError):
+                continue
+    return out
+
+
+def events_of(store: Path, kind: str) -> list[dict]:
+    p = store / "events.jsonl"
+    if not p.exists():
+        return []
+    out = []
+    for ln in p.read_text().splitlines():
+        try:
+            e = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if e.get("event") == kind:
+            out.append(e)
+    return out
+
+
+@pytest.fixture(scope="module")
+def killed_fleet(tmp_path_factory):
+    """The one expensive fixture: a 2-shard fleet where shard 1 is
+    SIGKILLed mid-sweep, then the fleet is resumed shard-by-shard.
+    Returns everything the tests below assert on."""
+    store = tmp_path_factory.mktemp("mesh") / "store"
+    (store / "synth").mkdir(parents=True)
+    dirs = write_synth_store(store / "synth", 160, 60, 6, 0)
+    by_shard = {0: set(), 1: set()}
+    for d in dirs:
+        key = os.path.relpath(d, store)
+        by_shard[shard_of(key, 2)].add(key)
+    assert by_shard[0] and by_shard[1], "degenerate hash split"
+
+    # -- phase A: shard 1 sweeps, SIGKILLed once its journal grows --
+    p1 = subprocess.Popen(
+        [sys.executable, "-m", "jepsen_tpu.cli", "analyze-store",
+         "--store", str(store), "--mesh"],
+        cwd=REPO, env=shard_env(1), stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL)
+    jp = meshmod.shard_journal_path(store, 1)
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if jp.exists() and jp.stat().st_size > 0:
+            break
+        if p1.poll() is not None:
+            break
+        time.sleep(0.002)
+    if p1.poll() is None:
+        p1.send_signal(signal.SIGKILL)
+    p1.wait(timeout=60)
+    pre_kill = journal_dirs(store, 1)
+    assert pre_kill, "shard 1 journaled nothing before the kill"
+    assert pre_kill <= by_shard[1]
+
+    # -- phase B: shard 0 (the SURVIVING shard + coordinator) sweeps
+    # to completion; with wait 0 the dead shard is LOST, not fatal --
+    pb = run_shard(store, 0)
+    # -- journal-only evidence for the resumes below: strip every
+    # per-run marker the completed sweeps left (PR-4 contract: an
+    # interrupted fleet may die between the journal append and any
+    # run-dir artifact) --
+    for d in dirs:
+        (d / ".sweep-append").unlink(missing_ok=True)
+        (d / "results.json").unlink(missing_ok=True)
+
+    # -- phase C: the dead shard re-assigned (same index, "another
+    # host") and resumed --
+    pc = run_shard(store, 1, "--resume")
+
+    # -- phase D: the surviving shard resumed + merged with report --
+    pd = run_shard(store, 0, "--resume", "--report")
+
+    return {"store": store, "by_shard": by_shard,
+            "pre_kill": pre_kill, "pb": pb, "pc": pc, "pd": pd}
+
+
+def test_kill_one_shard_survivor_completes(killed_fleet):
+    """The surviving shard's own sweep completes and classifies the
+    dead shard as LOST (exit 2 — unverdicted runs are unknown, never
+    a dead sweep), recorded in the flight recorder."""
+    f = killed_fleet
+    assert f["pb"].returncode == 2, f["pb"].stderr[-500:]
+    lost = events_of(f["store"], "shard_lost")
+    assert any(e.get("shard") == 1 for e in lost)
+    # the surviving shard verdicted exactly its own assignment
+    assert rel_keys(f["store"], dir_lines(f["pb"].stdout)) \
+        == f["by_shard"][0]
+    assert journal_dirs(f["store"], 0) == f["by_shard"][0]
+
+
+def test_killed_shard_resumes_from_its_own_journal(killed_fleet):
+    """Re-assigning the dead shard and resuming re-checks ONLY its
+    un-journaled runs: nothing the killed attempt journaled, and
+    nothing from any other shard — journal-only evidence (the per-run
+    markers were stripped)."""
+    f = killed_fleet
+    assert f["pc"].returncode == 0, f["pc"].stderr[-500:]
+    resumed = rel_keys(f["store"],
+                       dir_lines(f["pc"].stdout))
+    assert resumed == f["by_shard"][1] - f["pre_kill"]
+    assert not (resumed & f["pre_kill"])
+    assert not (resumed & f["by_shard"][0])
+    # the journal now covers the whole shard, each run exactly once
+    assert journal_dirs(f["store"], 1) == f["by_shard"][1]
+    assert events_of(f["store"], "sweep_resume")
+
+
+def test_surviving_shard_resume_rechecks_zero_runs(killed_fleet):
+    """The acceptance pin: resuming the SURVIVING shard re-checks
+    zero runs — its journal alone carries the evidence — and the
+    coordinator now merges a complete fleet (exit 0: every history
+    valid)."""
+    f = killed_fleet
+    assert dir_lines(f["pd"].stdout) == []
+    assert "nothing to resume" in f["pd"].stderr
+    assert f["pd"].returncode == 0, f["pd"].stderr[-500:]
+    merged = meshmod.merge_journals(f["store"], 2, "append")
+    assert set(merged) == f["by_shard"][0] | f["by_shard"][1]
+
+
+def test_merged_report_carries_per_shard_shares(killed_fleet):
+    """The merged report.json: per-shard stage shares summing to
+    ~1.0 per shard (each shard's decomposition runs on its own
+    timeline), built from shard 0's original sweep trace and shard
+    1's resumed sweep trace — a no-op resume preserves the previous
+    evidence instead of overwriting it with an empty trace."""
+    f = killed_fleet
+    rep = json.loads((f["store"] / "report.json").read_text())
+    per_shard = rep.get("per_shard", {})
+    assert set(per_shard) == {"0", "1"}
+    for k, sr in per_shard.items():
+        total = sum(sr["shares"].values())
+        assert abs(total - 1.0) < 0.01, (k, sr["shares"])
+        assert sr["wall_secs"] > 0
+    # the merged cross-host trace exists and carries both shards'
+    # tracks (shard id in the track name)
+    tr = json.loads((f["store"] / "trace.json").read_text())
+    names = {e["args"]["name"] for e in tr["traceEvents"]
+             if e.get("name") == "process_name"}
+    assert any(n.startswith("shard0:") for n in names)
+    assert any(n.startswith("shard1:") for n in names)
+
+
+def test_mesh_summary_line_counts(killed_fleet):
+    """The coordinator's one-line merged summary: every run verdicted,
+    none invalid (the store is all-valid), no lost shards."""
+    f = killed_fleet
+    summaries = [json.loads(ln) for ln in f["pd"].stdout.splitlines()
+                 if ln.startswith("{") and "\"mesh\"" in ln]
+    assert summaries, f["pd"].stdout[-500:]
+    s = summaries[-1]
+    assert s["runs_verdicted"] == 160
+    assert s["invalid"] == 0 and s["unknown"] == 0
+    assert s["lost_shards"] == []
+    assert s["valid?"] is True
+
+
+def test_crashed_shard_marker_floors_exit_at_unknown(tmp_path):
+    """A done marker whose exit code is not a validity code (a shard
+    that CRASHED mid-sweep) must read like a lost shard — runs are
+    unverdicted, exit floors at 2 — never as a completed shard whose
+    missing runs silently vanish from the merge. The independent
+    completeness backstop (journals vs the full store walk) reports
+    the unaccounted runs too."""
+    from jepsen_tpu import supervisor as sv
+    store = tmp_path / "store"
+    (store / "synth").mkdir(parents=True)
+    write_synth_store(store / "synth", 12, 40, 4, 0)   # all valid
+    sv.mark_shard_done(store, 1, {"shard": 1, "shards": 2,
+                                  "checker": "append",
+                                  "exit_code": "crashed"})
+    p = run_shard(store, 0)
+    assert p.returncode == 2, p.stderr[-400:]
+    s = [json.loads(ln) for ln in p.stdout.splitlines()
+         if ln.startswith("{") and "\"mesh\"" in ln][-1]
+    assert s["crashed_shards"] == [1]
+    assert s["unaccounted"] > 0
+    assert s["valid?"] is False
+
+
+def test_out_of_range_shard_index_is_rejected(tmp_path):
+    """A shard index >= the count is operator error (a wrapped index
+    would silently race another LIVE shard's journal): the sweep must
+    refuse, not alias. A bare index with no count at all is equally
+    ambiguous and equally refused."""
+    store = tmp_path / "store"
+    (store / "synth").mkdir(parents=True)
+    write_synth_store(store / "synth", 2, 40, 4, 0)
+    p = run_shard(store, 2, shards=2)
+    assert p.returncode == 255
+    assert "out of range" in (p.stderr or "")
+    env = shard_env(1)
+    env.pop("JEPSEN_TPU_MESH_SHARDS")
+    p = subprocess.run(
+        [sys.executable, "-m", "jepsen_tpu.cli", "analyze-store",
+         "--store", str(store), "--mesh"],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=300)
+    assert p.returncode == 255
+    assert "no shard count" in (p.stderr or "")
+
+
+def test_stale_done_marker_classified_incomplete(tmp_path):
+    """A done marker is a liveness hint, not evidence: the merge
+    classifies each shard by its journal's coverage of its hash
+    assignment, so last sweep's marker lingering while the shard's
+    journal is gone (a fresh fleet whose host died before journaling)
+    reads as INCOMPLETE — exit 2 — never as a completed shard."""
+    store = tmp_path / "store"
+    (store / "synth").mkdir(parents=True)
+    write_synth_store(store / "synth", 12, 40, 4, 0)
+    # a full fleet pass leaves both journals + both markers
+    p1 = run_shard(store, 1)
+    p0 = run_shard(store, 0)
+    assert (p0.returncode, p1.returncode) == (0, 0)
+    # simulate the NEXT fleet launch where shard 1's host dies before
+    # journaling anything: its journal is gone, last sweep's marker
+    # lingers
+    meshmod.shard_journal_path(store, 1).unlink()
+    p = run_shard(store, 0, "--resume")
+    assert p.returncode == 2, p.stderr[-400:]
+    s = [json.loads(ln) for ln in p.stdout.splitlines()
+         if ln.startswith("{") and "\"mesh\"" in ln][-1]
+    assert s["incomplete_shards"] == [1]
+    assert s["unaccounted"] > 0
+
+
+def test_empty_shard_is_not_a_usage_error(tmp_path):
+    """A shard the hash split left empty completes with exit 0 (the
+    coordinator still needs its done marker), while an empty STORE
+    stays the usage error it always was (254)."""
+    store = tmp_path / "store"
+    (store / "synth").mkdir(parents=True)
+    write_synth_store(store / "synth", 1, 40, 4, 0)
+    key = os.path.relpath(
+        next(iter(Store(store).iter_run_dirs())), store)
+    # a NON-coordinator empty shard (shard 0 would also wait on the
+    # never-run fleet and report it lost — a different contract)
+    empty = max({1, 2, 3} - {shard_of(key, 4)})
+    p = run_shard(store, empty, shards=4)
+    assert p.returncode == 0, p.stderr[-400:]
+    assert "no runs assigned" in p.stderr
+    p = run_shard(tmp_path / "nostore", 1)
+    assert p.returncode == 254

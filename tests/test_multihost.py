@@ -15,15 +15,38 @@ def test_two_process_multihost_dryrun():
     summary = g.dryrun_multihost(2, 2)   # 2 procs x 2 devices = 4 global
     assert summary.count("MULTIHOST_WORKER_OK") == 2
     assert "pid=0/2" in summary and "pid=1/2" in summary
+    # the REAL analyze-store --mesh CLI path: both processes
+    # rendezvous through jax.distributed, sweep their hash-assigned
+    # shard of a synthetic store, and every run's results.json/.edn
+    # is byte-identical to a single-process sweep of the same store
+    assert "MESH_SWEEP_OK" in summary
+    assert "shards=2 runs=6 byte_identical=12" in summary
+
+
+def test_mesh_sweep_cli_two_process():
+    """The mesh-sweep CLI dryrun ALONE: unlike the classify step
+    above, `analyze-store --mesh` performs no cross-process
+    computation (each shard dispatches on its own local devices; the
+    cross-host axis is the shard split), so it must work even on
+    jaxlib builds whose CPU backend lacks multiprocess collectives —
+    the two processes still rendezvous through jax.distributed for
+    shard identity and the coordinator still merges."""
+    import __graft_entry__ as g
+    summary = g._dryrun_mesh_sweep(2, 2)
+    assert "MESH_SWEEP_OK" in summary
+    assert "shards=2 runs=6 byte_identical=12" in summary
+    assert "rc=1" in summary   # the seeded G1c runs fail the fleet
 
 
 def test_multihost_non_power_of_two_devices():
     """factor2's squarest dp×mp split can straddle processes for
     non-power-of-2 device counts (6 devices / 2 procs -> dp 3); the
     worker must pick a process-aligned mesh instead of crashing on
-    non-contiguous host-local shards."""
+    non-contiguous host-local shards. (mesh_sweep=False: the CLI-path
+    dryrun above already covers the sweep; this test pins the mesh
+    SHAPE invariant only.)"""
     import __graft_entry__ as g
-    summary = g.dryrun_multihost(2, 3)   # 6 global devices
+    summary = g.dryrun_multihost(2, 3, mesh_sweep=False)  # 6 global
     assert summary.count("MULTIHOST_WORKER_OK") == 2
     assert "devices=6" in summary
     # the invariant itself: dp rows aligned to processes, (2, 3) not

@@ -434,6 +434,26 @@ def test_bench_report_lower_is_better_and_zero_tolerance(tmp_path,
     assert out.count("REGRESSED") == 2
 
 
+def test_bench_report_mesh_efficiency_floor(tmp_path, capsys):
+    """The mesh scaling-efficiency contract: a round whose 2-shard
+    efficiency lands below the declared 0.70 floor regresses even as
+    the FIRST round to report the metric (the ceiling's
+    higher-is-better twin), while a healthy round rides clean."""
+    a = _round(tmp_path / "BENCH_r01.json",
+               {"backend": "cpu",
+                "mesh": {"value": 40.0, "scaling_efficiency": 0.55}})
+    rc = bench_report.report([a])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "mesh 2-shard scaling efficiency" in out
+    assert "floor" in out and "REGRESSED" in out
+    b = _round(tmp_path / "BENCH_r02.json",
+               {"backend": "cpu",
+                "mesh": {"value": 40.0, "scaling_efficiency": 0.82}})
+    assert bench_report.report([b]) == 0
+    capsys.readouterr()
+
+
 def test_bench_report_cross_backend_not_compared(tmp_path, capsys):
     a = _round(tmp_path / "BENCH_r01.json",
                {"backend": "cpu", "value": 100.0})

@@ -1,7 +1,7 @@
 """Store layout and round-trip tests."""
 
 from jepsen_tpu import history as h
-from jepsen_tpu.store import Store
+from jepsen_tpu.store import Store, shard_of
 
 
 def sample_test():
@@ -61,3 +61,85 @@ def test_tests_registry(tmp_path):
     reg = store.tests()
     assert "store-test" in reg
     assert "20260101T000000.000" in reg["store-test"]
+
+
+# ---------------------------------------------------------------------------
+# The streaming, shard-assignable store walk (iter_run_dirs/shard_of)
+# ---------------------------------------------------------------------------
+
+def _synth_walk_store(base, names=("aero", "etcd", "mongo", "tidb"),
+                      per_name=2500):
+    """A ~10k-dir synthetic store: run DIRS only (the walk never opens
+    a file), plus the latest/current symlinks the walk must skip."""
+    for nm in names:
+        nd = base / nm
+        nd.mkdir(parents=True)
+        for j in range(per_name):
+            (nd / f"2026{j:05d}T000000").mkdir()
+    (base / "latest").symlink_to(f"{names[0]}/202600000T000000")
+    (base / "current").symlink_to(f"{names[0]}/202600000T000000")
+    (base / names[0] / "latest").symlink_to("202600000T000000")
+    return len(names) * per_name
+
+
+def test_iter_run_dirs_walks_10k_dir_store(tmp_path):
+    """The lazy walk over a ~10k-dir store: same set and order as the
+    legacy tests()-based listing, symlinks skipped, name filter
+    honored, and the iterator is a generator (nothing materialized
+    until consumed)."""
+    base = tmp_path / "store"
+    total = _synth_walk_store(base)
+    # a run SYMLINKED from another name dir is a real run (a store
+    # assembled by linking runs from another volume) — the walk must
+    # follow it, exactly like the legacy tests() listing
+    (base / "etcd" / "2026linkedT000000").symlink_to(
+        base / "mongo" / "202600000T000000")
+    total += 1
+    store = Store(base)
+    it = store.iter_run_dirs()
+    assert iter(it) is it            # a true lazy generator
+    walked = list(it)
+    assert len(walked) == total == 10_001
+    legacy = [d for runs in store.tests().values()
+              for d in runs.values()]
+    assert walked == sorted(legacy)
+    assert all(d.name != "latest" for d in walked)
+    only = list(store.iter_run_dirs(name="etcd"))
+    assert len(only) == 2501          # 2500 + the symlinked run
+    assert all(d.parent.name == "etcd" for d in only)
+
+
+def test_shard_walk_partitions_completely(tmp_path):
+    """The mesh split: shards partition the walk exactly (complete +
+    disjoint), deterministically across repeated walks, and agree
+    with shard_of over the store-relative key (the journal's key)."""
+    import os
+    base = tmp_path / "store"
+    total = _synth_walk_store(base, per_name=250)
+    store = Store(base)
+    n = 4
+    shards = [list(store.iter_run_dirs(shard=k, n_shards=n))
+              for k in range(n)]
+    assert sum(len(s) for s in shards) == total
+    seen = set()
+    for k, dirs in enumerate(shards):
+        for d in dirs:
+            assert d not in seen
+            seen.add(d)
+            assert shard_of(os.path.relpath(d, base), n) == k
+    # no empty shard at this size, and the split is stable
+    assert all(shards)
+    assert shards[1] == list(store.iter_run_dirs(shard=1, n_shards=n))
+
+
+def test_shard_of_is_pinned():
+    """The assignment hash is a RESUME contract: a changed hash would
+    silently re-partition a half-swept store, re-checking and
+    double-journaling runs across shards. Pin sample values (xxh64,
+    seed 0, utf-8 key) so any change is a visible diff."""
+    assert shard_of("etcd/20200101T000000", 1) == 0
+    assert shard_of("etcd/20200101T000000", 2) == 0
+    assert shard_of("etcd/20200101T000000", 4) == 2
+    assert shard_of("etcd/20200101T000000", 8) == 6
+    assert shard_of("synth/run-0000", 8) == 4
+    assert shard_of("synth/run-0042", 8) == 4
