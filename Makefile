@@ -9,7 +9,7 @@ TSAN_RT := $(shell gcc -print-file-name=libtsan.so)
 
 .PHONY: lint lint-json lint-changed env-table rule-table test native \
 	native-sanitize bench bench-report bench-warm obs-smoke \
-	trace-report
+	trace-report cost-report
 
 # Self-hosted static analysis: gate registry, JAX hazards, concurrency
 # discipline, shm lifecycle, tracer discipline, plus the cross-boundary
@@ -115,3 +115,11 @@ obs-smoke:
 STORE ?= store
 trace-report:
 	$(PY) -m jepsen_tpu.cli analyze-store --store $(STORE) --report
+
+# trace-report with the device cost observatory on: additionally
+# appends per-(executable, geometry) XLA-cost × measured-window
+# records to <store>/costdb.jsonl (provenance-tagged) and adds the
+# device roofline section to the report.
+cost-report:
+	JEPSEN_TPU_COSTDB=1 \
+	  $(PY) -m jepsen_tpu.cli analyze-store --store $(STORE) --report

@@ -694,6 +694,7 @@ def bench_north_star(n_dev: int, devices) -> dict:
 
     root = Path(tempfile.mkdtemp(prefix="bench-ns-"))
     _cache_prev = os.environ.get("JEPSEN_TPU_ENCODE_CACHE")
+    _costdb_prev = os.environ.get("JEPSEN_TPU_COSTDB")
     try:
         dirs = _write_synth_store(root, B, T, K, bad_every)
         mesh = parallel.make_mesh(devices) if n_dev > 1 else None
@@ -867,6 +868,17 @@ def bench_north_star(n_dev: int, devices) -> dict:
                                          "other_secs")
                                if k in rep["stalls"]}}
 
+        # The device cost observatory rides the timed sweeps: each
+        # compiled executable's XLA cost/memory analyses joined with
+        # its measured dispatch windows (jepsen_tpu/obs/device.py) —
+        # the bench retains the records under bench_artifacts/ as
+        # planner training data and reports the achieved-bandwidth
+        # share below. Per-dispatch overhead is a dict probe; the
+        # compile-time capture happened in the warmup above.
+        from jepsen_tpu.obs import device as device_obs
+        os.environ["JEPSEN_TPU_COSTDB"] = "1"
+        device_obs.reset()
+
         # Timed region = the COLD streaming sweep: every run dir
         # misses the encoded cache, parses, and leaves a sidecar.
         with prof_cm:
@@ -968,16 +980,47 @@ def bench_north_star(n_dev: int, devices) -> dict:
             except Exception as e:
                 rounds, rounds_src = 5.0, f"fallback: {e!r}"[:120]
         # peak throughput of the formulation the sweep ACTUALLY ran:
-        # the auto default is the int8 closure (resolve_formulation),
-        # whose v5e MXU peak is 394 TOPS — not bf16's 197 TFLOPS
+        # the auto default is the int8 closure (resolve_formulation).
+        # The peak itself now comes from the device_kind-keyed table
+        # (kernels.device_peak) instead of hard-coded v5e numbers —
+        # on an unknown/CPU device the v5e row still applies, but the
+        # artifact SAYS so (`peak` block below: source "fallback")
+        # instead of silently assuming. BENCH_PEAK_TFLOPS overrides.
         use_pallas_f, use_int8_f = K_.resolve_formulation(
             single_device=mesh is None)
+        peak_row = K_.device_peak()
+        peak_tflops = (peak_row["int8_tops"] if use_int8_f
+                       else peak_row["bf16_tflops"])
         peak = float(os.environ.get(
-            "BENCH_PEAK_TFLOPS", 394 if use_int8_f else 197)) * 1e12
+            "BENCH_PEAK_TFLOPS", peak_tflops)) * 1e12
         mfu = (B * rounds * 2 * t_pad ** 3) / (t_check * peak * n_dev) \
             if accel else None
         formulation = (("pallas" if use_pallas_f else "xla")
                        + ("-int8" if use_int8_f else "-bf16"))
+        # the cost observatory's sweep-level roofline: total bytes
+        # accessed (per XLA's own cost model) over total measured
+        # device seconds, against the peak-table HBM bandwidth. On a
+        # CPU host the windows are host wall time, not TPU time, so
+        # the block is tagged estimated AND carries "error" — the
+        # PR-6 outage convention, bench-report reads it as a dash,
+        # never as a zero.
+        cost_recs = device_obs.records()
+        device_cost = None
+        if cost_recs:
+            device_cost = {"records": len(cost_recs),
+                           **(device_obs.bandwidth_share(cost_recs)
+                              or {})}
+            if device_cost.get("provenance") != "measured":
+                device_cost["error"] = ("estimated provenance: no "
+                                        "accelerator-measured windows")
+            try:
+                from jepsen_tpu.store import append_costdb
+                art = Path("bench_artifacts")
+                art.mkdir(exist_ok=True)
+                append_costdb(art / "costdb.jsonl", cost_recs)
+                device_cost["costdb_path"] = str(art / "costdb.jsonl")
+            except Exception:
+                pass
         phase_out = {k: round(phases.get(k, 0.0), 3)
                      for k in ("parse", "feed", "pack", "h2d",
                                "dispatch", "collect", "render")}
@@ -1060,12 +1103,26 @@ def bench_north_star(n_dev: int, devices) -> dict:
                          f"{'int8' if use_int8_f else 'bf16'} ops, "
                          f"peak {peak / 1e12:g} "
                          f"{'TOPS' if use_int8_f else 'TFLOPS'}/chip",
+            # which peak the MFU denominator used — device_kind-keyed
+            # table row, or the documented v5e fallback, never silent
+            "peak": {"device_kind": peak_row["device_kind"],
+                     "source": peak_row["source"],
+                     "tflops_used": round(peak / 1e12, 1),
+                     "hbm_gbps": peak_row["hbm_gbps"]},
+            # the cost observatory's achieved-bandwidth roofline for
+            # this round (estimated-provenance rounds carry "error":
+            # an outage to bench-report, not a zero)
+            "device_cost": device_cost,
         }
     finally:
         if _cache_prev is None:
             os.environ.pop("JEPSEN_TPU_ENCODE_CACHE", None)
         else:
             os.environ["JEPSEN_TPU_ENCODE_CACHE"] = _cache_prev
+        if _costdb_prev is None:
+            os.environ.pop("JEPSEN_TPU_COSTDB", None)
+        else:
+            os.environ["JEPSEN_TPU_COSTDB"] = _costdb_prev
         shutil.rmtree(root, ignore_errors=True)
 
 

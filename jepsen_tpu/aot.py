@@ -68,6 +68,14 @@ def clear_memory() -> None:
         _mem.clear()
 
 
+def resident_count() -> int:
+    """How many compiled executables the in-process map holds — the
+    `resident_executables` gauge the device cost observatory
+    publishes (obs.device via residency.publish_residency_gauges)."""
+    with _lock:
+        return len(_mem)
+
+
 def _fingerprint(args, key_parts: tuple) -> str:
     """Digest of everything that determines the compiled artifact:
     toolchain versions, backend topology, input avals, kernel flags."""
@@ -127,6 +135,12 @@ def compiled_for(jitfn, args, key_parts: tuple):
             hit = _mem.get(key)
         if hit is not None:
             trace.counter("compile_cache_hits").inc()
+            # re-observe on memory hits too: the observatory resets
+            # per sweep, and a later sweep's costdb must still carry
+            # the resident executables it dispatched (dict probe once
+            # captured; nothing with JEPSEN_TPU_COSTDB off)
+            from .obs import device as device_obs
+            device_obs.observe(key_parts, args, hit, source="compiled")
             return hit
         path = cache_dir() / f"{key}.jtx"
         compiled = _disk_load(path)
@@ -140,6 +154,12 @@ def compiled_for(jitfn, args, key_parts: tuple):
             if len(_mem) >= _MEM_CAP:
                 _mem.pop(next(iter(_mem)))
             _mem[key] = compiled
+        # the device cost observatory's capture point: the compiled
+        # executable's cost/memory analyses, once per (key_parts,
+        # batch) — a dict probe on repeats, nothing at all with the
+        # JEPSEN_TPU_COSTDB gate off
+        from .obs import device as device_obs
+        device_obs.observe(key_parts, args, compiled, source="compiled")
         return compiled
     except Exception:
         log.warning("AOT executable cache failed; dispatching via jit",

@@ -42,6 +42,62 @@ from .encode import EncodedHistory, effective_complete_index
 G0, G1C, G_SINGLE, G2_ITEM, CYCLE = 0, 1, 2, 3, 4
 FLAG_NAMES = {G0: "G0", G1C: "G1c", G_SINGLE: "G-single", G2_ITEM: "G2-item"}
 
+#: Per-chip peak throughput, keyed by a normalized `device_kind`. The
+#: MFU/roofline numbers used to assume v5e (394 int8 TOPS hard-coded in
+#: bench.py) whatever chip actually ran; now the peak resolves from
+#: `jax.devices()[0].device_kind` with the v5e row as the DOCUMENTED
+#: fallback — and every consumer (bench artifact, costdb record, report
+#: device section) surfaces WHICH peak it used (`source: table` vs
+#: `fallback`), so an assumed number can never read as a measured one.
+#: Values are the published per-chip peaks: dense bf16 TFLOPS, int8
+#: TOPS (chips without an int8 fast path reuse the bf16 number — the
+#: closure is exact in either arithmetic, see _closure_batched), HBM
+#: bandwidth GB/s and capacity GiB.
+DEVICE_PEAKS: dict[str, dict] = {
+    "tpu v2": {"bf16_tflops": 45.0, "int8_tops": 45.0,
+               "hbm_gbps": 700.0, "hbm_gib": 16.0},
+    "tpu v3": {"bf16_tflops": 123.0, "int8_tops": 123.0,
+               "hbm_gbps": 900.0, "hbm_gib": 32.0},
+    "tpu v4": {"bf16_tflops": 275.0, "int8_tops": 275.0,
+               "hbm_gbps": 1228.0, "hbm_gib": 32.0},
+    "tpu v5 lite": {"bf16_tflops": 197.0, "int8_tops": 394.0,
+                    "hbm_gbps": 819.0, "hbm_gib": 16.0},
+    "tpu v5p": {"bf16_tflops": 459.0, "int8_tops": 918.0,
+                "hbm_gbps": 2765.0, "hbm_gib": 95.0},
+    "tpu v6 lite": {"bf16_tflops": 918.0, "int8_tops": 1836.0,
+                    "hbm_gbps": 1640.0, "hbm_gib": 32.0},
+}
+
+#: Spelling aliases libtpu has shipped for the same chips.
+_PEAK_ALIASES = {"tpu v5e": "tpu v5 lite", "tpu v5": "tpu v5p",
+                 "tpu v6e": "tpu v6 lite", "tpu v6": "tpu v6 lite"}
+
+#: The documented fallback row for unknown/CPU device kinds — the v5e
+#: values every pre-peak-table number assumed.
+_PEAK_FALLBACK = "tpu v5 lite"
+
+
+def device_peak(device_kind: str | None = None) -> dict:
+    """The peak-throughput row for `device_kind` (default: the first
+    jax device's), plus `device_kind` (as reported) and `source`:
+    `"table"` for a known chip, `"fallback"` when the kind is unknown
+    (CPU hosts, new chips) and the v5e row is assumed — consumers must
+    surface that instead of publishing an assumed peak as measured."""
+    if device_kind is None:
+        try:
+            device_kind = jax.devices()[0].device_kind
+        except Exception:
+            device_kind = "unknown"
+    norm = str(device_kind).strip().lower()
+    norm = _PEAK_ALIASES.get(norm, norm)
+    row = DEVICE_PEAKS.get(norm)
+    if row is not None:
+        return {"device_kind": str(device_kind), "source": "table",
+                **row}
+    return {"device_kind": str(device_kind),
+            "source": f"fallback (assumed {_PEAK_FALLBACK})",
+            **DEVICE_PEAKS[_PEAK_FALLBACK]}
+
 
 def pad_to(x: int, multiple: int) -> int:
     """Round x up to a positive multiple."""

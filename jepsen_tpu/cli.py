@@ -393,7 +393,8 @@ def analyze_store(store: Store, checker: str = "append",
     from . import obs
     from . import shm as _shm
     from . import supervisor as sv
-    from .store import VerdictJournal
+    from .obs import device as device_obs
+    from .store import VerdictJournal, costdb_path
     if report is None:
         report = gates.get("JEPSEN_TPU_REPORT")
     if mesh is None:
@@ -406,6 +407,10 @@ def analyze_store(store: Store, checker: str = "append",
         # track of this shard's trace carries it after the merge
         run_name = f"{run_name}@shard{shard}/{n_shards}"
     tr = trace.fresh_run(run_name, scope="sweep")
+    # the device cost observatory is per-sweep state like the tracer:
+    # a fresh sweep must not inherit a previous sweep's records or
+    # half-open dispatch windows (no-op-cheap; gate read at capture)
+    device_obs.reset()
     if getattr(tr, "enabled", False) and store.base.is_dir():
         # point the worker trace fabric at the store: pool workers
         # spool spans to <spool_dir>/trace-<pid>.jsonl; stale spools
@@ -454,6 +459,23 @@ def analyze_store(store: Store, checker: str = "append",
             sampler.stop()
         if server is not None:
             server.stop()
+        if store.base.is_dir():
+            # the costdb lands whether or not tracing was on: the
+            # observatory's windows are measured with perf_counter
+            # directly, and the planner's training data must not
+            # depend on the trace gate. flush() is a no-op (zero
+            # files) with JEPSEN_TPU_COSTDB off. It runs BEFORE
+            # reset_events so its costdb_flush mark reaches the
+            # flight recorder.
+            try:
+                n_cost = device_obs.flush(
+                    costdb_path(store.base, shard if mesh else None))
+                if n_cost:
+                    print(f"costdb: {n_cost} record(s) appended to "
+                          f"{costdb_path(store.base, shard if mesh else None)}",
+                          file=sys.stderr)
+            except Exception:
+                log.warning("costdb flush failed", exc_info=True)
         obs.reset_events()
         if getattr(tr, "enabled", False) and store.base.is_dir():
             try:
@@ -489,7 +511,10 @@ def analyze_store(store: Store, checker: str = "append",
                     if report:
                         from .obs import attribution
                         rj, _rmd = attribution.write_report(
-                            store.base, evs, tr.metrics_dict())
+                            store.base, evs, tr.metrics_dict(),
+                            device_records=(device_obs.records()
+                                            if device_obs.enabled()
+                                            else None))
                         print(f"report written to {rj}",
                               file=sys.stderr)
             except Exception:

@@ -163,6 +163,19 @@ _g("JEPSEN_TPU_METRICS_PORT", "int", None,
    "serve `/metrics` (Prometheus text exposition) + `/healthz` (the "
    "health snapshot) on this port during a sweep; `0` binds an "
    "ephemeral port; unset = off")
+_g("JEPSEN_TPU_COSTDB", "bool", False,
+   "set: the device cost observatory — capture each executable's XLA "
+   "`cost_analysis()`/`memory_analysis()` once per compile, join it "
+   "with the measured per-dispatch device windows, publish the "
+   "residency gauges, append one record per (executable, geometry) "
+   "to `<store>/costdb.jsonl` at sweep end, and add the device "
+   "roofline section to `--report`; off (the default) writes zero "
+   "new files and costs <1µs per dispatch")
+_g("JEPSEN_TPU_RESIDENCY_INTERVAL_S", "float", 5.0,
+   "minimum seconds between `device.memory_stats()` polls for the "
+   "`hbm_device_bytes` residency gauge (the cheap gauges still "
+   "publish per dispatch); `<=0` disables the poll; only read when "
+   "`JEPSEN_TPU_COSTDB` is on")
 # -- kernels / backend ------------------------------------------------------
 _g("JEPSEN_TPU_BACKEND", "str", None,
    "analysis backend override: `tpu`|`cpu`|`race` (the CLI's "

@@ -239,13 +239,25 @@ def coordinator_merge(store, checker: str, shard: int, n_shards: int,
             "lost_shards": lost, "crashed_shards": crashed,
             "incomplete_shards": incomplete,
             "valid?": worst == 0}))
+        cost_records: list = []
+        if Path(store.base).is_dir():
+            # evidence-driven like the trace merge: shard costdbs
+            # exist iff the shards ran with JEPSEN_TPU_COSTDB — merge
+            # whatever landed into ONE deduplicated costdb.jsonl
+            # (same executable on two shards → one record, windows
+            # summed), independent of the trace gate
+            try:
+                cost_records = merge_costdbs(store.base, n_shards)
+            except Exception:
+                log.warning("mesh costdb merge failed", exc_info=True)
         if tracer is not None and getattr(tracer, "enabled", False) \
                 and Path(store.base).is_dir():
             try:
                 _merge_trace_artifacts(
                     store.base, n_shards, report,
                     fleet_complete=not (lost or crashed or incomplete
-                                        or unaccounted))
+                                        or unaccounted),
+                    device_records=cost_records)
             except Exception:
                 log.warning("mesh trace merge failed", exc_info=True)
         return worst
@@ -253,10 +265,38 @@ def coordinator_merge(store, checker: str, shard: int, n_shards: int,
         obs.reset_events()
 
 
+def merge_costdbs(store_base, n_shards: int) -> list[dict]:
+    """Fold every present per-shard `costdb-shard<k>.jsonl` into one
+    deduplicated `<store>/costdb.jsonl` (obs.device.merge_records:
+    same (executable, geometry) on two shards → one record with the
+    measured windows summed and the roofline re-derived). Returns the
+    merged records ([] when no shard captured any — gate off). The
+    merged file is written atomically: it is a derived artifact, and
+    a repeat merge must replace, not double, the fleet's records."""
+    from . import trace as _trace
+    from .obs import device as device_obs
+    from .store import COSTDB_NAME, costdb_path, load_costdb
+    lists = [load_costdb(costdb_path(store_base, k))
+             for k in range(n_shards)]
+    if not any(lists):
+        return []
+    merged = device_obs.merge_records(lists)
+    _trace.atomic_write_text(
+        Path(store_base) / COSTDB_NAME,
+        "".join(json.dumps(r) + "\n" for r in merged))
+    print(f"merged costdb: {len(merged)} record(s) across "
+          f"{n_shards} shard(s)", file=sys.stderr)
+    return merged
+
+
 def _merge_trace_artifacts(store_base, n_shards: int, report: bool,
-                           fleet_complete: bool = True) -> None:
+                           fleet_complete: bool = True,
+                           device_records: list | None = None) -> None:
     """trace.json / metrics.json / report.{json,md} from the per-shard
-    exports (a lost shard's missing files are skipped, not fatal)."""
+    exports (a lost shard's missing files are skipped, not fatal).
+    `device_records` is the ALREADY-merged costdb set the coordinator
+    just wrote — handed through so the report can never read a stale
+    pre-merge file."""
     from . import trace as _trace
     evs, per_shard = _trace.merge_shard_traces(store_base,
                                                range(n_shards))
@@ -272,7 +312,8 @@ def _merge_trace_artifacts(store_base, n_shards: int, report: bool,
     if report:
         from .obs import attribution
         rj, _md = attribution.write_report(
-            store_base, evs, metrics, per_shard_events=per_shard)
+            store_base, evs, metrics, per_shard_events=per_shard,
+            device_records=device_records or None)
         print(f"merged mesh report written to {rj}", file=sys.stderr)
     # every shard's spans now live in its trace-shard<k>.json export —
     # but ONLY when the whole fleet is accounted for: a lost/crashed/

@@ -41,6 +41,12 @@ This package is that layer, in four stdlib-only pieces:
     accounting, and what-if headroom, persisted by `analyze-store
     --report` as `<store>/report.json` + `report.md` and embedded in
     the bench's north_star/cache_warm blocks.
+  * `device` — the device cost observatory (JEPSEN_TPU_COSTDB,
+    default off): per-executable XLA cost/memory analyses joined
+    with measured dispatch windows, the HBM residency gauges, and
+    the persistent `<store>/costdb.jsonl` the cost-aware planner
+    consumes; `--report` grows a device roofline section from the
+    same records.
 
 The whole package imports nothing but the stdlib (plus `gates` and
 `trace`, themselves stdlib-only); jax is never touched. Everything is
@@ -50,14 +56,14 @@ one `gates.get` per entry point.
 
 from __future__ import annotations
 
-from . import attribution, events
+from . import attribution, device, events
 from .events import EVENT_KINDS, emit, install_events, load_events, reset_events
 from .health import HealthSampler, health_snapshot, maybe_start_health_sampler
 from .prom import MetricsServer, maybe_start_metrics_server, render_prometheus
 
 __all__ = [
     "EVENT_KINDS", "HealthSampler", "MetricsServer", "attribution",
-    "emit", "events", "health_snapshot", "install_events",
+    "device", "emit", "events", "health_snapshot", "install_events",
     "load_events", "maybe_start_health_sampler",
     "maybe_start_metrics_server", "render_prometheus", "reset_events",
 ]

@@ -302,6 +302,9 @@ def render_report_md(rep: dict) -> str:
                 f"{ss.get('device', 0.0):.1%} | "
                 f"{ss.get('encode', 0.0):.1%} | "
                 f"{ss.get('idle', 0.0):.1%} |")
+    dev = rep.get("device") or {}
+    if dev:
+        lines += render_device_md(dev)
     lines += ["", "## What-if", "", f"- {summary_line(rep)}"]
     if rep.get("counters"):
         keep = ("runs_verdicted", "buckets_dispatched", "cache_hits",
@@ -314,6 +317,89 @@ def render_report_md(rep: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
+def device_section(records: list) -> dict | None:
+    """The report's "device" section from the cost observatory's
+    finalized records (jepsen_tpu/obs/device.py — already carrying
+    achieved rates, roofline utilization and provenance, so this stays
+    stdlib-only): one row per (executable, geometry) with measured
+    windows, plus the sweep-level aggregate. None when no records
+    were captured (gate off)."""
+    rows = []
+    provenance = "estimated"
+    peak = None
+    for r in records or []:
+        if not isinstance(r, dict):
+            continue
+        w = r.get("windows") or {}
+        g = r.get("geometry") or {}
+        cost = r.get("cost") or {}
+        ach = r.get("achieved") or {}
+        roof = r.get("roofline") or {}
+        peak = r.get("peak") or peak
+        if r.get("provenance") == "measured":
+            provenance = "measured"
+        rows.append({
+            "geometry": g,
+            "formulation": r.get("formulation"),
+            "analysis": r.get("analysis"),
+            "flops": cost.get("flops"),
+            "bytes_accessed": cost.get("bytes_accessed"),
+            "dispatches": w.get("dispatches", 0),
+            "device_secs": round(w.get("device_secs", 0.0), 6),
+            "histories": w.get("histories", 0),
+            "achieved_tflops": (
+                round(ach["flops_per_sec"] / 1e12, 4)
+                if isinstance(ach.get("flops_per_sec"), (int, float))
+                else None),
+            "achieved_gbps": (
+                round(ach["bytes_per_sec"] / 1e9, 3)
+                if isinstance(ach.get("bytes_per_sec"), (int, float))
+                else None),
+            "flops_utilization": roof.get("flops_utilization"),
+            "bandwidth_utilization": roof.get("bandwidth_utilization"),
+            "provenance": r.get("provenance"),
+        })
+    if not rows:
+        return None
+    return {"records": rows, "peak": peak, "provenance": provenance,
+            "device_secs": round(sum(r["device_secs"] for r in rows),
+                                 6)}
+
+
+def render_device_md(dev: dict) -> list[str]:
+    """The report.md roofline table for one device section."""
+    peak = dev.get("peak") or {}
+    lines = ["", "## Device roofline (cost observatory)", "",
+             f"Peak: {peak.get('device_kind', '?')} "
+             f"[{peak.get('source', '?')}] — "
+             f"{peak.get('bf16_tflops', '?')} bf16 TFLOPS / "
+             f"{peak.get('int8_tops', '?')} int8 TOPS / "
+             f"{peak.get('hbm_gbps', '?')} GB/s HBM; provenance "
+             f"**{dev.get('provenance')}**.", "",
+             "| geometry | form | dispatches | device s | achieved "
+             "TFLOP/s | achieved GB/s | flops util | bw util |",
+             "|---|---|---|---|---|---|---|---|"]
+    for r in dev.get("records", []):
+        g = r.get("geometry") or {}
+        geom = (f"B{g.get('B')}xT{g.get('n_txns')}"
+                f"(K{g.get('n_keys')},P{g.get('max_pos')})")
+
+        def pct(v):
+            return f"{v:.2%}" if isinstance(v, (int, float)) else "—"
+
+        def num(v):
+            return f"{v:g}" if isinstance(v, (int, float)) else "—"
+
+        lines.append(
+            f"| {geom} | {r.get('formulation')} | "
+            f"{r.get('dispatches')} | {r.get('device_secs'):.4f} | "
+            f"{num(r.get('achieved_tflops'))} | "
+            f"{num(r.get('achieved_gbps'))} | "
+            f"{pct(r.get('flops_utilization'))} | "
+            f"{pct(r.get('bandwidth_utilization'))} |")
+    return lines
+
+
 def analyze_shards(per_shard_events: dict) -> dict:
     """Per-shard attribution for a mesh sweep: each shard's report is
     computed over ITS OWN event list (its own timeline — cross-host
@@ -324,19 +410,29 @@ def analyze_shards(per_shard_events: dict) -> dict:
 
 
 def write_report(store_base, events: list, metrics: dict | None = None,
-                 window_us=None, per_shard_events: dict | None = None):
+                 window_us=None, per_shard_events: dict | None = None,
+                 device_records: list | None = None):
     """Write `<store>/report.json` + `report.md` (atomically — the
     journal discipline) and return their paths. With
     `per_shard_events` ({shard: event list} — a mesh sweep's
     coordinator merge) the report additionally carries `per_shard`:
     each shard's own stage-share decomposition, so `bench-report` and
-    operators can pin per-shard ceilings, not just fleet-wide ones."""
+    operators can pin per-shard ceilings, not just fleet-wide ones.
+    With `device_records` (the cost observatory's finalized records —
+    merged across shards by the coordinator) it carries the `device`
+    roofline section: per-(executable, geometry) achieved-vs-peak
+    FLOPs and bandwidth from captured `cost_analysis()` joined with
+    the measured dispatch windows."""
     base = Path(store_base)
     rep = analyze(events, window_us=window_us,
                   counters=(metrics or {}).get("counters"))
     rep = {"v": 1, **rep}
     if per_shard_events:
         rep["per_shard"] = analyze_shards(per_shard_events)
+    if device_records:
+        dev = device_section(device_records)
+        if dev is not None:
+            rep["device"] = dev
     jp = trace.atomic_write_text(base / "report.json",
                                  json.dumps(rep, indent=2))
     mp = trace.atomic_write_text(base / "report.md",

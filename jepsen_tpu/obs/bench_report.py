@@ -40,10 +40,12 @@ class MetricSpec:
     path: tuple         # path into the parsed bench dict
     higher_is_better: bool
     tolerance: float    # allowed relative slack before "regressed"
-    # absolute ceiling (lower-is-better metrics only): the latest
-    # value exceeding it regresses even with no predecessor to
-    # compare against — "a stage silently regrowing past a declared
-    # share fails the gate"
+    # absolute ceiling: the latest value exceeding it regresses even
+    # with no predecessor to compare against — "a stage silently
+    # regrowing past a declared share fails the gate" for
+    # lower-is-better metrics, and a sanity bound for ratios that
+    # cannot legitimately exceed it (an achieved-bandwidth share past
+    # ~1 means the byte model is wrong, not that the chip got faster)
     ceiling: float | None = None
     # absolute floor (higher-is-better metrics only): the latest value
     # falling below it regresses even with no predecessor — the
@@ -112,6 +114,17 @@ METRICS: tuple[MetricSpec, ...] = (
                True, 0.30),
     MetricSpec("mesh_eff", "mesh 2-shard scaling efficiency",
                ("mesh", "scaling_efficiency"), True, 0.15, floor=0.70),
+    # the device cost observatory's roofline number: XLA-modeled bytes
+    # accessed over measured device seconds, as a share of the
+    # peak-table HBM bandwidth. Estimated-provenance rounds (CPU-only
+    # boxes) carry "error" in the device_cost block, so they read as
+    # outages here — the PR-6 convention — never as zeros. The 1.05
+    # ceiling is a sanity bound: a share past ~1 means the byte model
+    # or the window join broke, which must fail the gate, not read as
+    # a speedup.
+    MetricSpec("ns_bw_share", "north-star achieved-bandwidth share",
+               ("north_star", "device_cost", "achieved_bw_share"),
+               True, 0.30, ceiling=1.05),
 )
 
 

@@ -52,6 +52,8 @@ EVENT_KINDS = frozenset({
     "shard_done",         # shard, exit_code (mesh shard completed)
     "shard_lost",         # shard, shards (no done marker at merge —
     #                       re-assignable via JEPSEN_TPU_MESH_SHARD)
+    "costdb_flush",       # path, records (device cost observatory
+    #                       appended its per-executable records)
 })
 
 _lock = threading.Lock()

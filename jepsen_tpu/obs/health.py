@@ -74,6 +74,17 @@ def health_snapshot(tracer=None, *, seq: int = 0,
         "robustness": {k: c.get(k, 0)
                        for k in ("quarantined", "oom_retries",
                                  "bucket_splits", "watchdog_timeouts")},
+        # the HBM residency ledger (jepsen_tpu/obs/device.py, gated by
+        # JEPSEN_TPU_COSTDB): resident AOT executables, modeled device
+        # bytes in flight, the backend's own accounting where the
+        # platform reports one, and cumulative donated bytes — null
+        # (not absent) when the observatory never published
+        "device": {
+            "resident_executables": g.get("resident_executables"),
+            "hbm_modeled_bytes": g.get("hbm_modeled_bytes"),
+            "hbm_device_bytes": g.get("hbm_device_bytes"),
+            "donated_bytes": c.get("donated_bytes"),
+        },
         "throughput": {
             "elapsed_secs": round(elapsed, 3) if elapsed is not None
             else None,

@@ -37,6 +37,10 @@ def main() -> int:
     # a REAL pooled sweep, even on a 1-core box: the trace-fabric
     # assertions below need actual worker processes spooling spans
     gates.export("JEPSEN_TPU_PIPELINE", 1)
+    # the device cost observatory: the assertions below pin the
+    # residency gauges on /metrics + health.json, the report's device
+    # section and the costdb contract
+    gates.export("JEPSEN_TPU_COSTDB", 1)
 
     root = Path(tempfile.mkdtemp(prefix="obs-smoke-"))
     try:
@@ -140,12 +144,45 @@ def main() -> int:
             print("obs-smoke: worker_spans digest never reached the "
                   "parent tracer")
             return 1
+        # -- device cost observatory contract --------------------------
+        for gname in ("jepsen_tpu_resident_executables",
+                      "jepsen_tpu_hbm_modeled_bytes"):
+            if not any(ln.startswith(gname + " ")
+                       for ln in page_lines):
+                print(f"obs-smoke: residency gauge {gname} missing "
+                      "from /metrics render")
+                return 1
+        dev = health.get("device") or {}
+        if not isinstance(dev.get("resident_executables"), int):
+            print(f"obs-smoke: health.json device section missing "
+                  f"residency gauges: {dev}")
+            return 1
+        from ..store import load_costdb
+        cost_recs = load_costdb(store.base)
+        if not cost_recs:
+            print("obs-smoke: no costdb.jsonl records despite "
+                  "JEPSEN_TPU_COSTDB=1")
+            return 1
+        if any(r.get("provenance") not in ("measured", "estimated")
+               for r in cost_recs):
+            print(f"obs-smoke: untagged costdb provenance: "
+                  f"{cost_recs[:1]}")
+            return 1
+        if "device" not in rep or not rep["device"].get("records"):
+            print("obs-smoke: report.json has no device section")
+            return 1
+        if "Device roofline" not in \
+                (store.base / "report.md").read_text():
+            print("obs-smoke: report.md has no device roofline "
+                  "section")
+            return 1
         print("obs-smoke: OK — health.json "
               f"(seq {health['heartbeat']['seq']}), /metrics scraped "
               f"({len(scraped['metrics'].splitlines())} lines), "
               f"{len(evs)} flight-recorder events, "
               f"{len(worker_pids)} worker track(s), report bound="
-              f"{rep.get('bound')}")
+              f"{rep.get('bound')}, costdb {len(cost_recs)} "
+              f"record(s) [{cost_recs[0]['provenance']}]")
         return 0
     finally:
         trace.reset()

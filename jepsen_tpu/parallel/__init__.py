@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import supervisor as sv
 from .. import trace
+from ..obs import device as obs_device
 from ..obs import events as obs_events
 from ..checker.elle import kernels as K
 from ..devices import default_devices, ensure_platform_pin
@@ -584,8 +585,8 @@ def _donate_active(bucket_mesh) -> bool:
     return _slots.donate_active(bucket_mesh)
 
 
-def _note_donation(tr) -> None:
-    _slots.note_donation(tr)
+def _note_donation(tr, args=None) -> None:
+    _slots.note_donation(tr, args)
 
 
 def _sync_check(encs, idx: list, mesh, budget_cells: int, kw: dict,
@@ -604,14 +605,22 @@ def _sync_check(encs, idx: list, mesh, budget_cells: int, kw: dict,
     fn = _dispatch_fn(bucket_mesh, shape, kw, args, donate)
     sv.maybe_inject_oom()
     if donate:
-        _note_donation(tr)
+        _note_donation(tr, args)
     try:
         t_disp = time.perf_counter()
-        arr = np.asarray(_block_flags(fn(*args), tr))
+        flags = fn(*args)
+        obs_device.begin_dispatch(flags, kw, shape, bucket_mesh is None,
+                                  donate, args, tr)
+        try:
+            arr = np.asarray(_block_flags(flags, tr))
+        except BaseException:
+            obs_device.discard_dispatch(flags, tr)
+            raise
     finally:
         if donate:
             _slots.release()
     tr.device_complete("bucket", t_disp, histories=len(idx))
+    obs_device.close_dispatch(flags, t_disp, len(idx), tr)
     return arr
 
 
@@ -676,8 +685,13 @@ def _finish_part(encs, idx: list, flags, mesh, budget_cells: int,
         if donated:
             _slots.release()
         tr.device_complete("bucket", t_disp, histories=len(idx))
+        obs_device.close_dispatch(flags, t_disp, len(idx), tr)
         return [int(w) for w in arr[:len(idx)]]
     except BaseException as e:
+        # the abandoned dispatch's cost window is discarded, never
+        # recorded: a recovered bucket's device time is the backdown's
+        # own windows, same as the device track
+        obs_device.discard_dispatch(flags, tr)
         if donated:
             _slots.release()
         if isinstance(e, sv.WatchdogTimeout) and not sv.strict_enabled():
@@ -792,8 +806,11 @@ def check_bucketed_async(encs: Sequence, mesh: Mesh | None = None, *,
             sv.maybe_inject_oom()
             flags = fn(*args)
             if donate:
-                _note_donation(tr)
+                _note_donation(tr, args)
             parts.append((bucket, flags, time.perf_counter(), donate))
+            obs_device.begin_dispatch(flags, kw, shape,
+                                      bucket_mesh is None, donate,
+                                      args, tr)
         except BaseException as e:
             if not sv.is_oom_error(e) or sv.strict_enabled():
                 raise

@@ -46,14 +46,34 @@ class ExecutableResidency:
                     donate: bool):
         """The callable for one bucket dispatch: `fn` (the jitted
         check fn) for mesh-sharded dispatches, else the persistent
-        compiled executable when the AOT cache is on."""
-        if bucket_mesh is not None:
+        compiled executable when the AOT cache is on. Dispatches that
+        stay on the plain jitted fn (a mesh, or the AOT cache off)
+        still feed the device cost observatory — a one-time
+        `jit.lower()` per geometry reads `cost_analysis()` without
+        forcing a second XLA compile (obs.device, JEPSEN_TPU_COSTDB;
+        the compiled path captures inside aot.compiled_for)."""
+        if bucket_mesh is not None or not self._aot_enabled():
+            from ..obs import device as device_obs
+            device_obs.observe(
+                device_obs.dispatch_cost_key(
+                    kw, shape, bucket_mesh is None, donate),
+                args, fn, source="lowered")
             return fn
         from .. import aot
-        if not aot.enabled():
-            return fn
         return aot.compiled_for(
             fn, args, self.dispatch_key(kw, shape, donate))
+
+    @staticmethod
+    def _aot_enabled() -> bool:
+        from .. import aot
+        return aot.enabled()
+
+    @staticmethod
+    def resident_count() -> int:
+        """How many compiled executables this process holds resident
+        (the AOT in-memory map — jax's own jit cache is opaque)."""
+        from .. import aot
+        return aot.resident_count()
 
     @staticmethod
     def dispatch_key(kw: dict, shape, donate: bool) -> tuple:
@@ -104,14 +124,40 @@ class DeviceSlots:
             warnings.filterwarnings(
                 "ignore", message="Some donated buffers were not usable")
 
-    def note_donation(self, tr) -> None:
+    def note_donation(self, tr, args=None) -> None:
         """One donated dispatch: six input buffers handed to XLA, one
-        ledger slot held until the dispatch resolves."""
+        ledger slot held until the dispatch resolves. With `args` (and
+        the cost observatory on) the donated BYTES are counted too —
+        the residency surface the HBM ledger publishes."""
         self.ledger.acquire()
         tr.counter("buffers_donated").inc(6)
+        if args is not None:
+            from ..obs import device as device_obs
+            if device_obs.enabled():
+                try:
+                    tr.counter("donated_bytes").inc(
+                        sum(int(a.nbytes) for a in args))
+                except Exception:   # observability never sinks dispatch
+                    pass
 
     def release(self) -> None:
         self.ledger.release()
 
     def inflight(self) -> int:
         return self.ledger.inflight()
+
+
+def publish_residency_gauges(tr, modeled_bytes: int | None = None
+                             ) -> None:
+    """THE residency-gauge publication point (obs.device calls it at
+    each dispatch open/close): resident executables, modeled HBM in
+    flight, and — throttled by JEPSEN_TPU_RESIDENCY_INTERVAL_S — the
+    backend's own `memory_stats()` where the platform reports one.
+    The gauges land in the metrics registry, so metrics.json,
+    `/metrics` and health.json's device section all agree."""
+    tr.gauge("resident_executables").set(
+        ExecutableResidency.resident_count())
+    if modeled_bytes is not None:
+        tr.gauge("hbm_modeled_bytes").set(int(modeled_bytes))
+    from ..obs import device as device_obs
+    device_obs.maybe_poll_memory_stats(tr)

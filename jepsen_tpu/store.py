@@ -432,6 +432,89 @@ class VerdictJournal:
 
 
 # ---------------------------------------------------------------------------
+# The persistent cost database: costdb.jsonl at the store root.
+#
+# The device cost observatory (jepsen_tpu/obs/device.py, behind
+# JEPSEN_TPU_COSTDB) captures one record per (compiled executable,
+# bucket geometry) — XLA cost/memory analyses joined with the measured
+# dispatch windows — and appends them here at sweep end: one flushed
+# JSON line each, the VerdictJournal discipline, so a torn tail from a
+# killed flush is skipped on load instead of poisoning the reader.
+# Mesh shards write `costdb-shard<k>.jsonl`; the coordinator merges
+# them (obs.device.merge_records) into one deduplicated costdb.jsonl.
+# The file is the training data ROADMAP item 4's cost-aware planner
+# consumes — an append-only empirical cost model, not a cache (repeat
+# sweeps append fresh records; consumers dedup by record key).
+# ---------------------------------------------------------------------------
+
+COSTDB_NAME = "costdb.jsonl"
+
+
+def costdb_path(store_base, shard: int | None = None) -> Path:
+    """The costdb for a store — per-shard under a mesh sweep, so two
+    hosts never interleave appends in one file."""
+    if shard is None:
+        return Path(store_base) / COSTDB_NAME
+    return Path(store_base) / f"costdb-shard{shard}.jsonl"
+
+
+def append_costdb(path, records: list[dict]) -> int:
+    """Append records as JSON lines, each flushed as written; a
+    crash-torn tail from a previous writer is sealed first (the
+    journal's rule — appending after a line that lost its newline
+    would merge two records into one unparseable line). Best-effort:
+    a read-only store returns 0, never raises."""
+    p = Path(path)
+    n = 0
+    try:
+        p.parent.mkdir(parents=True, exist_ok=True)
+        with open(p, "a") as f:
+            if f.tell() > 0:
+                with open(p, "rb") as rf:
+                    rf.seek(-1, os.SEEK_END)
+                    if rf.read(1) != b"\n":
+                        f.write("\n")
+            for rec in records:
+                try:
+                    line = json.dumps(rec)
+                except (TypeError, ValueError):
+                    continue
+                f.write(line + "\n")
+                f.flush()
+                n += 1
+    except OSError:
+        log.debug("costdb append failed for %s", p, exc_info=True)
+    return n
+
+
+def load_costdb(path) -> list[dict]:
+    """Records from an existing costdb, in file order; unparseable
+    lines (the crash-torn tail) are skipped, mirroring
+    VerdictJournal.load."""
+    out: list[dict] = []
+    p = Path(path)
+    if p.is_dir():
+        p = p / COSTDB_NAME
+    if not p.is_file():
+        return out
+    try:
+        lines = p.read_text().splitlines()
+    except OSError:
+        return out
+    for ln in lines:
+        ln = ln.strip()
+        if not ln:
+            continue
+        try:
+            rec = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "geometry" in rec:
+            out.append(rec)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Persistent encoded cache: encoded.v1.bin / encoded.v2.bin sidecars.
 #
 # Re-analysis sweeps (analyze-store --resume, repeated benches, CI) pay

@@ -63,15 +63,18 @@ DECLARED_METRICS: dict[str, frozenset] = {
     "counters": frozenset({
         "bucket_splits", "buckets_dispatched", "buckets_resolved",
         "buffers_donated", "cache_hits", "cache_misses",
-        "compile_cache_hits", "compile_cache_misses", "h2d_bytes",
+        "compile_cache_hits", "compile_cache_misses", "cost_records",
+        "donated_bytes", "h2d_bytes",
         "native_fallback", "oom_retries", "pad_waste_cells",
         "quarantined", "runs_verdicted", "shm_bytes",
         "shm_stale_reclaimed", "sidecar_upgrades", "split.native",
         "split.python", "warm_copy_bytes", "watchdog_timeouts",
         "worker_spans",
     }),
-    "gauges": frozenset({"donate_slots_inflight", "inflight_depth",
-                         "reorder_depth", "runs_total"}),
+    "gauges": frozenset({"donate_slots_inflight", "hbm_device_bytes",
+                         "hbm_modeled_bytes", "inflight_depth",
+                         "reorder_depth", "resident_executables",
+                         "runs_total"}),
     "histograms": frozenset({"bucket_cells"}),
 }
 
