@@ -7,9 +7,9 @@ PY ?= python
 ASAN_RT := $(shell gcc -print-file-name=libasan.so)
 TSAN_RT := $(shell gcc -print-file-name=libtsan.so)
 
-.PHONY: lint lint-json lint-changed env-table rule-table test native \
-	native-sanitize bench bench-report bench-warm obs-smoke \
-	trace-report cost-report
+.PHONY: lint lint-json lint-changed env-table rule-table dur-table \
+	crash-smoke test native native-sanitize bench bench-report \
+	bench-warm obs-smoke trace-report cost-report
 
 # Self-hosted static analysis: gate registry, JAX hazards, concurrency
 # discipline, shm lifecycle, tracer discipline, plus the cross-boundary
@@ -46,6 +46,27 @@ env-table:
 	e = t.index(gates.TABLE_END) + len(gates.TABLE_END); \
 	p.write_text(t[:s] + gates.render_env_block() + t[e:]); \
 	print('README.md env-gate table regenerated')"
+
+# Regenerate the README "Store durability" table from the
+# STORE_ARTIFACTS registry (lint rule JT-DUR-006 fails the build when
+# the committed table drifts).
+dur-table:
+	$(PY) -c "from pathlib import Path; \
+	from jepsen_tpu.lint import contracts as c; \
+	p = Path('README.md'); t = p.read_text(); \
+	s = t.index(c.DUR_BEGIN); \
+	e = t.index(c.DUR_END) + len(c.DUR_END); \
+	p.write_text(t[:s] + c.render_dur_block() + t[e:]); \
+	print('README.md store-durability table regenerated')"
+
+# Crash-consistency smoke: the kill-mid-write / short-write /
+# torn-tail / rotation tests over the journal-class artifacts
+# (costdb, verdict journal, events rotation) — the dynamic
+# counterpart of the JT-DUR static prover.
+crash-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_costdb.py \
+	  tests/test_obs.py tests/test_durability_prover.py -q \
+	  -m 'not slow' -k 'crash or torn or seal or rotat or caught'
 
 # Tier-1: the ROADMAP verification gate.
 test:

@@ -99,6 +99,81 @@ def clear(test: dict | None = None, node: str | None = None) -> None:
     _ctl("clear")
 
 
+# ---------------------------------------------------------------------------
+# Local (no-FUSE) write-fault injection.
+#
+# The FUSE layer above needs root + a DB node; the store's own
+# durability protocols (the flushed append-journal, the atomic
+# snapshot) want crash-sim coverage in plain tier-1 tests. This is
+# the deterministic counterpart: a byte-budgeted `open()` replacement
+# whose write-mode files stop mid-`write()` once the budget runs out
+# — the partial bytes are flushed to disk first, which is exactly
+# the torn tail a SIGKILL (or a full disk / EIO) leaves behind.
+# tests/test_costdb.py drives `append_costdb`/`merge_costdbs` through
+# it and asserts seal + skip + idempotent re-merge.
+# ---------------------------------------------------------------------------
+
+class FaultyWriteFile:
+    """Wraps a real text-mode file: writes draw down a shared
+    character budget; the write that exhausts it lands its prefix on
+    disk (flushed — the crash point must be observable) and raises
+    EIO. Reads and bookkeeping pass through."""
+
+    def __init__(self, f, budget: dict):
+        self._f = f
+        self._budget = budget
+
+    def write(self, data):
+        left = self._budget["left"]
+        if left <= 0:
+            raise OSError(5, "faultfs: injected write fault")
+        if len(data) <= left:
+            self._budget["left"] = left - len(data)
+            return self._f.write(data)
+        self._f.write(data[:left])
+        self._f.flush()
+        self._budget["left"] = 0
+        raise OSError(5, "faultfs: injected short write "
+                         f"({left} of {len(data)} bytes landed)")
+
+    def writelines(self, lines):
+        # route through write() so the budget applies — delegating
+        # via __getattr__ would silently bypass the injection
+        for ln in lines:
+            self.write(ln)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._f.close()
+        return False
+
+    def __getattr__(self, name):
+        return getattr(self._f, name)
+
+
+def faulty_opener(budget_chars: int, real_open=open):
+    """An `open()` replacement that injects a crash after
+    `budget_chars` characters of write-mode output (shared across
+    every file it opens — the budget models the process's remaining
+    lifetime, not one file's). Read-mode opens pass through
+    untouched. Use with monkeypatch:
+
+        monkeypatch.setattr("builtins.open",
+                            faultfs.faulty_opener(120))
+    """
+    budget = {"left": int(budget_chars)}
+
+    def _open(file, mode="r", *args, **kwargs):
+        f = real_open(file, mode, *args, **kwargs)
+        if any(c in mode for c in "wax+") and "b" not in mode:
+            return FaultyWriteFile(f, budget)
+        return f
+
+    return _open
+
+
 class FaultFSNemesis(Nemesis):
     """Nemesis driving faultfs on target nodes. Ops:
 

@@ -163,6 +163,10 @@ _g("JEPSEN_TPU_METRICS_PORT", "int", None,
    "serve `/metrics` (Prometheus text exposition) + `/healthz` (the "
    "health snapshot) on this port during a sweep; `0` binds an "
    "ephemeral port; unset = off")
+_g("JEPSEN_TPU_EVENTS_MAX_BYTES", "int", None,
+   "rotate `<store>/events.jsonl` once it exceeds this many bytes "
+   "(atomic rename to `events.jsonl.1`, then an `events_rotated` "
+   "event opens the fresh log); unset/<=0 = unbounded (the default)")
 _g("JEPSEN_TPU_COSTDB", "bool", False,
    "set: the device cost observatory — capture each executable's XLA "
    "`cost_analysis()`/`memory_analysis()` once per compile, join it "

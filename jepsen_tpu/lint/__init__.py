@@ -16,7 +16,8 @@ Architecture:
     the package, grouped in rule families: JT-GATE (env-gate
     registry), JT-JAX (host-sync/recompile hazards), JT-THREAD
     (concurrency discipline), JT-SHM (shared-memory lifecycle),
-    JT-TRACE (tracer/span + metric-name discipline).
+    JT-TRACE (tracer/span + metric-name discipline), JT-DUR
+    (store-artifact durability protocols over the fileflow pass).
   * project rules (`ProjectRule`) — whole-repo checks that need more
     than one file: the README env-gate table must match the registry
     render; every registered gate must appear in test coverage.
@@ -206,14 +207,14 @@ def walk_functions(tree: ast.AST) -> Iterator[ast.FunctionDef]:
 
 def all_rules() -> tuple[list[ModuleRule], list[ProjectRule]]:
     """Every registered rule instance (module rules, project rules)."""
-    from . import (rules_abi, rules_concurrency, rules_gates,
-                   rules_jax, rules_lock, rules_meta, rules_shm,
-                   rules_tensor, rules_trace)
+    from . import (rules_abi, rules_concurrency, rules_dur,
+                   rules_gates, rules_jax, rules_lock, rules_meta,
+                   rules_shm, rules_tensor, rules_trace)
     mod: list[ModuleRule] = []
     proj: list[ProjectRule] = []
     for m in (rules_gates, rules_jax, rules_concurrency, rules_shm,
               rules_trace, rules_abi, rules_tensor, rules_lock,
-              rules_meta):
+              rules_dur, rules_meta):
         for r in m.RULES:
             (proj if isinstance(r, ProjectRule) else mod).append(r)
     return mod, proj

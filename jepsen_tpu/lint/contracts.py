@@ -5,18 +5,24 @@ against.
 typed table, is an invariant the linter can enforce everywhere it is
 consumed. This module does the same for the encode→pack→dispatch
 tensor contracts (JT-TENSOR), the lock/shared-state discipline of the
-sweep's thread graph (JT-LOCK), and the hot-path scoping both share.
+sweep's thread graph (JT-LOCK), the hot-path scoping both share, and
+the store-artifact durability protocols (JT-DUR) — every on-disk
+format a sweep persists, declared once with its crash-consistency
+protocol, sanctioned writer/reader helpers and retention class.
 The ABI/layout contracts (JT-ABI) are NOT declared here — their source
 of truth is `native/hist_encode.cc` itself, parsed by `cparse.py` and
 cross-checked against `native_lib.py`/`store.py`; duplicating them in
 a third place would just add one more thing to drift.
 
 Every table is consumed by a rule in `rules_tensor.py` /
-`rules_lock.py`; tests/test_lint.py pins the registry's shape so an
-entry can't silently vanish.
+`rules_lock.py` / `rules_dur.py`; tests/test_lint.py pins the
+registry's shape so an entry can't silently vanish.
 """
 
 from __future__ import annotations
+
+from dataclasses import dataclass
+from fnmatch import fnmatchcase
 
 # ---------------------------------------------------------------------------
 # JT-TENSOR — dtype/shape/fill contracts of the encode→pack→dispatch path
@@ -173,3 +179,240 @@ THREADSAFE_CTORS: frozenset[str] = frozenset({
     "Semaphore", "BoundedSemaphore", "Event", "Lock", "RLock",
     "Condition", "Barrier", "deque",
 })
+
+
+# ---------------------------------------------------------------------------
+# JT-DUR — the store-artifact registry: every on-disk format a sweep
+# persists, declared ONCE with its crash-consistency protocol.
+# ---------------------------------------------------------------------------
+
+#: The two-and-a-half durability protocols the package implements:
+#:
+#:   * `journal`  — append-only JSON lines, each record written as ONE
+#:     `write()` and `flush()`ed as it lands; a crash tears at most
+#:     the line in flight, which the reader skips and the next append
+#:     seals (the VerdictJournal discipline).
+#:   * `snapshot` — whole-file artifacts published via temp file +
+#:     `os.replace` (`trace.atomic_write_text`): a reader sees the
+#:     previous complete file or the new one, never bytes of both.
+#:   * `spool`    — a journal owned by ONE process for ONE sweep,
+#:     cleaned at the next sweep start (worker trace spools).
+#:   * `marker`   — a tiny atomic pointer/flag (done markers, the
+#:     latest/current symlinks): existence + content flip atomically.
+#:   * `sidecar`  — a derived binary cache keyed by its source;
+#:     written to a temp name and `os.replace`d, discarded (never
+#:     trusted) on any mismatch.
+PROTOCOLS = ("journal", "snapshot", "spool", "marker", "sidecar")
+
+#: Declared retention classes — how an artifact is kept from growing
+#: without bound. JT-DUR-005 requires every append-forever (journal/
+#: spool) artifact to declare one:
+#:
+#:   * `rotated`        — size-capped, rotated by atomic rename
+#:                        (events.jsonl under JEPSEN_TPU_EVENTS_MAX_BYTES);
+#:   * `replaced`       — each write replaces the whole artifact;
+#:   * `merged`         — periodically folded/deduplicated into one
+#:                        file by a coordinator (per-shard costdbs);
+#:   * `per-run`        — bounded by the run dir it lives in;
+#:   * `per-sweep`      — cleared at the next sweep start;
+#:   * `store-lifetime` — grows with the store; pruned only when the
+#:                        store is recycled (verdict journals —
+#:                        compaction is ROADMAP item 5).
+RETENTION_CLASSES: frozenset[str] = frozenset({
+    "rotated", "replaced", "merged", "per-run", "per-sweep",
+    "store-lifetime",
+})
+
+
+@dataclass(frozen=True)
+class StoreArtifact:
+    """One declared on-disk artifact: where it lives, which protocol
+    its writers/readers must speak, and who is sanctioned to speak it.
+    `patterns` are fnmatch globs over the artifact's FILE NAME
+    (store-root-relative for `root="store"`, compile-cache-relative
+    for `root="cache"`, run-dir for the sidecars). `writers`/`readers`
+    name the sanctioned helpers as `<module rel>:<qualname>`;
+    `helpers` are path-constructor functions whose RETURN is this
+    artifact's path — the fileflow pass resolves calls to them
+    interprocedurally."""
+
+    name: str
+    patterns: tuple[str, ...]
+    protocol: str
+    writers: tuple[str, ...]
+    readers: tuple[str, ...]
+    retention: str | None
+    doc: str
+    root: str = "store"
+    helpers: tuple[str, ...] = ()
+
+
+STORE_ARTIFACTS: tuple[StoreArtifact, ...] = (
+    StoreArtifact(
+        "verdict journal", ("verdicts*.jsonl",), "journal",
+        writers=("jepsen_tpu/store.py:VerdictJournal.record",),
+        readers=("jepsen_tpu/store.py:VerdictJournal.load",),
+        retention="store-lifetime",
+        helpers=("shard_journal_path",),
+        doc="resumable per-history verdict log (`verdicts-<k>.jsonl` "
+            "per mesh shard); torn tail sealed on reopen, skipped on "
+            "load; compaction is ROADMAP item 5"),
+    StoreArtifact(
+        "flight recorder", ("events.jsonl*",), "journal",
+        writers=("jepsen_tpu/obs/events.py:emit",),
+        readers=("jepsen_tpu/obs/events.py:load_events",),
+        retention="rotated",
+        doc="typed lifecycle events, one flushed line each; size-"
+            "capped by `JEPSEN_TPU_EVENTS_MAX_BYTES` (atomic rename "
+            "to `events.jsonl.1`, an `events_rotated` event opens "
+            "the fresh log)"),
+    StoreArtifact(
+        "cost database", ("costdb*.jsonl",), "journal",
+        writers=("jepsen_tpu/store.py:append_costdb",
+                 "jepsen_tpu/mesh.py:merge_costdbs"),
+        readers=("jepsen_tpu/store.py:load_costdb",),
+        retention="merged",
+        helpers=("costdb_path",),
+        doc="per-(executable, geometry) device cost records; mesh "
+            "shards append `costdb-shard<k>.jsonl`, the coordinator "
+            "replaces the merged `costdb.jsonl` atomically"),
+    StoreArtifact(
+        # jt-lint: ok JT-TRACE-004 (the registry's declared pattern, not an ad-hoc spool writer)
+        "worker trace spool", ("trace-*.jsonl",), "spool",
+        writers=("jepsen_tpu/trace.py:ensure_worker_tracer",
+                 "jepsen_tpu/trace.py:flush_worker_spool"),
+        readers=("jepsen_tpu/trace.py:load_spool",),
+        retention="per-sweep",
+        helpers=("spool_path",),
+        doc="per-pid span spool of one sweep's pool workers; stale "
+            "spools cleared at sweep start, merged into trace.json "
+            "at sweep end"),
+    StoreArtifact(
+        "shard spool dir", ("spool-shard*",), "spool",
+        writers=("jepsen_tpu/trace.py:flush_worker_spool",),
+        readers=("jepsen_tpu/trace.py:merge_shard_traces",),
+        retention="per-sweep",
+        helpers=("shard_spool_dir",),
+        doc="one mesh shard's spool subdirectory (two hosts' workers "
+            "can share a pid); removed by the coordinator after a "
+            "fully-covered merge"),
+    StoreArtifact(
+        "health snapshot", ("health.json",), "snapshot",
+        writers=("jepsen_tpu/obs/health.py:write_health",),
+        readers=(),
+        retention="replaced",
+        doc="live progress/robustness/throughput snapshot, rewritten "
+            "atomically every `JEPSEN_TPU_HEALTH_INTERVAL_S` seconds"),
+    StoreArtifact(
+        "sweep trace", ("trace.json", "trace-shard*.json"), "snapshot",
+        writers=("jepsen_tpu/trace.py:Tracer.export",
+                 "jepsen_tpu/trace.py:Tracer.export_merged",
+                 "jepsen_tpu/trace.py:export_shard_trace",
+                 "jepsen_tpu/mesh.py:_merge_trace_artifacts"),
+        readers=("jepsen_tpu/trace.py:load_shard_trace",),
+        retention="replaced",
+        helpers=("shard_trace_path",),
+        doc="merged Chrome trace of the sweep (per-shard exports "
+            "under a mesh, folded by the coordinator)"),
+    StoreArtifact(
+        "metrics export", ("metrics.json", "metrics-shard*.json"),
+        "snapshot",
+        writers=("jepsen_tpu/trace.py:Tracer.export_metrics",
+                 "jepsen_tpu/mesh.py:_merge_trace_artifacts"),
+        readers=("jepsen_tpu/mesh.py:merge_shard_metrics",),
+        retention="replaced",
+        doc="the tracer's counters/gauges/histograms at sweep end"),
+    StoreArtifact(
+        "attribution report", ("report.json", "report.md"), "snapshot",
+        writers=("jepsen_tpu/obs/attribution.py:write_report",),
+        readers=(),
+        retention="replaced",
+        doc="critical-path attribution (`analyze-store --report`)"),
+    StoreArtifact(
+        "shard done marker", (".shard-*.done",), "marker",
+        writers=("jepsen_tpu/supervisor.py:mark_shard_done",),
+        readers=("jepsen_tpu/supervisor.py:load_shard_done",),
+        retention="per-sweep",
+        helpers=("shard_done_path",),
+        doc="one mesh shard's completion marker (exit code + counts), "
+            "cleared at its own sweep start, polled by the "
+            "coordinator's bounded wait"),
+    StoreArtifact(
+        "latest/current links", ("latest", "current"), "marker",
+        writers=("jepsen_tpu/store.py:Store._relink",),
+        readers=(),
+        retention="replaced",
+        doc="monotonic symlinks to the newest run dir"),
+    StoreArtifact(
+        "encoded sidecar", ("encoded*.bin",), "sidecar",
+        writers=("jepsen_tpu/store.py:save_encoded",),
+        readers=("jepsen_tpu/store.py:load_encoded",),
+        retention="per-run",
+        helpers=("encoded_cache_path",),
+        doc="flat binary encode cache next to history.jsonl, keyed "
+            "by the history's size/mtime/xxh64; written temp + "
+            "`os.replace`, discarded on any key mismatch"),
+    StoreArtifact(
+        "AOT executable cache", ("*.jtx",), "snapshot",
+        writers=("jepsen_tpu/aot.py:_disk_store",),
+        readers=("jepsen_tpu/aot.py:_disk_load",),
+        retention="replaced",
+        root="cache",
+        doc="serialized XLA executables under "
+            "`~/.cache/jepsen_tpu/executables`; corrupt entries "
+            "degrade to a fresh compile"),
+    StoreArtifact(
+        "jax profile capture", ("jax-profile",), "sidecar",
+        writers=("jepsen_tpu/trace.py:jax_profile_session",),
+        readers=(),
+        retention="store-lifetime",
+        doc="`jax.profiler` dump dir (JEPSEN_TPU_JAX_PROFILE)"),
+)
+
+#: Path-constructor helper name -> the artifact whose path it returns
+#: (the fileflow pass's interprocedural edge: a call to one of these
+#: resolves to the artifact wherever it appears).
+PATH_HELPERS: dict[str, StoreArtifact] = {
+    h: a for a in STORE_ARTIFACTS for h in a.helpers
+}
+
+
+def artifact_for_name(tail: str) -> StoreArtifact | None:
+    """The declared artifact a file-name skeleton belongs to, or None
+    (= an UNdeclared store write, JT-DUR-001). Skeletons carry `*` for
+    interpolated segments; fnmatch treats the pattern's own `*` as the
+    wildcard, so `costdb-shard*.jsonl` matches `costdb*.jsonl`."""
+    for a in STORE_ARTIFACTS:
+        for p in a.patterns:
+            if fnmatchcase(tail, p):
+                return a
+    return None
+
+
+#: README markers for the generated "Store durability" table — the
+#: env-gate table's pattern: edit the registry, run `make dur-table`,
+#: JT-DUR-006 fails the build on drift.
+DUR_BEGIN = ("<!-- store-durability:begin "
+             "(generated by jepsen_tpu.lint.contracts) -->")
+DUR_END = "<!-- store-durability:end -->"
+
+
+def _short(spec: str) -> str:
+    """`store.py:VerdictJournal.record` for the table cell."""
+    return spec.replace("jepsen_tpu/", "")
+
+
+def render_dur_table() -> str:
+    rows = ["| artifact | pattern | protocol | retention | "
+            "writer → reader |", "|---|---|---|---|---|"]
+    for a in STORE_ARTIFACTS:
+        pats = " ".join(f"`{p}`" for p in a.patterns)
+        w = ", ".join(_short(s) for s in a.writers) or "—"
+        r = ", ".join(_short(s) for s in a.readers) or "—"
+        rows.append(f"| {a.name} | {pats} | {a.protocol} | "
+                    f"{a.retention or '—'} | {w} → {r} |")
+    return "\n".join(rows)
+
+
+def render_dur_block() -> str:
+    return f"{DUR_BEGIN}\n{render_dur_table()}\n{DUR_END}"

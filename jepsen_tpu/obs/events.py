@@ -19,7 +19,11 @@ descriptor, so concurrent emitters (the sampler thread, the sweep
 thread) interleave at line granularity and a crash tears at most the
 line in flight — `load_events` skips unparseable lines, like the
 journal's truncated-tail rule. Pool worker processes never install a
-log, so their `emit` calls are no-ops by construction.
+log, so their `emit` calls are no-ops by construction. Retention is
+the registry-declared `rotated` class: with
+`JEPSEN_TPU_EVENTS_MAX_BYTES` set, a log over the cap is renamed
+aside to `events.jsonl.1` (atomic `os.replace`) and the fresh log
+opens with an `events_rotated` event naming it.
 """
 
 from __future__ import annotations
@@ -54,6 +58,9 @@ EVENT_KINDS = frozenset({
     #                       re-assignable via JEPSEN_TPU_MESH_SHARD)
     "costdb_flush",       # path, records (device cost observatory
     #                       appended its per-executable records)
+    "events_rotated",     # rotated_to, size (the log hit
+    #                       JEPSEN_TPU_EVENTS_MAX_BYTES and was
+    #                       renamed aside; first line of the new log)
 })
 
 _lock = threading.Lock()
@@ -86,6 +93,96 @@ def current_path() -> Path | None:
     return _path
 
 
+def _max_bytes() -> int | None:
+    """The JEPSEN_TPU_EVENTS_MAX_BYTES rotation cap (unset/<=0 = off,
+    the default) — the registry-declared `rotated` retention class of
+    the flight recorder, made real."""
+    from .. import gates
+    v = gates.get("JEPSEN_TPU_EVENTS_MAX_BYTES")
+    return v if v is not None and v > 0 else None
+
+
+#: A crashed rotator's lockfile is broken after this many seconds —
+#: rotation pauses (the log grows past the cap), it never loses data.
+_ROTLOCK_STALE_S = 60.0
+
+
+def _maybe_rotate(p: Path) -> str | None:
+    """Rotate the log aside (atomic rename to `<name>.1`) when it
+    exceeds the cap; returns the `events_rotated` line to open the
+    fresh log with, or None. `_lock` serializes threads; PROCESSES
+    (mesh shards share one store log) are serialized by an
+    O_CREAT|O_EXCL lockfile, and the size is re-stat'ed after the
+    claim — a stale pre-claim stat from a racing emitter can't
+    rename the freshly-rotated log over the generation it just kept.
+    Losing the claim (or any OSError) skips rotation for this emit:
+    the next emit retries, nothing is lost."""
+    cap = _max_bytes()
+    if cap is None:
+        return None
+    try:
+        if p.stat().st_size < cap:
+            return None
+    except OSError:
+        return None
+    lock = p.with_name(p.name + ".rotlock")
+    try:
+        fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except FileExistsError:
+        # another process holds the rotation; break only a stale
+        # lock (its holder crashed mid-rotation) and retry NEXT
+        # emit. The break is rename-then-verify, never unlink-by-
+        # path: between our staleness stat and the unlink a live
+        # claimant could have replaced the stale lock, and deleting
+        # ITS claim would let two rotators run at once. os.rename is
+        # atomic (exactly one breaker gets the inode), and the
+        # renamed file's mtime proves which lock we actually took —
+        # a live claim is renamed straight back.
+        try:
+            if time.time() - lock.stat().st_mtime <= _ROTLOCK_STALE_S:
+                return None
+            breaking = lock.with_name(f"{lock.name}.{os.getpid()}")
+            os.rename(lock, breaking)
+            if time.time() - breaking.stat().st_mtime \
+                    > _ROTLOCK_STALE_S:
+                os.unlink(breaking)        # broke the crashed holder
+            else:
+                os.rename(breaking, lock)  # stole a live claim: undo
+        except OSError:
+            pass
+        return None
+    except OSError:
+        return None
+    try:
+        os.close(fd)
+        # re-stat under the lock: the crossing this emit observed may
+        # already have been rotated by the previous lock holder
+        try:
+            size = p.stat().st_size
+        except OSError:
+            return None
+        if size < cap:
+            return None
+        rotated = p.with_name(p.name + ".1")
+        try:
+            os.replace(p, rotated)
+        except OSError:
+            log.debug("events rotation failed for %s", p,
+                      exc_info=True)
+            return None
+        return json.dumps({"event": "events_rotated",
+                           "t_mono": round(time.monotonic(), 6),
+                           "t_wall": round(time.time(), 6),
+                           "pid": os.getpid(),
+                           "rotated_to": rotated.name,
+                           "size": size}) + "\n"
+    finally:
+        try:
+            os.unlink(lock)
+        except OSError:
+            pass
+
+
 def emit(kind: str, **fields) -> bool:
     """Append one typed event; returns True when a line was written.
     No-op (False) when no log is installed — callers never guard.
@@ -108,9 +205,16 @@ def emit(kind: str, **fields) -> bool:
                   exc_info=True)
         return False
     try:
-        with _lock, open(p, "a") as f:
-            f.write(line)
-            f.flush()
+        with _lock:
+            rot = _maybe_rotate(p)
+            if rot is not None:
+                # the rotation mark and the record open the fresh log
+                # as ONE write — a crash between two writes would
+                # leave a log whose first record isn't the rotation
+                line = rot + line
+            with open(p, "a") as f:
+                f.write(line)
+                f.flush()
         return True
     except OSError:
         # a read-only store mount must not sink the sweep

@@ -1,0 +1,40 @@
+"""Seeded JT-DUR violations — the durability prover's golden fixture.
+
+Each offending line carries an `# EXPECT:` marker; the clean twin is
+dur_ok.py.
+"""
+import json
+from pathlib import Path
+
+
+def undeclared_artifact(store_base):
+    # a new on-disk format with no registry entry: no certified
+    # protocol, no retention class, no sanctioned reader
+    return Path(store_base) / "serve.jsonl"      # EXPECT: JT-DUR-001
+
+
+def inline_snapshot_write(store_base, snap):
+    # health.json is snapshot-class: publishing on the final name
+    # tears under a concurrent reader when the writer crashes
+    p = Path(store_base) / "health.json"
+    with open(p, "w") as f:                      # EXPECT: JT-DUR-002
+        json.dump(snap, f)
+
+
+def unflushed_append(path, rec):
+    f = open(path, "a")
+    f.write(json.dumps(rec) + "\n")              # EXPECT: JT-DUR-003
+    return f
+
+
+def tearing_append(path, rec):
+    with open(path, "a") as f:
+        f.write(json.dumps(rec))
+        f.write("\n")                            # EXPECT: JT-DUR-003
+        f.flush()
+
+
+def raw_journal_reader(store_base):
+    p = Path(store_base) / "verdicts.jsonl"
+    return [json.loads(ln)
+            for ln in p.read_text().splitlines()]   # EXPECT: JT-DUR-004

@@ -1,0 +1,30 @@
+"""Clean twin of dur_bad.py — the sanctioned durability protocols."""
+import json
+from pathlib import Path
+
+from jepsen_tpu.store import VerdictJournal
+from jepsen_tpu.trace import atomic_write_text
+
+
+def declared_artifact(store_base):
+    # a registry-declared artifact name resolves cleanly
+    return Path(store_base) / "costdb.jsonl"
+
+
+def atomic_snapshot(store_base, snap):
+    # snapshot-class publish through the sanctioned temp+replace
+    atomic_write_text(Path(store_base) / "health.json",
+                      json.dumps(snap))
+
+
+def flushed_append(path, recs):
+    # the journal protocol: one write per record, flushed as it lands
+    with open(path, "a") as f:
+        for rec in recs:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+
+
+def sanctioned_reader(store_base):
+    # journals are read through their torn-tail-tolerant loader
+    return VerdictJournal.load(Path(store_base) / "verdicts.jsonl")

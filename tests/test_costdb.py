@@ -431,3 +431,108 @@ class TestDeviceSection:
             (4 * 2e8 / 2.0) / (819.0 * 1e9), rel=1e-3)
         assert bw["device_secs"] == pytest.approx(2.0)
         assert device_obs.bandwidth_share([]) is None
+
+
+# ---------------------------------------------------------------------------
+# Crash-sim coverage (the JT-DUR dynamic counterpart, `make
+# crash-smoke`): the costdb journal family driven through real
+# SIGKILL-mid-write and injected short writes — torn tails must be
+# sealed + skipped, and a repeat merge must stay idempotent.
+# ---------------------------------------------------------------------------
+
+class TestCostdbCrashSim:
+    _rec = TestMeshMerge._rec
+
+    def test_kill_mid_append_crash_seals_and_resumes(self, tmp_path):
+        # a REAL kill: the child appends complete records, leaves a
+        # torn tail on disk, and SIGKILLs itself mid-"write"
+        import signal
+        import subprocess
+        import sys
+        import textwrap
+        p = tmp_path / "costdb.jsonl"
+        child = textwrap.dedent(f"""
+            import json, os, signal
+            from jepsen_tpu import store
+            p = {str(p)!r}
+            store.append_costdb(
+                p, [{{"v": 1, "geometry": {{"B": i}}, "i": i}}
+                    for i in range(3)])
+            with open(p, "a") as f:
+                f.write('{{"v": 1, "geometry": {{"B": 9}}, "to')
+                f.flush()
+                os.kill(os.getpid(), signal.SIGKILL)
+        """)
+        res = subprocess.run(
+            [sys.executable, "-c", child], cwd=str(Path(__file__).parents[1]),
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            capture_output=True, timeout=120)
+        assert res.returncode == -signal.SIGKILL, res.stderr.decode()
+        # the torn tail is skipped, the complete records survive
+        loaded = jstore.load_costdb(p)
+        assert [r["i"] for r in loaded] == [0, 1, 2]
+        # the next append seals the torn tail before writing: the new
+        # record cannot merge into the dead bytes
+        assert jstore.append_costdb(
+            p, [{"v": 1, "geometry": {"B": 4}, "i": 3}]) == 1
+        loaded = jstore.load_costdb(p)
+        assert [r["i"] for r in loaded] == [0, 1, 2, 3]
+
+    def test_short_write_crash_mid_record(self, tmp_path, monkeypatch):
+        # the faultfs local injector: the write that exhausts the
+        # byte budget lands its prefix (flushed) and raises EIO —
+        # the torn tail a full disk or a kill leaves behind
+        from jepsen_tpu import faultfs
+        p = tmp_path / "costdb.jsonl"
+        recs = [{"v": 1, "geometry": {"B": i}, "i": i} for i in range(3)]
+        line0 = json.dumps(recs[0]) + "\n"
+        real_open = open
+        monkeypatch.setattr(
+            "builtins.open",
+            faultfs.faulty_opener(len(line0) + 11, real_open=real_open))
+        # best-effort contract: the injected fault must not raise out
+        n = jstore.append_costdb(p, recs)
+        monkeypatch.setattr("builtins.open", real_open)
+        assert n == 1                       # one record fully landed
+        raw = p.read_text()
+        assert raw.startswith(line0) and not raw.endswith("\n")
+        assert [r["i"] for r in jstore.load_costdb(p)] == [0]
+        # recovery: seal + append, nothing merged, nothing doubled
+        assert jstore.append_costdb(p, recs[1:]) == 2
+        assert [r["i"] for r in jstore.load_costdb(p)] == [0, 1, 2]
+
+    def test_merge_crash_at_publish_is_invisible(self, tmp_path,
+                                                 monkeypatch):
+        # crash between the merged tmp write and os.replace: the
+        # previous costdb.jsonl must survive untouched, and the
+        # re-merge (and a repeat merge) must converge byte-identical
+        from jepsen_tpu import mesh
+        base = tmp_path
+        jstore.append_costdb(jstore.costdb_path(base, 0),
+                             [self._rec(dispatches=1, secs=0.3)])
+        jstore.append_costdb(jstore.costdb_path(base, 1),
+                             [self._rec(dispatches=2, secs=0.6)])
+        before = mesh.merge_costdbs(base, 2)
+        assert len(before) == 1
+        first_bytes = jstore.costdb_path(base).read_bytes()
+        # another shard lands; the next merge dies at the publish
+        jstore.append_costdb(jstore.costdb_path(base, 1),
+                             [self._rec(B=32, dispatches=1, secs=0.1)])
+        real_replace = os.replace
+
+        def boom(src, dst):
+            raise OSError(5, "faultfs: injected crash at publish")
+
+        monkeypatch.setattr(os, "replace", boom)
+        with pytest.raises(OSError):
+            mesh.merge_costdbs(base, 2)
+        monkeypatch.setattr(os, "replace", real_replace)
+        # previous merged file intact, no tmp litter
+        assert jstore.costdb_path(base).read_bytes() == first_bytes
+        assert [f for f in os.listdir(base) if f.endswith(".tmp")] == []
+        # the re-merge converges, and a repeat merge is idempotent
+        merged = mesh.merge_costdbs(base, 2)
+        assert len(merged) == 2
+        once = jstore.costdb_path(base).read_bytes()
+        mesh.merge_costdbs(base, 2)
+        assert jstore.costdb_path(base).read_bytes() == once

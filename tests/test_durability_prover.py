@@ -1,0 +1,218 @@
+"""Differential tests for the JT-DUR durability prover.
+
+The analyzer that certifies the store's crash-consistency protocols
+must itself be certified (the test_contract_prover.py precedent):
+each test copies the REAL durability-critical modules into a fixture
+tree, applies exactly one seeded mutation — drop a `flush()`, inline
+a non-atomic snapshot write, add an undeclared `<store>/` file,
+bypass the torn-tail reader, strip a retention class — and asserts
+the prover reports exactly the expected JT-DUR finding (and nothing
+else). The unmutated tree must be clean, so a prover that goes blind
+(fileflow regression) or trigger-happy (false drift) fails loudly
+either way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import shutil
+from pathlib import Path
+
+import pytest
+
+from jepsen_tpu import lint
+from jepsen_tpu.lint import ProjectCtx, contracts, rules_dur
+
+REPO = Path(__file__).resolve().parents[1]
+
+#: The modules that own the store's durability protocols — every
+#: registered writer/reader lives in one of these.
+_FIXTURE_FILES = (
+    "jepsen_tpu/store.py", "jepsen_tpu/trace.py", "jepsen_tpu/mesh.py",
+    "jepsen_tpu/supervisor.py", "jepsen_tpu/aot.py",
+    "jepsen_tpu/cli.py", "jepsen_tpu/obs/events.py",
+    "jepsen_tpu/obs/health.py", "jepsen_tpu/obs/device.py",
+    "jepsen_tpu/obs/attribution.py",
+)
+
+_MODULE_RULES = [r for r in rules_dur.RULES
+                 if isinstance(r, lint.ModuleRule)]
+
+
+@pytest.fixture()
+def tree(tmp_path: Path) -> Path:
+    for rel in _FIXTURE_FILES:
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(REPO / rel, dst)
+    return tmp_path
+
+
+def prove(root: Path):
+    files = [root / rel for rel in _FIXTURE_FILES
+             if (root / rel).is_file()]
+    return lint.lint_paths(files, root, rules=_MODULE_RULES)
+
+
+def mutate(root: Path, rel: str, old: str, new: str) -> None:
+    p = root / rel
+    text = p.read_text()
+    assert old in text, f"mutation anchor not found in {rel}: {old!r}"
+    p.write_text(text.replace(old, new, 1))
+
+
+def test_unmutated_tree_is_clean(tree):
+    assert prove(tree) == []
+
+
+def test_real_repo_is_clean():
+    # the rules run against the live tree in the self-hosting gate
+    # too; this pins the direct path the mutation tests exercise
+    assert prove(REPO) == []
+
+
+# -- the five acceptance-mandated mutations ---------------------------------
+
+def test_undeclared_store_file_is_caught(tree):
+    # a new on-disk format slipped in without a registry entry
+    mutate(tree, "jepsen_tpu/store.py",
+           "return Path(store_base) / COSTDB_NAME",
+           'return Path(store_base) / "costdb.sqlite"')
+    findings = prove(tree)
+    assert [f.rule for f in findings] == ["JT-DUR-001"]
+    assert "costdb.sqlite" in findings[0].message
+
+
+def test_non_atomic_snapshot_publish_is_caught(tree):
+    # the shard done marker published on its final name: a crash
+    # mid-write leaves a torn marker the coordinator would trust
+    mutate(tree, "jepsen_tpu/supervisor.py",
+           "trace.atomic_write_text(shard_done_path(store_base, shard),\n"
+           "                                json.dumps(payload))",
+           "shard_done_path(store_base, shard).write_text(\n"
+           "            json.dumps(payload))")
+    findings = prove(tree)
+    assert [f.rule for f in findings] == ["JT-DUR-002"]
+    assert ".shard-*.done" in findings[0].message
+
+
+def test_dropped_flush_is_caught(tree):
+    # the verdict journal's per-record flush removed: a SIGKILL loses
+    # every buffered verdict, exactly what --resume depends on
+    mutate(tree, "jepsen_tpu/store.py",
+           '            self._f.write(json.dumps(entry) + "\\n")\n'
+           "            self._f.flush()",
+           '            self._f.write(json.dumps(entry) + "\\n")')
+    findings = prove(tree)
+    assert [f.rule for f in findings] == ["JT-DUR-003"]
+    assert "flush" in findings[0].message
+
+
+def test_torn_tail_reader_bypass_is_caught(tree):
+    # the coordinator merging shard journals with raw json.loads over
+    # raw lines: a crash-torn tail poisons the whole merge
+    mutate(tree, "jepsen_tpu/mesh.py",
+           "        loaded = VerdictJournal.load("
+           "shard_journal_path(store_base, k))",
+           "        loaded = {}\n"
+           "        for _ln in shard_journal_path(store_base, k)"
+           ".read_text().splitlines():\n"
+           "            _e = json.loads(_ln)\n"
+           '            loaded[(_e["dir"], _e["checker"])] = _e')
+    findings = prove(tree)
+    assert [f.rule for f in findings] == ["JT-DUR-004"]
+    assert "torn-tail" in findings[0].message
+
+
+def test_stripped_retention_class_is_caught(monkeypatch):
+    # an append-forever artifact whose retention class vanishes: the
+    # registry half of ROADMAP item 5's bounded-retention lever
+    stripped = tuple(
+        dataclasses.replace(a, retention=None)
+        if a.name == "cost database" else a
+        for a in contracts.STORE_ARTIFACTS)
+    monkeypatch.setattr(contracts, "STORE_ARTIFACTS", stripped)
+    rule = rules_dur.UndeclaredRetention()
+    findings = list(rule.check_project(ProjectCtx(REPO, [])))
+    assert [f.rule for f in findings] == ["JT-DUR-005"]
+    assert "cost database" in findings[0].message
+
+
+def test_unknown_retention_token_is_caught(monkeypatch):
+    bad = tuple(
+        dataclasses.replace(a, retention="whenever")
+        if a.name == "health snapshot" else a
+        for a in contracts.STORE_ARTIFACTS)
+    monkeypatch.setattr(contracts, "STORE_ARTIFACTS", bad)
+    rule = rules_dur.UndeclaredRetention()
+    findings = list(rule.check_project(ProjectCtx(REPO, [])))
+    assert [f.rule for f in findings] == ["JT-DUR-005"]
+    assert "whenever" in findings[0].message
+
+
+def test_retention_registry_is_clean():
+    rule = rules_dur.UndeclaredRetention()
+    assert list(rule.check_project(ProjectCtx(REPO, []))) == []
+
+
+# -- the generated README table ---------------------------------------------
+
+def test_dur_table_drift(tmp_path):
+    rule = rules_dur.DurTableDrift()
+    ctx = ProjectCtx(tmp_path, [])
+    (tmp_path / "README.md").write_text(
+        contracts.DUR_BEGIN + "\n| drifted |\n" + contracts.DUR_END + "\n")
+    assert [f.rule for f in rule.check_project(ctx)] == ["JT-DUR-006"]
+    (tmp_path / "README.md").write_text(
+        "intro\n\n" + contracts.render_dur_block() + "\n\noutro\n")
+    assert list(rule.check_project(ctx)) == []
+    (tmp_path / "README.md").write_text("no markers at all\n")
+    assert [f.rule for f in rule.check_project(ctx)] == ["JT-DUR-006"]
+
+
+# -- registry shape pins ----------------------------------------------------
+
+def test_registry_shape():
+    names = [a.name for a in contracts.STORE_ARTIFACTS]
+    assert len(names) == len(set(names))
+    for a in contracts.STORE_ARTIFACTS:
+        assert a.protocol in contracts.PROTOCOLS, a.name
+        assert a.patterns, a.name
+        for w in a.writers + a.readers:
+            assert ":" in w, (a.name, w)
+    # the formats the motivation names are all declared
+    for tail in ("verdicts.jsonl", "verdicts-3.jsonl", "events.jsonl",
+                 "events.jsonl.1", "costdb.jsonl",
+                 "costdb-shard2.jsonl", "trace-1234.jsonl",
+                 "health.json", "trace.json", "trace-shard1.json",
+                 "metrics.json", "report.json", "encoded.v2.bin",
+                 ".shard-0.done"):
+        assert contracts.artifact_for_name(tail) is not None, tail
+    # and an undeclared name stays undeclared
+    assert contracts.artifact_for_name("serve.jsonl") is None
+
+
+def test_declared_writers_and_readers_exist():
+    # the registry's sanctioned helpers must be real functions in the
+    # named modules — a rename (or a stale entry) is a visible failure
+    # here, not a silently-dead exemption
+    import ast
+
+    from jepsen_tpu.lint import fileflow
+    for a in contracts.STORE_ARTIFACTS:
+        for spec in a.writers + a.readers:
+            rel, qual = spec.split(":")
+            tree = ast.parse((REPO / rel).read_text())
+            quals = set(fileflow._qualnames(tree).values())
+            # context-manager writers (jax_profile_session) are classes
+            quals.update(n.name for n in ast.walk(tree)
+                         if isinstance(n, ast.ClassDef))
+            assert qual in quals, f"{a.name}: {spec} does not exist"
+
+
+def test_path_helpers_resolve_to_their_artifact():
+    assert contracts.PATH_HELPERS["costdb_path"].name == "cost database"
+    assert contracts.PATH_HELPERS["shard_journal_path"].name \
+        == "verdict journal"
+    assert contracts.PATH_HELPERS["spool_path"].name \
+        == "worker trace spool"

@@ -31,6 +31,7 @@ ALL_GATES = [
     "JEPSEN_TPU_JAX_PROFILE",
     "JEPSEN_TPU_HEALTH_INTERVAL_S",
     "JEPSEN_TPU_METRICS_PORT",
+    "JEPSEN_TPU_EVENTS_MAX_BYTES",
     "JEPSEN_TPU_COSTDB",
     "JEPSEN_TPU_RESIDENCY_INTERVAL_S",
     "JEPSEN_TPU_BACKEND",

@@ -45,7 +45,7 @@ def findings_of(path: Path) -> list[tuple[int, str]]:
 
 
 FAMILIES = ["gates", "jax", "concurrency", "shm", "trace", "tensor",
-            "lock"]
+            "lock", "dur"]
 
 
 @pytest.mark.parametrize("family", FAMILIES)
@@ -242,6 +242,45 @@ def test_blocking_registry_drives_the_rule(tmp_path):
     assert rules_lock._is_blocking(call) is None
 
 
+# -- the fileflow engine (JT-DUR) ------------------------------------------
+
+def test_append_handle_not_confused_by_rebound_writer(tmp_path):
+    # regression: a later same-named 'w' handle in the same function
+    # must not donate its (legitimately unflushed) write to the
+    # append handle's history — handle regions end at rebinding
+    src = ("import json\n"
+           "def emit(p, meta, line, hdr):\n"
+           "    with open(p, 'a') as f:\n"
+           "        f.write(line)\n"
+           "        f.flush()\n"
+           "    with open(meta, 'w') as f:\n"
+           "        f.write(hdr)\n")
+    assert _lint_at(tmp_path, "pkg/m.py", src) == []
+
+
+def test_append_write_then_explicit_close_is_flushed(tmp_path):
+    # an explicit close() drains the buffer and ends observability —
+    # per the JT-DUR-003 contract that's as durable as a flush
+    src = ("def seal(p):\n"
+           "    f = open(p, 'a')\n"
+           "    f.write('x\\n')\n"
+           "    f.close()\n")
+    assert _lint_at(tmp_path, "pkg/m.py", src) == []
+
+
+def test_append_write_after_close_region_still_fires(tmp_path):
+    # but a write with no flush/close after it still fires even when
+    # an earlier region closed cleanly
+    src = ("def bad(p):\n"
+           "    f = open(p, 'a')\n"
+           "    f.write('x\\n')\n"
+           "    f.close()\n"
+           "    f = open(p, 'a')\n"
+           "    f.write('y\\n')\n"
+           "    return f\n")
+    assert _lint_at(tmp_path, "pkg/m.py", src) == ["JT-DUR-003"]
+
+
 # -- the self-hosting contract ---------------------------------------------
 
 def test_package_is_clean_against_baseline():
@@ -259,9 +298,9 @@ def test_rule_families_all_registered():
     ids = lint.rule_ids()
     assert len(ids) == len(set(ids))
     for fam in ("JT-GATE", "JT-JAX", "JT-THREAD", "JT-SHM", "JT-TRACE",
-                "JT-ABI", "JT-TENSOR", "JT-LOCK", "JT-META"):
+                "JT-ABI", "JT-TENSOR", "JT-LOCK", "JT-DUR", "JT-META"):
         assert any(i.startswith(fam + "-") for i in ids), fam
-    assert len(ids) >= 29
+    assert len(ids) >= 36
 
 
 #: The GOLDEN rule-id table. Renumbering an existing rule, dropping
@@ -271,6 +310,8 @@ def test_rule_families_all_registered():
 #: JT-TENSOR-002, see MIGRATING.md.)
 GOLDEN_RULE_IDS = [
     "JT-ABI-001", "JT-ABI-002", "JT-ABI-003", "JT-ABI-004",
+    "JT-DUR-001", "JT-DUR-002", "JT-DUR-003", "JT-DUR-004",
+    "JT-DUR-005", "JT-DUR-006",
     "JT-GATE-001", "JT-GATE-002", "JT-GATE-003", "JT-GATE-004",
     "JT-JAX-001", "JT-JAX-002", "JT-JAX-003", "JT-JAX-004",
     "JT-LOCK-001", "JT-LOCK-002", "JT-LOCK-003", "JT-LOCK-004",

@@ -247,6 +247,146 @@ def test_load_events_skips_torn_tail(tmp_path):
     assert [e["event"] for e in evs] == ["sweep_start", "sweep_end"]
 
 
+def test_events_rotation_default_off(tmp_path, monkeypatch):
+    monkeypatch.delenv("JEPSEN_TPU_EVENTS_MAX_BYTES", raising=False)
+    obs.install_events(tmp_path)
+    for i in range(50):
+        obs.emit("sweep_start", checker="append", runs=i)
+    assert not (tmp_path / "events.jsonl.1").exists()
+    assert len(obs.load_events(tmp_path)) == 50
+
+
+def test_events_rotate_at_cap(tmp_path, monkeypatch):
+    # the registry's declared `rotated` retention class made real:
+    # the over-cap log is renamed aside atomically and the fresh log
+    # opens with an events_rotated record naming it
+    monkeypatch.setenv("JEPSEN_TPU_EVENTS_MAX_BYTES", "400")
+    obs.install_events(tmp_path)
+    for i in range(40):
+        obs.emit("sweep_start", checker="append", runs=i)
+    rotated = tmp_path / "events.jsonl.1"
+    assert rotated.exists()
+    # every rotated-aside line is complete (rename is atomic — no
+    # torn records created by rotation itself); at this cap the log
+    # rotates repeatedly, so the kept generation may itself start
+    # with the previous rotation's mark
+    old = obs.load_events(rotated)
+    assert old and all(e["event"] in ("sweep_start", "events_rotated")
+                       for e in old)
+    live = obs.load_events(tmp_path)
+    assert live[0]["event"] == "events_rotated"
+    assert live[0]["rotated_to"] == "events.jsonl.1"
+    assert live[0]["size"] >= 400
+    # nothing lost across the rotation boundary: one generation kept
+    # plus the live log covers the tail of the emits
+    seen = [e["runs"] for e in old + live if e["event"] == "sweep_start"]
+    assert seen == sorted(seen) and seen[-1] == 39
+    # the live log stays under cap + one rotation's slack
+    assert (tmp_path / "events.jsonl").stat().st_size < 400 + 400
+
+
+def test_events_rotation_keeps_one_generation(tmp_path, monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_EVENTS_MAX_BYTES", "300")
+    obs.install_events(tmp_path)
+    for i in range(120):
+        obs.emit("sweep_start", checker="append", runs=i)
+    names = sorted(p.name for p in tmp_path.glob("events.jsonl*"))
+    assert names == ["events.jsonl", "events.jsonl.1"]
+
+
+def test_events_rotation_cross_process_claim(tmp_path, monkeypatch):
+    # mesh shards share one store log: a concurrent rotator's live
+    # lockfile must make this emitter SKIP rotation (append only) —
+    # renaming with a stale size would destroy the kept generation
+    monkeypatch.setenv("JEPSEN_TPU_EVENTS_MAX_BYTES", "10")
+    obs.install_events(tmp_path)
+    obs.emit("sweep_start", checker="append")       # now over cap
+    lock = tmp_path / "events.jsonl.rotlock"
+    lock.write_text("")                             # a live claimant
+    obs.emit("sweep_end", exit_code=0)
+    assert not (tmp_path / "events.jsonl.1").exists()
+    assert [e["event"] for e in obs.load_events(tmp_path)] \
+        == ["sweep_start", "sweep_end"]
+    assert lock.exists()      # a LIVE lock is never broken
+    # a stale lock (its holder crashed mid-rotation) is broken so the
+    # NEXT emit can rotate again
+    stale = obs.events._ROTLOCK_STALE_S + 5
+    os.utime(lock, (time.time() - stale, time.time() - stale))
+    obs.emit("sweep_end", exit_code=0)              # breaks the lock
+    assert not lock.exists()
+    obs.emit("sweep_end", exit_code=0)              # rotates
+    assert (tmp_path / "events.jsonl.1").exists()
+    live = obs.load_events(tmp_path)
+    assert live[0]["event"] == "events_rotated"
+
+
+def test_events_rotation_stale_break_restores_live_claim(tmp_path,
+                                                         monkeypatch):
+    # the break is rename-then-verify: if ANOTHER claimant replaced
+    # the stale lock between our staleness stat and our rename, we
+    # renamed a LIVE claim — it must be renamed straight back, not
+    # deleted (deleting it would let two rotators run at once)
+    from jepsen_tpu.obs import events as ev
+    monkeypatch.setenv("JEPSEN_TPU_EVENTS_MAX_BYTES", "10")
+    obs.install_events(tmp_path)
+    obs.emit("sweep_start", checker="append")        # over cap
+    lock = tmp_path / "events.jsonl.rotlock"
+    lock.write_text("")
+    stale = ev._ROTLOCK_STALE_S + 5
+    os.utime(lock, (time.time() - stale, time.time() - stale))
+    real_rename = os.rename
+    fired = {"v": False}
+
+    def racing_rename(src, dst):
+        if Path(src) == lock and not fired["v"]:
+            fired["v"] = True
+            # between our stat and rename, another breaker removed
+            # the stale lock and a fresh claimant took the path
+            os.unlink(lock)
+            lock.write_text("")
+        return real_rename(src, dst)
+
+    monkeypatch.setattr(os, "rename", racing_rename)
+    obs.emit("sweep_end", exit_code=0)
+    monkeypatch.setattr(os, "rename", real_rename)
+    assert lock.exists()                 # the live claim came back
+    assert not (tmp_path / "events.jsonl.1").exists()
+    assert list(tmp_path.glob("events.jsonl.rotlock.*")) == []
+
+
+def test_events_rotation_restat_under_claim(tmp_path, monkeypatch):
+    # the clobber race, replayed deterministically: an emitter whose
+    # pre-claim stat is stale (another process already rotated and
+    # the live log is small again) must NOT rotate — the re-stat
+    # under the lock catches it
+    from jepsen_tpu.obs import events as ev
+    monkeypatch.setenv("JEPSEN_TPU_EVENTS_MAX_BYTES", "100")
+    obs.install_events(tmp_path)
+    p = tmp_path / "events.jsonl"
+    real_stat = Path.stat
+    calls = {"n": 0}
+
+    def racing_stat(self, *a, **kw):
+        res = real_stat(self, *a, **kw)
+        if self == p:
+            calls["n"] += 1
+            if calls["n"] == 1:
+                # the pre-claim probe saw the PRE-ROTATION size; the
+                # "other process" rotates right after it
+                os.replace(p, tmp_path / "events.jsonl.1")
+                p.write_text('{"event": "events_rotated"}\n')
+        return res
+
+    p.write_text('{"event": "sweep_start"}\n' * 8)   # over cap
+    kept = (tmp_path / "events.jsonl.1")
+    monkeypatch.setattr(Path, "stat", racing_stat)
+    assert ev._maybe_rotate(p) is None               # re-stat saved it
+    monkeypatch.setattr(Path, "stat", real_stat)
+    # the concurrently-kept generation survived intact
+    assert kept.read_text() == '{"event": "sweep_start"}\n' * 8
+    assert not (tmp_path / "events.jsonl.rotlock").exists()
+
+
 def test_fault_inject_sweep_records_every_quarantine(
         tmp_path, capsys, monkeypatch):
     """The acceptance case: a `JEPSEN_TPU_FAULT_INJECT kill:` sweep
