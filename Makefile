@@ -9,7 +9,7 @@ TSAN_RT := $(shell gcc -print-file-name=libtsan.so)
 
 .PHONY: lint lint-json lint-changed env-table rule-table dur-table \
 	crash-smoke test native native-sanitize bench bench-report \
-	bench-warm obs-smoke trace-report cost-report
+	bench-warm obs-smoke serve-smoke trace-report cost-report
 
 # Self-hosted static analysis: gate registry, JAX hazards, concurrency
 # discipline, shm lifecycle, tracer discipline, plus the cross-boundary
@@ -129,6 +129,14 @@ bench-warm:
 # track with encode spans; shares sum to ~1.0). Exit 0/1.
 obs-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m jepsen_tpu.obs.smoke
+
+# Verdict-service smoke: the REAL `jepsen-tpu serve` daemon as a
+# subprocess over a synthetic store, two concurrent tenants through
+# the real socket, a mid-flight /metrics scrape (per-tenant series),
+# a SIGTERM drain (exit 0, zero lost/duplicated journal entries), and
+# streamed-vs-`analyze-store` byte-identical verdict parity. Exit 0/1.
+serve-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m jepsen_tpu.serve.smoke
 
 # Convenience: re-sweep an existing store (STORE ?= store) and emit
 # the merged trace + critical-path attribution report

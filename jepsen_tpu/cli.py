@@ -210,10 +210,36 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
                               "_SHARDS or the jax.distributed job")
     add_trace_opts(p_batch)
 
-    p_serve = sub.add_parser("serve", help="serve the store over HTTP")
-    p_serve.add_argument("--port", type=int, default=8080)
-    p_serve.add_argument("--host", default="0.0.0.0")
+    p_serve = sub.add_parser(
+        "serve",
+        help="run the multi-tenant verdict daemon: tenants stream "
+             "histories over a local socket and get verdicts back "
+             "while their tests run (continuous batching, per-tenant "
+             "fairness, journaled verdicts; analyze-store remains the "
+             "batch path). --web serves the legacy HTTP store browser "
+             "instead.")
+    p_serve.add_argument("--port", type=int, default=None,
+                         help="TCP port for the daemon (default: unix "
+                              "socket <store>/serve.sock); with --web, "
+                              "the HTTP port (default 8080)")
+    p_serve.add_argument("--host", default=None,
+                         help="bind address (default 127.0.0.1 for "
+                              "the daemon, the historical 0.0.0.0 "
+                              "for --web)")
     p_serve.add_argument("--store", default="store")
+    p_serve.add_argument("--socket", default=None,
+                         help="unix-socket path the daemon listens on "
+                              "(default <store>/serve.sock; "
+                              "JEPSEN_TPU_SERVE_SOCKET is the env "
+                              "equivalent)")
+    p_serve.add_argument("--drain-timeout", type=float, default=None,
+                         help="seconds to drain admitted work on "
+                              "SIGTERM (default "
+                              "JEPSEN_TPU_SERVE_DRAIN_S)")
+    p_serve.add_argument("--web", action="store_true",
+                         help="serve the legacy HTTP store browser "
+                              "instead of the verdict daemon")
+    add_trace_opts(p_serve)
 
     from . import lint as _lint   # stdlib-only, import-cheap
     p_lint = sub.add_parser(
@@ -333,9 +359,18 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
                                  report=args.report or None,
                                  mesh=args.mesh or None)
         if args.command == "serve":
-            from . import web
-            web.serve(Store(args.store), host=args.host, port=args.port)
-            return 0
+            if args.web:
+                from . import web
+                web.serve(Store(args.store),
+                          host=args.host or "0.0.0.0",
+                          port=args.port if args.port is not None
+                          else 8080)
+                return 0
+            from .serve import run_daemon
+            return run_daemon(Store(args.store),
+                              socket_path=args.socket, port=args.port,
+                              host=args.host or "127.0.0.1",
+                              drain_s=args.drain_timeout)
         return 254
     except KeyboardInterrupt:
         return 255

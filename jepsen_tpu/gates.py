@@ -260,6 +260,27 @@ _g("JEPSEN_TPU_MESH_WAIT_S", "float", 600.0,
    "seconds the mesh coordinator (shard 0) waits for the other "
    "shards' done markers before declaring them lost (re-assignable, "
    "exit code ≥2) and merging what exists; `0` merges immediately")
+# -- verdict service --------------------------------------------------------
+_g("JEPSEN_TPU_SERVE_SOCKET", "str", None,
+   "unix-socket path the `serve` verdict daemon listens on (default "
+   "`<store>/serve.sock`); tenants stream length-prefixed frames over "
+   "it and get verdicts back")
+_g("JEPSEN_TPU_SERVE_PORT", "int", None,
+   "TCP port for the `serve` daemon instead of the unix socket "
+   "(`0` binds an ephemeral port, printed in the ready line); unset = "
+   "unix socket")
+_g("JEPSEN_TPU_SERVE_MAX_QUEUE", "int", 256,
+   "per-tenant admission-queue depth of the `serve` daemon; a CHECK "
+   "past the cap gets an explicit `retry-after` frame (never a "
+   "silent drop)")
+_g("JEPSEN_TPU_SERVE_WEIGHTS", "str", "",
+   "per-tenant fairness weights for the `serve` daemon's continuous "
+   "batcher, e.g. `fleetA=3,fleetB=1` (unlisted tenants weigh 1); "
+   "fold shares follow weighted deficit round-robin")
+_g("JEPSEN_TPU_SERVE_DRAIN_S", "float", 30.0,
+   "seconds the `serve` daemon spends draining admitted work on "
+   "SIGTERM before closing; work never admitted (or past the "
+   "deadline) is left for the tenant to resend — never half-acked")
 # -- robustness -------------------------------------------------------------
 _g("JEPSEN_TPU_STRICT", "bool", False,
    "set: restore fail-fast — no quarantine, no OOM backdown; the "

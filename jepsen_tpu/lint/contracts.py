@@ -344,6 +344,43 @@ STORE_ARTIFACTS: tuple[StoreArtifact, ...] = (
         retention="replaced",
         doc="monotonic symlinks to the newest run dir"),
     StoreArtifact(
+        "serve tenant journal", ("serve-*.verdicts.jsonl",), "journal",
+        writers=("jepsen_tpu/store.py:VerdictJournal.record",),
+        readers=("jepsen_tpu/store.py:VerdictJournal.load",),
+        retention="store-lifetime",
+        helpers=("tenant_journal_path",),
+        doc="one tenant's verdict log from the serve daemon — FULL "
+            "result per line (journal-then-reply: written before the "
+            "ack frame), replayed on reconnect without re-checking; "
+            "compaction is ROADMAP item 5"),
+    StoreArtifact(
+        "serve request spool", ("serve-requests.jsonl",), "spool",
+        writers=("jepsen_tpu/serve/daemon.py:RequestSpool.append",),
+        readers=("jepsen_tpu/serve/daemon.py:RequestSpool.load",),
+        retention="per-sweep",
+        helpers=("request_spool_path",),
+        doc="one flushed line per admitted request (tenant/id/"
+            "checker) — crash triage for admitted-but-unverdicted "
+            "work; cleared at daemon start"),
+    StoreArtifact(
+        "serve socket", ("serve.sock",), "marker",
+        writers=("jepsen_tpu/serve/daemon.py:VerdictDaemon._bind",),
+        readers=(),
+        retention="per-sweep",
+        helpers=("serve_socket_path",),
+        doc="the daemon's unix listen socket "
+            "(JEPSEN_TPU_SERVE_SOCKET overrides); a stale one (prior "
+            "daemon SIGKILLed) is probe-reclaimed at bind, removed at "
+            "drain"),
+    StoreArtifact(
+        "serve pidfile", ("serve.pid",), "marker",
+        writers=("jepsen_tpu/serve/daemon.py:VerdictDaemon.start",),
+        readers=(),
+        retention="per-sweep",
+        helpers=("serve_pid_path",),
+        doc="the daemon's pid + listen address, published atomically "
+            "(temp+`os.replace`), removed at drain"),
+    StoreArtifact(
         "encoded sidecar", ("encoded*.bin",), "sidecar",
         writers=("jepsen_tpu/store.py:save_encoded",),
         readers=("jepsen_tpu/store.py:load_encoded",),

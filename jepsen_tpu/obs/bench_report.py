@@ -114,6 +114,17 @@ METRICS: tuple[MetricSpec, ...] = (
                True, 0.30),
     MetricSpec("mesh_eff", "mesh 2-shard scaling efficiency",
                ("mesh", "scaling_efficiency"), True, 0.15, floor=0.70),
+    # the verdict service under the open-loop two-tenant load
+    # generator: sustained streamed-verdict throughput, and the p99
+    # end-to-end verdict latency the daemon is contractually required
+    # to bound — the 30 s ceiling is the declared threshold (at ~70%
+    # of probed capacity a p99 past it means queueing broke, whatever
+    # the predecessor did), and the 0.50 tolerance absorbs CI jitter
+    # between rounds
+    MetricSpec("serve_rate", "serve streamed verdicts/sec",
+               ("serve", "value"), True, 0.30),
+    MetricSpec("serve_p99_ms", "serve p99 verdict latency (ms)",
+               ("serve", "p99_ms"), False, 0.50, ceiling=30_000.0),
     # the device cost observatory's roofline number: XLA-modeled bytes
     # accessed over measured device seconds, as a share of the
     # peak-table HBM bandwidth. Estimated-provenance rounds (CPU-only

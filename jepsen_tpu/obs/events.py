@@ -61,6 +61,16 @@ EVENT_KINDS = frozenset({
     "events_rotated",     # rotated_to, size (the log hit
     #                       JEPSEN_TPU_EVENTS_MAX_BYTES and was
     #                       renamed aside; first line of the new log)
+    # -- the serve_* group: the verdict daemon's lifecycle ---------------
+    "serve_start",        # socket|port, store (daemon accepting)
+    "serve_tenant_connect",   # tenant, weight, journaled (replayable)
+    "serve_admit",        # histories, tenants (one continuous-batch
+    #                       fold formed from the admission queues)
+    "serve_backpressure",  # tenant, depth (explicit retry-after frame
+    #                       sent — a full queue never drops silently)
+    "serve_drain",        # pending, reason (SIGTERM/stop: admission
+    #                       closed, queued work finishing)
+    "serve_stop",         # verdicts, drained (daemon exit)
 })
 
 _lock = threading.Lock()

@@ -38,11 +38,15 @@ def health_interval_s() -> float | None:
 
 
 def health_snapshot(tracer=None, *, seq: int = 0,
-                    started_mono: float | None = None) -> dict:
+                    started_mono: float | None = None,
+                    extra: dict | None = None) -> dict:
     """The one snapshot shape health.json and `/healthz` both serve,
     derived entirely from the current tracer's metrics (plus the
     sampler's own heartbeat bookkeeping). Works against the NullTracer
-    too — every field the metrics can't answer is null, never absent."""
+    too — every field the metrics can't answer is null, never absent.
+    `extra` merges owner-specific top-level sections into the snapshot
+    (the serve daemon's `"serve"` section rides this seam); the core
+    keys always win on a collision."""
     tr = tracer if tracer is not None else trace.get_current()
     md = tr.metrics_dict() if getattr(tr, "enabled", False) else {}
     c = md.get("counters", {})
@@ -56,6 +60,7 @@ def health_snapshot(tracer=None, *, seq: int = 0,
     if rate and isinstance(total, (int, float)) and total > done:
         eta = (total - done) / rate
     return {
+        **(extra or {}),
         "v": 1,
         "run": getattr(tr, "run", None),
         # the liveness signal: seq strictly increases per write and
@@ -116,10 +121,13 @@ class HealthSampler:
     `fresh_run` swap mid-flight is picked up automatically."""
 
     def __init__(self, store_base, interval: float,
-                 tracer_fn=trace.get_current):
+                 tracer_fn=trace.get_current, extra_fn=None):
         self.path = Path(store_base) / HEALTH_NAME
         self.interval = float(interval)
         self._tracer_fn = tracer_fn
+        # owner-specific snapshot section (the serve daemon's "serve"
+        # dict), read at each tick like the tracer; None = core only
+        self._extra_fn = extra_fn
         self._seq = 0
         self._t0 = time.monotonic()
         # serializes the tick thread against /healthz handler threads
@@ -140,8 +148,15 @@ class HealthSampler:
     def write_snapshot(self) -> dict:
         with self._wlock:
             self._seq += 1
+            extra = None
+            if self._extra_fn is not None:
+                try:
+                    extra = self._extra_fn()
+                except Exception:
+                    log.debug("health extra section failed",
+                              exc_info=True)
             snap = health_snapshot(self._tracer_fn(), seq=self._seq,
-                                   started_mono=self._t0)
+                                   started_mono=self._t0, extra=extra)
             write_health(self.path, snap)
         return snap
 
@@ -167,13 +182,13 @@ class HealthSampler:
 
 
 def maybe_start_health_sampler(store_base,
-                               tracer_fn=trace.get_current
-                               ) -> HealthSampler | None:
+                               tracer_fn=trace.get_current,
+                               extra_fn=None) -> HealthSampler | None:
     """Start the sampler when JEPSEN_TPU_HEALTH_INTERVAL_S enables it;
     None (and zero work) otherwise — the sweep's one-line integration
     point."""
     interval = health_interval_s()
     if interval is None:
         return None
-    return HealthSampler(store_base, interval,
-                         tracer_fn=tracer_fn).start()
+    return HealthSampler(store_base, interval, tracer_fn=tracer_fn,
+                         extra_fn=extra_fn).start()

@@ -1,0 +1,255 @@
+"""Batch folding: the continuous-batcher's scheduler seam.
+
+`check_bucketed_async` answers "given THIS list of histories, sweep
+them efficiently" — the batch question. A verdict service asks the
+inverse: many tenants' admission queues are filling concurrently, and
+as device slots free up the daemon must decide WHICH pending histories
+form the next shared bucket dispatch. That decision lives here, next
+to the dispatcher it feeds, in two pieces:
+
+  * `plan_fold` — weighted deficit round-robin (DRR) across per-tenant
+    lanes under a padded-cell budget. The cost unit is `fold_cost`
+    (T_pad² closure cells — the same geometry `bucket_by_length`
+    budgets), so admission control is BY HISTORY SIZE, not request
+    count: the complexity bounds in arxiv 1908.04509 make cost grow
+    with history length, and a fairness scheme that charged a 5-txn
+    and a 5000-txn history the same would let one tenant's long tail
+    starve everyone. Deficits persist across folds (the caller owns
+    the lanes), so a tenant whose head is briefly unaffordable earns
+    credit instead of starving.
+  * `FoldDispatcher` — one owner's dispatch loop over the folds:
+    routes each fold through `check_bucketed` (OOM backdown, watchdog
+    quarantine, donated slots and the shared `ExecutableResidency` all
+    included — a fold is just a caller-chosen chunk) and renders the
+    SAME verdict dicts `analyze-store` persists, so a streamed verdict
+    is byte-identical to the post-hoc one for the same history. A fold
+    that fails outright quarantines ONLY its own histories: a poisoned
+    tenant costs its bucket share, never the daemon.
+
+Both are plain objects with no socket/tenant knowledge — the serve
+daemon composes them; the mesh sweep (or a future planner) can too.
+"""
+
+from __future__ import annotations
+
+import logging
+
+log = logging.getLogger(__name__)
+
+#: Default fold budget in padded closure cells — the same envelope
+#: `check_bucketed_async` budgets per dispatch pipeline.
+DEFAULT_FOLD_CELLS = 1 << 27
+
+#: DRR safety valve: rounds are bounded so a pathological lane set can
+#: never spin the scheduler (a full-budget head is affordable within
+#: ~8·lanes rounds at the default quantum; 1024 is far past any real
+#: shape).
+_MAX_ROUNDS = 1024
+
+
+def fold_cost(n_txns: int, multiple: int = 128) -> int:
+    """The padded closure footprint one history contributes to a
+    shared bucket: T_pad² cells with the txn axis rounded up to the
+    MXU tile — `bucket_by_length`'s unit, restated jax-free so
+    admission can price a request before any device work."""
+    t = max(int(n_txns), 1)
+    t = max(multiple, ((t + multiple - 1) // multiple) * multiple)
+    return t * t
+
+
+class Lane:
+    """One tenant's scheduling lane: a FIFO of cost-carrying items, a
+    fairness weight, and the DRR deficit counter `plan_fold` maintains.
+    The queue is a plain deque; the OWNER serializes access (the serve
+    daemon holds its admission lock around admit and plan)."""
+
+    __slots__ = ("name", "weight", "deficit", "queue")
+
+    def __init__(self, name: str, weight: float = 1.0):
+        from collections import deque
+        self.name = name
+        # a zero/negative weight would never earn deficit — clamp to a
+        # small positive floor so every admitted tenant eventually runs
+        self.weight = max(float(weight), 1e-3)
+        self.deficit = 0.0
+        self.queue = deque()
+
+
+def plan_fold(lanes, *, budget_cells: int = DEFAULT_FOLD_CELLS,
+              max_histories: int | None = None) -> list:
+    """Pick the next fold: weighted deficit round-robin over `lanes`
+    (Lane objects), popping items until the padded-cell budget (or
+    `max_histories`) is reached. Returns [(lane, item), ...] in pick
+    order; items must carry `.cost` (a `fold_cost` value).
+
+    Contract: with equally-sized items and saturated queues, pick
+    counts converge to the weight ratio; an item larger than the whole
+    budget still dispatches (alone — the dispatcher's oversized-
+    singleton path owns it from there); a lane's deficit resets when
+    its queue drains, so idle tenants can't hoard credit."""
+    active = [ln for ln in lanes if ln.queue]
+    if not active:
+        return []
+    # quantum granularity bounds fairness error: one round must not
+    # hand a lane more credit than ~one typical item, or the first
+    # lane drains the whole fold before the second's turn — so the
+    # quantum is capped at the smallest head cost (and at an 1/8th
+    # budget share for the monster-head case)
+    quantum = max(1.0, min(float(budget_cells) / (8 * len(active)),
+                           float(min(ln.queue[0].cost
+                                     for ln in active))))
+    picked: list = []
+    cells = 0
+
+    def fits(cost: int) -> bool:
+        if picked and cells + cost > budget_cells:
+            return False
+        return max_histories is None or len(picked) < max_histories
+
+    for _ in range(_MAX_ROUNDS):
+        earned = False
+        for ln in active:
+            if not ln.queue or not fits(ln.queue[0].cost):
+                continue
+            ln.deficit += ln.weight * quantum
+            earned = True
+            while ln.queue and ln.deficit >= ln.queue[0].cost \
+                    and fits(ln.queue[0].cost):
+                item = ln.queue.popleft()
+                picked.append((ln, item))
+                cells += item.cost
+                ln.deficit -= item.cost
+        if not earned:
+            break   # fold full, or every queue drained
+    if not picked and active:
+        # _MAX_ROUNDS safety valve tripped: take one head anyway —
+        # the scheduler must always make progress
+        ln = active[0]
+        picked.append((ln, ln.queue.popleft()))
+        ln.deficit = 0.0
+    for ln in lanes:
+        if not ln.queue:
+            ln.deficit = 0.0
+    return picked
+
+
+class FoldDispatcher:
+    """Dispatch one fold of encoded histories and render the exact
+    verdict dicts `analyze-store` would persist for them.
+
+    Shares the process-wide `ExecutableResidency` (AOT-cached
+    executables stay resident across folds — the daemon's whole point)
+    and the supervisor's recovery ladder via `check_bucketed`: OOM
+    backdown, watchdog quarantine, per-history `Quarantined`
+    sentinels. Any error that still escapes quarantines the WHOLE
+    fold's histories (`valid? unknown`, cause attached) instead of
+    propagating — one tenant's poison costs its bucket share, never
+    the dispatch loop."""
+
+    def __init__(self, mesh=None, budget_cells: int = DEFAULT_FOLD_CELLS,
+                 max_inflight: int = 2):
+        self.mesh = mesh
+        self.budget_cells = budget_cells
+        self.max_inflight = max_inflight
+        self.phases: dict = {}
+
+    @staticmethod
+    def _host_only() -> bool:
+        from .. import gates
+        return gates.get("JEPSEN_TPU_BACKEND") == "cpu"
+
+    def verdicts(self, encs: list, checker: str = "append") -> list[dict]:
+        """Per-history verdict dicts for one fold, aligned with
+        `encs`. Entries that are Exceptions (a failed encode riding
+        the queue) quarantine individually at the `encode` stage."""
+        from .. import supervisor as sv
+        out: list = [None] * len(encs)
+        good_idx = [i for i, e in enumerate(encs)
+                    if not isinstance(e, Exception)]
+        for i, e in enumerate(encs):
+            if isinstance(e, Exception):
+                out[i] = sv.quarantine_verdict(e, "encode", checker)
+        good = [encs[i] for i in good_idx]
+        if good:
+            try:
+                rendered = self._check(good, checker)
+            except Exception as e:
+                log.warning("fold dispatch failed; quarantining %d "
+                            "histories", len(good), exc_info=True)
+                rendered = [sv.quarantine_verdict(e, "dispatch",
+                                                  checker)
+                            for _ in good]
+            for i, res in zip(good_idx, rendered):
+                out[i] = res
+        return out
+
+    def _check(self, encs: list, checker: str) -> list[dict]:
+        from .. import parallel, supervisor as sv
+        from ..checker import elle
+        from ..checker.elle import kernels as elle_kernels
+        from ..checker.elle import wr as elle_wr
+        host_only = self._host_only()
+        if checker == "append":
+            prohibited = elle.AppendChecker().prohibited
+            if host_only:
+                cycles_per = [elle.cycle_anomalies_cpu(e) for e in encs]
+            else:
+                # the sweep's exact routing: histories past the dense
+                # [T,T] limit go through SCC condensation
+                # (check_long_history), everything else through the
+                # bucketed dispatch — a streamed verdict for a 100k-op
+                # history must match the post-hoc one, not quarantine
+                # on a doomed dense closure
+                cycles_per: list = [None] * len(encs)
+                dense = [i for i, e in enumerate(encs)
+                         if e.n <= parallel.DENSE_TXN_LIMIT]
+                if dense:
+                    got = parallel.check_bucketed(
+                        [encs[i] for i in dense], self.mesh,
+                        budget_cells=self.budget_cells,
+                        phases=self.phases)
+                    for i, cy in zip(dense, got):
+                        cycles_per[i] = cy
+                for i, e in enumerate(encs):
+                    if e.n <= parallel.DENSE_TXN_LIMIT:
+                        continue
+                    try:
+                        cycles_per[i] = parallel.check_long_history(
+                            e, None,
+                            dense_limit=parallel.DENSE_TXN_LIMIT)
+                    except Exception as err:
+                        # one monster history fails alone (the cli
+                        # huge-path contract)
+                        cycles_per[i] = sv.Quarantined("check",
+                                                       repr(err))
+            out = []
+            for enc, cycles in zip(encs, cycles_per):
+                if isinstance(cycles, sv.Quarantined):
+                    out.append(cycles.verdict("append"))
+                    continue
+                res = elle.render_verdict(enc, cycles, prohibited)
+                res["checker"] = "append"
+                out.append(res)
+            return out
+        if checker == "wr":
+            prohibited = elle_wr.WrChecker().prohibited
+            if host_only:
+                cycles_per = [elle_wr.cycle_anomalies_cpu(e)
+                              for e in encs]
+            else:
+                # the wr sweep's exact backdown ladder (bucketed batch
+                # -> singletons -> quarantine), shared with cli so the
+                # two dispatch owners can't drift
+                from ..cli import _wr_chunk_with_backdown
+                cycles_per = _wr_chunk_with_backdown(
+                    [(None, e) for e in encs], elle_kernels, elle_wr)
+            out = []
+            for enc, cycles in zip(encs, cycles_per):
+                if hasattr(cycles, "verdict"):   # supervisor.Quarantined
+                    out.append(cycles.verdict("wr"))
+                    continue
+                res = elle_wr.render_wr_verdict(enc, cycles, prohibited)
+                res["checker"] = "wr"
+                out.append(res)
+            return out
+        raise ValueError(f"unknown checker {checker!r}")

@@ -1,0 +1,43 @@
+"""jepsen_tpu.serve — the multi-tenant verdict daemon.
+
+Everything before this package was post-hoc: a store is written, then
+`analyze-store` sweeps it. At fleet scale (ROADMAP north star, open
+item 2) the checker is a long-lived SERVICE: many concurrent test
+fleets stream histories in over a local socket and get verdicts back
+while their tests are still running — the online-checking posture of
+arxiv 2504.01477, with admission control priced by history size per
+the complexity bounds of arxiv 1908.04509. Four pieces:
+
+  * `protocol` — the length-prefixed JSON frame layer (one magic+u32
+    header per frame). CHECK frames carry a run-dir reference, a shm
+    descriptor (`jepsen_tpu.shm`), or inline ops — the first two keep
+    encode zero-copy end to end: the daemon mmaps the tenant's
+    dispatch-shaped sidecar (or maps the tenant-exported segment)
+    exactly the way the pooled sweep does.
+  * `scheduler` — tenant admission: per-(tenant, checker) lanes with
+    weighted-fairness (JEPSEN_TPU_SERVE_WEIGHTS) over
+    `parallel.folding`'s deficit round-robin, a per-tenant queue-depth
+    cap (JEPSEN_TPU_SERVE_MAX_QUEUE) answered with explicit
+    `retry-after` frames — never a silent drop.
+  * `daemon` — the `python -m jepsen_tpu.cli serve` process: holds
+    AOT-cached executables and donated device slots resident
+    (`parallel.residency`), continuously folds pending histories from
+    different tenants into shared bucket dispatches as slots free up,
+    journals every verdict to a per-tenant `serve-<t>.verdicts.jsonl`
+    BEFORE acking it (a daemon crash loses nothing; reconnecting
+    tenants replay from the journal without re-checking), drains
+    gracefully on SIGTERM, and publishes `/metrics` + a `serve`
+    section in health.json + `serve_*` flight-recorder events.
+  * `client` — the tenant-side library the tests, the bench's open-
+    loop load generator and `make serve-smoke` drive the real socket
+    with.
+
+`analyze-store` remains the batch path; the daemon is the streaming
+one — both render verdicts through the same kernels and the same
+renderers, so for the same history the two are byte-identical (the
+`serve-smoke` acceptance check).
+"""
+
+from __future__ import annotations
+
+from .daemon import VerdictDaemon, run_daemon  # noqa: F401

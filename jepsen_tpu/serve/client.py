@@ -1,0 +1,185 @@
+"""Tenant-side client for the verdict daemon.
+
+A thin, dependency-light wrapper over the frame protocol: connect,
+hello, stream CHECK frames, collect verdicts. Handles the service's
+explicit flow control for the caller — `retry-after` frames are
+honored by re-sending after the daemon's delay hint (bounded), so
+`collect` returns exactly one verdict per submitted id or raises.
+
+The bench's open-loop load generator, `make serve-smoke` and the
+crash/restart tests all drive the REAL socket through this class —
+there is no in-process shortcut to accidentally test instead.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+from . import protocol
+
+
+class ServeError(RuntimeError):
+    pass
+
+
+class ServeClient:
+    def __init__(self, socket_path=None, host: str = "127.0.0.1",
+                 port: int | None = None, tenant: str = "default",
+                 weight: float | None = None, timeout: float = 60.0):
+        self.socket_path = socket_path
+        self.host = host
+        self.port = port
+        self.tenant = tenant
+        self.weight = weight
+        self.timeout = timeout
+        self.sock: socket.socket | None = None
+        self.welcome: dict | None = None
+        #: ids submitted but not yet verdicted (retry bookkeeping)
+        self._inflight: dict[str, dict] = {}
+        self.verdicts: dict[str, dict] = {}
+        self.replays = 0
+        self.retries = 0
+        #: per-id submit/verdict monotonic stamps — the open-loop load
+        #: generator's latency record (client-observed end to end)
+        self.sent_at: dict[str, float] = {}
+        self.done_at: dict[str, float] = {}
+        # one connection may be driven by a submitter thread AND a
+        # collector thread (the open-loop generator): frame sends are
+        # serialized so two frames can't interleave on the stream
+        self._slock = threading.Lock()
+
+    # -- connection --------------------------------------------------------
+
+    def connect(self) -> dict:
+        if self.port is not None:
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.settimeout(self.timeout)
+            s.connect((self.host, self.port))
+        else:
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.settimeout(self.timeout)
+            s.connect(str(self.socket_path))
+        self.sock = s
+        hello = {"op": "hello", "tenant": self.tenant}
+        if self.weight is not None:
+            hello["weight"] = self.weight
+        protocol.send_frame(s, hello)
+        w = protocol.recv_frame(s)
+        if not w or w.get("op") != "welcome":
+            raise ServeError(f"expected welcome, got {w!r}")
+        self.welcome = w
+        return w
+
+    def close(self) -> None:
+        if self.sock is not None:
+            try:
+                protocol.send_frame(self.sock, {"op": "bye"})
+            except OSError:
+                pass
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None
+
+    def __enter__(self) -> "ServeClient":
+        self.connect()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- submission --------------------------------------------------------
+
+    def _submit(self, frame: dict) -> str:
+        rid = frame["id"]
+        self._inflight[rid] = frame
+        self.sent_at.setdefault(rid, time.monotonic())
+        with self._slock:
+            protocol.send_frame(self.sock, frame)
+        return rid
+
+    def check_dir(self, run_dir, checker: str = "append",
+                  rid: str | None = None) -> str:
+        """Submit a store run dir by reference (the daemon encodes it
+        through the warm sidecar path — zero-copy on a v2 hit)."""
+        return self._submit({"op": "check",
+                             "id": rid or str(run_dir),
+                             "checker": checker, "dir": str(run_dir)})
+
+    def check_history(self, ops: list, rid: str,
+                      checker: str = "append") -> str:
+        """Submit inline history ops (the convenience path)."""
+        return self._submit({"op": "check", "id": rid,
+                             "checker": checker, "history": ops})
+
+    def check_encoded(self, enc, rid: str,
+                      checker: str = "append") -> str:
+        """Submit a locally-encoded history through shared memory: the
+        arrays are exported once into a segment and only the
+        descriptor rides the socket — the daemon maps the same pages
+        (zero-copy) and unlinks the name immediately."""
+        from .. import shm
+        payload = shm.export(enc, shm.gen_name(), checker)
+        if shm.is_descriptor(payload):
+            return self._submit({"op": "check", "id": rid,
+                                 "checker": checker, "shm": payload})
+        # shm unavailable: fall back to inline ops? The encoding has
+        # no ops anymore — refuse loudly rather than silently re-parse
+        raise ServeError("shared-memory export unavailable "
+                         "(JEPSEN_TPU_SHM_INGEST=0 or /dev/shm "
+                         "unusable); submit by dir or history instead")
+
+    # -- collection --------------------------------------------------------
+
+    def recv(self) -> dict | None:
+        return protocol.recv_frame(self.sock)
+
+    def collect(self, timeout: float | None = None,
+                max_retries: int = 100,
+                expect: int | None = None) -> dict[str, dict]:
+        """Drain the socket until every submitted id has a verdict.
+        `retry-after` frames re-submit after the daemon's delay hint
+        (up to `max_retries` total); a `draining` retry-after keeps
+        retrying too — after a restart the new daemon replays from the
+        journal. With `expect`, keep collecting until that many TOTAL
+        verdicts have landed — the open-loop generator's collector
+        thread starts before the first submission, when the in-flight
+        set is still empty. Returns {id: result}."""
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        while self._inflight or (expect is not None
+                                 and len(self.verdicts) < expect):
+            if deadline is not None and time.monotonic() > deadline:
+                raise ServeError(
+                    f"collect timed out with {len(self._inflight)} "
+                    f"verdict(s) outstanding")
+            frame = self.recv()
+            if frame is None:
+                raise ServeError("daemon closed the connection with "
+                                 f"{len(self._inflight)} outstanding")
+            op = frame.get("op")
+            if op == "verdict":
+                rid = frame.get("id")
+                self._inflight.pop(rid, None)
+                self.verdicts[rid] = frame["result"]
+                self.done_at[rid] = time.monotonic()
+                if frame.get("replay"):
+                    self.replays += 1
+            elif op == "retry-after":
+                rid = frame.get("id")
+                pend = self._inflight.get(rid)
+                if pend is None:
+                    continue
+                if self.retries >= max_retries:
+                    raise ServeError("retry budget exhausted")
+                self.retries += 1
+                time.sleep(min(float(frame.get("delay_s") or 0.2),
+                               2.0))
+                with self._slock:
+                    protocol.send_frame(self.sock, pend)
+            elif op == "error":
+                raise ServeError(f"daemon error: {frame.get('error')}")
+        return dict(self.verdicts)

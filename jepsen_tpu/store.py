@@ -369,12 +369,24 @@ class VerdictJournal:
                 pass
         return str(run_dir)
 
-    def record(self, run_dir, checker: str, res: dict) -> None:
+    def record(self, run_dir, checker: str, res: dict,
+               full: bool = False) -> bool:
+        """Append one verdict line; returns True when the line landed
+        (False = best-effort write failed, e.g. a read-only store —
+        the serve daemon flags acks whose journal append failed, since
+        those verdicts will be re-checked instead of replayed after a
+        restart). With `full=True` the WHOLE result dict rides the
+        entry (`"result"`) — the serve daemon's replay contract: a
+        reconnecting tenant must get back byte-identical verdicts from
+        the journal alone, not a lossy summary. Sweep journals stay
+        lean (the run dir's results.json is their full record)."""
         entry = {"dir": self.rel(run_dir), "checker": checker,
                  "valid?": res.get("valid?")}
         for k in ("quarantined", "error"):
             if k in res:
                 entry[k] = res[k]
+        if full:
+            entry["result"] = res
         try:
             if self._f is None:
                 self.path.parent.mkdir(parents=True, exist_ok=True)
@@ -394,9 +406,13 @@ class VerdictJournal:
                                         path=str(self.path))
             self._f.write(json.dumps(entry) + "\n")
             self._f.flush()
-        except OSError:
+            return True
+        except (OSError, TypeError, ValueError):
+            # OSError: read-only store; TypeError/ValueError: a full=
+            # result that isn't JSON-able — either way best-effort
             log.debug("verdict journal append failed for %s",
                       self.path, exc_info=True)
+            return False
 
     def close(self) -> None:
         if self._f is not None:
@@ -512,6 +528,61 @@ def load_costdb(path) -> list[dict]:
         if isinstance(rec, dict) and "geometry" in rec:
             out.append(rec)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Verdict-service artifacts: the `jepsen-tpu serve` daemon's on-disk
+# surface, all at the store root (the flat per-shard convention of
+# verdicts-<k>.jsonl / costdb-shard<k>.jsonl):
+#
+#   serve.sock                     the tenant socket (unix mode)
+#   serve.pid                      the daemon's pidfile (atomic marker)
+#   serve-<tenant>.verdicts.jsonl  per-tenant verdict journal — one
+#                                  FULL verdict per line (the replay
+#                                  record), VerdictJournal discipline
+#   serve-requests.jsonl           admitted-request spool (triage for
+#                                  a crashed daemon; cleared at start)
+#
+# Every path is built here (and declared in lint/contracts.py
+# STORE_ARTIFACTS) so the JT-DUR durability prover covers the daemon
+# the way it covers sweeps.
+# ---------------------------------------------------------------------------
+
+def safe_tenant(name: str) -> str:
+    """A tenant id as a filesystem-safe slug: the journal path embeds
+    it, and a tenant must not be able to name itself `../../etc` (or
+    collide with another tenant after mangling — hence the hash
+    suffix whenever anything was replaced). Dots are mangled too, so
+    no `..` survives in any form."""
+    cleaned = "".join(c if c.isalnum() or c in "-_" else "_"
+                      for c in str(name))[:64] or "tenant"
+    if cleaned != str(name):
+        h = _buf_xxh64(str(name).encode()) & 0xffffffff
+        cleaned = f"{cleaned}-{h:08x}"
+    return cleaned
+
+
+def serve_socket_path(store_base) -> Path:
+    """The daemon's unix socket (JEPSEN_TPU_SERVE_SOCKET overrides)."""
+    return Path(store_base) / "serve.sock"
+
+
+def serve_pid_path(store_base) -> Path:
+    return Path(store_base) / "serve.pid"
+
+
+def tenant_journal_path(store_base, tenant: str) -> Path:
+    """One tenant's verdict journal — the daemon's crash evidence AND
+    the tenant's resume evidence (reconnect replays from it without
+    re-checking)."""
+    return Path(store_base) / f"serve-{safe_tenant(tenant)}.verdicts.jsonl"
+
+
+def request_spool_path(store_base) -> Path:
+    """The admitted-request spool: one line per admission, so a
+    post-mortem on a killed daemon can tell admitted-but-unverdicted
+    work (resent by tenants) from never-admitted work."""
+    return Path(store_base) / "serve-requests.jsonl"
 
 
 # ---------------------------------------------------------------------------

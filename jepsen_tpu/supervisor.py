@@ -30,7 +30,13 @@ policy the recovery layers share:
 
 ``JEPSEN_TPU_STRICT=1`` restores the old fail-fast behavior on every
 path (injection still fires — a strict run under the nemesis dies
-loudly, which is the point of strict).
+loudly, which is the point of strict). The one owner exempt from
+strict's *process death* is the serve daemon: its fold dispatcher
+(`parallel.folding.FoldDispatcher`) catches whatever the strict
+ladder re-raises and converts it to per-history `unknown` verdicts
+for THAT fold only — a long-lived service degrades a tenant's bucket
+share, never its own lifetime (the daemon's analogue of "never a
+dead sweep").
 
 Every recovery is tracer-attributed: `quarantined`, `oom_retries`,
 `bucket_splits`, `watchdog_timeouts` counters plus "quarantine" spans,
@@ -73,7 +79,12 @@ class Quarantined:
     __slots__ = ("stage", "error")
 
     def __init__(self, stage: str, error: str):
-        self.stage = stage      # "encode" | "oom" | "watchdog" | "pack"
+        # "encode" | "oom" | "watchdog" | "pack" | "stored" |
+        # "dispatch" (the serve daemon's whole-fold isolation:
+        # parallel.folding.FoldDispatcher quarantines a failed fold's
+        # own histories — a poisoned tenant costs its bucket share,
+        # never the daemon)
+        self.stage = stage
         self.error = error
 
     def __repr__(self) -> str:

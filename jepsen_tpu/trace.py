@@ -66,7 +66,9 @@ DECLARED_METRICS: dict[str, frozenset] = {
         "compile_cache_hits", "compile_cache_misses", "cost_records",
         "donated_bytes", "h2d_bytes",
         "native_fallback", "oom_retries", "pad_waste_cells",
-        "quarantined", "runs_verdicted", "shm_bytes",
+        "quarantined", "runs_verdicted",
+        "serve_backpressure", "serve_folds", "serve_replays",
+        "serve_requests", "serve_verdicts", "shm_bytes",
         "shm_stale_reclaimed", "sidecar_upgrades", "split.native",
         "split.python", "warm_copy_bytes", "watchdog_timeouts",
         "worker_spans",
@@ -74,15 +76,18 @@ DECLARED_METRICS: dict[str, frozenset] = {
     "gauges": frozenset({"donate_slots_inflight", "hbm_device_bytes",
                          "hbm_modeled_bytes", "inflight_depth",
                          "reorder_depth", "resident_executables",
-                         "runs_total"}),
-    "histograms": frozenset({"bucket_cells"}),
+                         "runs_total", "serve_pending",
+                         "serve_tenants"}),
+    "histograms": frozenset({"bucket_cells", "serve_fold_histories",
+                             "serve_latency_ms"}),
 }
 
 #: Sanctioned dynamic-name families: an f-string metric name must
 #: start with one of these (`phase.<key>`, `device.<kernel>`,
 #: `native_fallback.<component>`, `worker.<stage>` — the per-task
 #: stage-seconds digests ingest relays from pool workers).
-METRIC_PREFIXES = ("phase.", "device.", "native_fallback.", "worker.")
+METRIC_PREFIXES = ("phase.", "device.", "native_fallback.", "worker.",
+                   "serve.")
 
 #: Synthetic tid for the device track (real thread idents are pthread
 #: addresses, nowhere near this; named tracks count down from here).

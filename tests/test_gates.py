@@ -57,6 +57,11 @@ ALL_GATES = [
     "JEPSEN_TPU_MESH_SHARD",
     "JEPSEN_TPU_MESH_SHARDS",
     "JEPSEN_TPU_MESH_WAIT_S",
+    "JEPSEN_TPU_SERVE_SOCKET",
+    "JEPSEN_TPU_SERVE_PORT",
+    "JEPSEN_TPU_SERVE_MAX_QUEUE",
+    "JEPSEN_TPU_SERVE_WEIGHTS",
+    "JEPSEN_TPU_SERVE_DRAIN_S",
     "JEPSEN_TPU_STRICT",
     "JEPSEN_TPU_DISPATCH_TIMEOUT_S",
     "JEPSEN_TPU_FAULT_INJECT",
@@ -243,6 +248,26 @@ def test_no_native_wins_over_lib_dir(tmp_path, monkeypatch):
         lambda *a, **k: pytest.fail("CDLL called despite NO_NATIVE"))
     assert native_lib._cached_lib(
         "hist_encode.cc", "libjepsen_histenc.so", lambda L: True) is None
+
+
+def test_serve_gates(monkeypatch):
+    # the verdict daemon's knobs: socket path default (None -> the
+    # store-derived serve.sock), queue-depth cap, weight-spec parse
+    from jepsen_tpu.serve import scheduler
+    monkeypatch.delenv("JEPSEN_TPU_SERVE_SOCKET", raising=False)
+    assert gates.get("JEPSEN_TPU_SERVE_SOCKET") is None
+    monkeypatch.delenv("JEPSEN_TPU_SERVE_MAX_QUEUE", raising=False)
+    assert gates.get("JEPSEN_TPU_SERVE_MAX_QUEUE") == 256
+    monkeypatch.setenv("JEPSEN_TPU_SERVE_MAX_QUEUE", "not-a-depth")
+    assert gates.get("JEPSEN_TPU_SERVE_MAX_QUEUE") == 256
+    monkeypatch.setenv("JEPSEN_TPU_SERVE_WEIGHTS",
+                       "fleetA=3, fleetB=1, junk, neg=-2")
+    # malformed/negative entries fall back to weight 1, never crash
+    assert scheduler.parse_weights() == {"fleetA": 3.0, "fleetB": 1.0}
+    monkeypatch.delenv("JEPSEN_TPU_SERVE_WEIGHTS", raising=False)
+    assert scheduler.parse_weights() == {}
+    monkeypatch.delenv("JEPSEN_TPU_SERVE_DRAIN_S", raising=False)
+    assert gates.get("JEPSEN_TPU_SERVE_DRAIN_S") == 30.0
 
 
 def test_encode_cache_write_gate(monkeypatch):

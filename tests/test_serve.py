@@ -1,0 +1,532 @@
+"""The multi-tenant verdict daemon (`jepsen-tpu serve`).
+
+Tier-1 coverage: the frame protocol, the weighted batch-folding
+scheduler seam (parallel.folding), admission/backpressure semantics,
+an in-process two-tenant end-to-end run over the real unix socket
+(dir/inline/shm submission parity + journal replay), daemon-level
+backpressure with the dispatch thread gated, and the two subprocess
+lifecycle contracts: SIGKILL mid-stream (journaled verdicts survive,
+the torn tail seals, reconnecting tenants replay without re-checking)
+and SIGTERM (clean drain, zero lost or duplicated journal entries).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from jepsen_tpu import trace  # noqa: E402
+from jepsen_tpu.parallel import folding  # noqa: E402
+from jepsen_tpu.serve import protocol, scheduler  # noqa: E402
+from jepsen_tpu.serve.client import ServeClient  # noqa: E402
+from jepsen_tpu.serve.daemon import VerdictDaemon  # noqa: E402
+from jepsen_tpu.checker.elle.synth import write_synth_store  # noqa: E402
+from jepsen_tpu.store import (Store, VerdictJournal, load_history_dir,  # noqa: E402
+                              safe_tenant, tenant_journal_path)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_store(root: Path, b: int = 6, t: int = 96, k: int = 8,
+               bad_every: int = 3) -> tuple[Path, list[Path]]:
+    store = root / "store"
+    (store / "synth").mkdir(parents=True)
+    write_synth_store(store / "synth", b, t, k, bad_every)
+    return store, sorted(Store(store).iter_run_dirs())
+
+
+@pytest.fixture
+def keep_tracer():
+    prev = trace.get_current()
+    yield
+    trace.set_current(prev)
+
+
+# ---------------------------------------------------------------------------
+# protocol
+# ---------------------------------------------------------------------------
+
+def test_frame_roundtrip():
+    a, b = socket.socketpair()
+    try:
+        protocol.send_frame(a, {"op": "hello", "tenant": "t",
+                                "n": [1, 2, 3]})
+        got = protocol.recv_frame(b)
+        assert got == {"op": "hello", "tenant": "t", "n": [1, 2, 3]}
+        a.close()
+        assert protocol.recv_frame(b) is None   # clean EOF
+    finally:
+        b.close()
+
+
+def test_frame_bad_magic_raises():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(b"XXXX\x00\x00\x00\x02{}")
+        with pytest.raises(protocol.ProtocolError):
+            protocol.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_frame_torn_mid_body_raises():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(protocol.MAGIC + (100).to_bytes(4, "big") + b"{half")
+        a.close()
+        with pytest.raises(protocol.ProtocolError):
+            protocol.recv_frame(b)
+    finally:
+        b.close()
+
+
+def test_frame_oversized_refused():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(protocol.MAGIC
+                  + (protocol.MAX_FRAME + 1).to_bytes(4, "big"))
+        with pytest.raises(protocol.ProtocolError):
+            protocol.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# the folding seam
+# ---------------------------------------------------------------------------
+
+class _Item:
+    def __init__(self, cost: int):
+        self.cost = cost
+
+
+def test_fold_cost_pads_to_tile():
+    assert folding.fold_cost(1) == 128 * 128
+    assert folding.fold_cost(128) == 128 * 128
+    assert folding.fold_cost(129) == 256 * 256
+
+
+def test_plan_fold_weighted_shares():
+    heavy = folding.Lane("heavy", 3.0)
+    light = folding.Lane("light", 1.0)
+    c = folding.fold_cost(1)
+    for _ in range(200):
+        heavy.queue.append(_Item(c))
+        light.queue.append(_Item(c))
+    picked = folding.plan_fold([heavy, light],
+                               budget_cells=c * 1000,
+                               max_histories=80)
+    counts = {"heavy": 0, "light": 0}
+    for ln, _item in picked:
+        counts[ln.name] += 1
+    assert len(picked) == 80
+    # DRR at weights 3:1 with equal costs: shares converge to 3:1
+    ratio = counts["heavy"] / max(counts["light"], 1)
+    assert 2.0 <= ratio <= 4.0, counts
+    assert counts["light"] > 0   # the light tenant is never starved
+
+
+def test_plan_fold_budget_bounds_cells():
+    ln = folding.Lane("t", 1.0)
+    c = folding.fold_cost(1)
+    for _ in range(50):
+        ln.queue.append(_Item(c))
+    picked = folding.plan_fold([ln], budget_cells=c * 10)
+    assert len(picked) == 10
+    assert len(ln.queue) == 40
+
+
+def test_plan_fold_oversized_head_goes_alone():
+    ln = folding.Lane("t", 1.0)
+    big = _Item(folding.fold_cost(100_000))
+    ln.queue.append(big)
+    ln.queue.append(_Item(folding.fold_cost(1)))
+    picked = folding.plan_fold([ln], budget_cells=folding.fold_cost(1))
+    assert [it for _ln, it in picked] == [big]
+
+
+def test_plan_fold_deficit_resets_on_drain():
+    ln = folding.Lane("t", 5.0)
+    ln.queue.append(_Item(folding.fold_cost(1)))
+    folding.plan_fold([ln], budget_cells=1 << 30)
+    assert not ln.queue
+    assert ln.deficit == 0.0
+
+
+# ---------------------------------------------------------------------------
+# admission
+# ---------------------------------------------------------------------------
+
+def _req(tenant: str, rid: str, checker: str = "append") -> scheduler.Request:
+    return scheduler.Request(tenant, rid, checker, enc=None,
+                             cost=folding.fold_cost(1))
+
+
+def test_admission_backpressure_cap():
+    adm = scheduler.Admission(weights={}, max_queue=2)
+    assert adm.admit(_req("t", "a"))
+    assert adm.admit(_req("t", "b"))
+    assert not adm.admit(_req("t", "c"))     # explicit refusal
+    assert adm.retry_after_s() > 0
+    # another tenant's lanes are not affected by t's cap
+    assert adm.admit(_req("u", "a"))
+    assert adm.pending() == 3
+
+
+def test_admission_fold_is_single_checker():
+    adm = scheduler.Admission(weights={}, max_queue=16)
+    adm.admit(_req("t", "a", "append"))
+    time.sleep(0.01)    # the wr head must be strictly younger
+    adm.admit(_req("t", "w1", "wr"))
+    adm.admit(_req("u", "b", "append"))
+    checker, picked = adm.next_fold(1 << 30)
+    assert checker == "append"
+    assert sorted(r.rid for r in picked) == ["a", "b"]
+    checker2, picked2 = adm.next_fold(1 << 30)
+    assert checker2 == "wr"
+    assert [r.rid for r in picked2] == ["w1"]
+    assert adm.next_fold(1 << 30) == (None, [])
+    assert adm.pending() == 0
+
+
+def test_operator_weights_win_over_client():
+    adm = scheduler.Admission(weights={"a": 4.0}, max_queue=4)
+    assert adm.register("a", requested_weight=0.1) == 4.0
+    assert adm.register("b", requested_weight=2.5) == 2.5
+    assert adm.register("c") == 1.0
+
+
+def test_safe_tenant_slug():
+    assert safe_tenant("fleetA") == "fleetA"
+    evil = safe_tenant("../../etc/passwd")
+    assert "/" not in evil and ".." not in evil
+    # distinct hostile names cannot collide after mangling
+    assert safe_tenant("a/b") != safe_tenant("a_b-ish") \
+        and safe_tenant("a/b") != safe_tenant("a.b")
+    p = tenant_journal_path("/store", "../../x")
+    assert str(p).startswith("/store/serve-")
+
+
+# ---------------------------------------------------------------------------
+# in-process end to end
+# ---------------------------------------------------------------------------
+
+def _canon(v) -> str:
+    return json.dumps(v, sort_keys=True)
+
+
+def test_daemon_end_to_end_two_tenants(tmp_path, keep_tracer):
+    store, dirs = make_store(tmp_path)
+    d = VerdictDaemon(Store(store)).start()
+    try:
+        info = d.ready_info()["serve"]
+        assert info["socket"] and Path(info["socket"]).exists()
+        results: dict[str, dict] = {}
+
+        def run_tenant(name: str, share) -> None:
+            with ServeClient(socket_path=info["socket"],
+                             tenant=name) as c:
+                for x in share:
+                    c.check_dir(x)
+                results[name] = c.collect(timeout=300)
+
+        th = [threading.Thread(target=run_tenant,
+                               args=("t1", dirs[:3])),
+              threading.Thread(target=run_tenant,
+                               args=("t2", dirs[3:]))]
+        for t in th:
+            t.start()
+        for t in th:
+            t.join(timeout=300)
+        assert len(results["t1"]) == 3 and len(results["t2"]) == 3
+        merged = {**results["t1"], **results["t2"]}
+        invalid = [k for k, r in merged.items()
+                   if r.get("valid?") is False]
+        assert len(invalid) == 2          # bad_every=3 over 6 runs
+        assert all(r.get("checker") == "append"
+                   for r in merged.values())
+        # per-tenant journals hold exactly each tenant's ids, full
+        # results included (the replay record)
+        for name, share in (("t1", dirs[:3]), ("t2", dirs[3:])):
+            entries = VerdictJournal.load(
+                tenant_journal_path(store, name))
+            assert set(entries) == {(str(x), "append") for x in share}
+            for k, e in entries.items():
+                assert _canon(e["result"]) == _canon(merged[k[0]])
+        # the daemon's tracer carries the serve series
+        tr = trace.get_current()
+        md = tr.metrics_dict()
+        assert md["counters"]["serve_verdicts"] == 6
+        assert md["counters"]["serve_folds"] >= 1
+        assert md["counters"]["serve.t1.verdicts"] == 3
+        assert "serve_latency_ms" in md["histograms"]
+    finally:
+        assert d.stop() == 0
+    assert not Path(info["socket"]).exists()   # socket reclaimed
+
+
+def test_daemon_inline_and_shm_parity(tmp_path, keep_tracer):
+    from jepsen_tpu import shm
+    store, dirs = make_store(tmp_path, b=3, bad_every=2)
+    d = VerdictDaemon(Store(store)).start()
+    try:
+        info = d.ready_info()["serve"]
+        with ServeClient(socket_path=info["socket"], tenant="t") as c:
+            for x in dirs:
+                c.check_dir(x)
+            for i, x in enumerate(dirs):
+                c.check_history(load_history_dir(x), rid=f"inline:{i}")
+            n_shm = 0
+            if shm.enabled() and shm.available():
+                from jepsen_tpu import ingest
+                for i, x in enumerate(dirs):
+                    enc = ingest.encode_run_dir(x, "append")
+                    c.check_encoded(enc, rid=f"shm:{i}")
+                    n_shm += 1
+            got = c.collect(timeout=300)
+        for i, x in enumerate(dirs):
+            assert _canon(got[f"inline:{i}"]) == _canon(got[str(x)])
+            if n_shm:
+                assert _canon(got[f"shm:{i}"]) == _canon(got[str(x)])
+    finally:
+        assert d.stop() == 0
+
+
+def test_daemon_wr_checker(tmp_path, keep_tracer):
+    store, dirs = make_store(tmp_path, b=2, bad_every=0)
+    d = VerdictDaemon(Store(store)).start()
+    try:
+        info = d.ready_info()["serve"]
+        with ServeClient(socket_path=info["socket"], tenant="t") as c:
+            for x in dirs:
+                c.check_dir(x, checker="wr")
+            got = c.collect(timeout=300)
+        assert all(r.get("checker") == "wr" for r in got.values())
+        assert all(r.get("valid?") is not None for r in got.values())
+    finally:
+        assert d.stop() == 0
+
+
+def test_daemon_replay_after_restart_in_process(tmp_path, keep_tracer):
+    store, dirs = make_store(tmp_path, b=4, bad_every=2)
+    d = VerdictDaemon(Store(store)).start()
+    info = d.ready_info()["serve"]
+    with ServeClient(socket_path=info["socket"], tenant="t") as c:
+        for x in dirs:
+            c.check_dir(x)
+        first = c.collect(timeout=300)
+    assert d.stop() == 0
+    # a fresh daemon on the same store replays from the journal
+    d2 = VerdictDaemon(Store(store)).start()
+    try:
+        info2 = d2.ready_info()["serve"]
+        with ServeClient(socket_path=info2["socket"], tenant="t") as c2:
+            w = c2.welcome
+            assert w["journaled"] == 4
+            for x in dirs:
+                c2.check_dir(x)
+            second = c2.collect(timeout=300)
+            assert c2.replays == 4            # zero re-checks
+        assert {k: _canon(v) for k, v in first.items()} \
+            == {k: _canon(v) for k, v in second.items()}
+        md = trace.get_current().metrics_dict()
+        assert md["counters"]["serve_replays"] == 4
+        assert md["counters"].get("serve_folds", 0) == 0
+    finally:
+        assert d2.stop() == 0
+
+
+def test_fold_dispatcher_long_history_route(tmp_path, monkeypatch,
+                                            keep_tracer):
+    # histories past DENSE_TXN_LIMIT must ride the SCC-condensation
+    # path (check_long_history), exactly like the analyze-store huge
+    # path — never quarantine on a doomed dense closure. Shrink the
+    # limit so a 96-txn synth history counts as "huge" and pin verdict
+    # parity against the dense route.
+    from jepsen_tpu import ingest, parallel
+    store, dirs = make_store(tmp_path, b=2, bad_every=2)
+    want = folding.FoldDispatcher().verdicts(
+        [ingest.encode_run_dir(d, "append") for d in dirs], "append")
+    monkeypatch.setattr(parallel, "DENSE_TXN_LIMIT", 16)
+    got = folding.FoldDispatcher().verdicts(
+        [ingest.encode_run_dir(d, "append") for d in dirs], "append")
+    assert [_canon(w) for w in want] == [_canon(g) for g in got]
+    assert any(r.get("valid?") is False for r in got)
+
+
+class _GatedDispatcher:
+    """Wraps the real FoldDispatcher: the first fold blocks until
+    released, so admission backpressure is deterministic to provoke."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.entered = threading.Event()
+        self.release = threading.Event()
+
+    def verdicts(self, encs, checker):
+        self.entered.set()
+        assert self.release.wait(timeout=120)
+        return self.inner.verdicts(encs, checker)
+
+
+def test_daemon_backpressure_retry_after(tmp_path, keep_tracer):
+    store, dirs = make_store(tmp_path, b=2, bad_every=0)
+    hist = load_history_dir(dirs[0])
+    d = VerdictDaemon(Store(store), max_queue=2).start()
+    gate = _GatedDispatcher(d._dispatcher)
+    d._dispatcher = gate
+    try:
+        info = d.ready_info()["serve"]
+        with ServeClient(socket_path=info["socket"], tenant="t") as c:
+            c.check_history(hist, rid="h0")
+            assert gate.entered.wait(timeout=60)   # fold in flight,
+            for i in range(1, 5):                  # scheduler blocked
+                c.check_history(hist, rid=f"h{i}")
+            # queue cap is 2: some of these got retry-after frames —
+            # collect() honors them and re-submits
+            t = threading.Thread(
+                target=lambda: time.sleep(1.0) or gate.release.set())
+            t.start()
+            got = c.collect(timeout=300)
+            t.join()
+        assert len(got) == 5
+        assert c.retries >= 1                      # backpressure seen
+        md = trace.get_current().metrics_dict()
+        assert md["counters"]["serve_backpressure"] >= 1
+    finally:
+        gate.release.set()
+        assert d.stop() == 0
+
+
+# ---------------------------------------------------------------------------
+# subprocess lifecycle: SIGKILL crash/restart, SIGTERM drain
+# ---------------------------------------------------------------------------
+
+def _daemon_env() -> dict:
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "JEPSEN_TPU_PLATFORM": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+    for k in ("JEPSEN_TPU_MESH", "JEPSEN_TPU_MESH_SHARD",
+              "JEPSEN_TPU_MESH_SHARDS", "JEPSEN_TPU_METRICS_PORT"):
+        env.pop(k, None)
+    return env
+
+
+def _spawn_daemon(store: Path, timeout: float = 180.0):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "jepsen_tpu.cli", "serve",
+         "--store", str(store)],
+        cwd=REPO, env=_daemon_env(), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            raise RuntimeError("daemon died before ready: "
+                               + (proc.stderr.read() or "")[-400:])
+        try:
+            got = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(got, dict) and "serve" in got:
+            return proc, got["serve"]
+    proc.kill()
+    raise RuntimeError("daemon ready-line timeout")
+
+
+def _raw_line_count(p: Path) -> int:
+    if not p.exists():
+        return 0   # a drain that refused everything never opens it
+    return sum(1 for ln in p.read_text().splitlines() if ln.strip())
+
+
+def test_sigkill_crash_journal_survives_and_resumes(tmp_path):
+    store, dirs = make_store(tmp_path, b=6, bad_every=3)
+    proc, ready = _spawn_daemon(store)
+    try:
+        # closed-loop: verdict-by-verdict, so the journal deterministically
+        # holds exactly 3 entries when the SIGKILL lands
+        with ServeClient(socket_path=ready["socket"], tenant="t") as c:
+            for x in dirs[:3]:
+                c.check_dir(x)
+                c.collect(timeout=300)
+    finally:
+        proc.kill()
+        proc.wait(timeout=60)
+    jp = tenant_journal_path(store, "t")
+    assert len(VerdictJournal.load(jp)) == 3
+    # simulate the crash tearing the tail mid-append: a record without
+    # its newline — the next append must seal it, the loader skip it
+    with open(jp, "a") as f:
+        f.write('{"dir": "torn-mid-wri')
+    proc2, ready2 = _spawn_daemon(store)   # also reclaims stale serve.sock
+    try:
+        with ServeClient(socket_path=ready2["socket"], tenant="t") as c2:
+            assert c2.welcome["journaled"] == 3   # torn tail not counted
+            for x in dirs:
+                c2.check_dir(x)
+            got = c2.collect(timeout=600)
+            # the 3 journaled ids replayed with zero re-checking; the
+            # other 3 (and only those) were checked fresh
+            assert len(got) == 6
+            assert c2.replays == 3
+        entries = VerdictJournal.load(jp)
+        assert set(entries) == {(str(x), "append") for x in dirs}
+        # file shape: 3 intact + 1 sealed torn line + 3 new appends
+        assert _raw_line_count(jp) == 7
+        proc2.send_signal(signal.SIGTERM)
+        assert proc2.wait(timeout=120) == 0
+    finally:
+        if proc2.poll() is None:
+            proc2.kill()
+            proc2.wait(timeout=60)
+
+
+def test_sigterm_drains_without_loss_or_duplication(tmp_path):
+    store, dirs = make_store(tmp_path, b=6, bad_every=3)
+    proc, ready = _spawn_daemon(store)
+    received: dict[str, dict] = {}
+    try:
+        with ServeClient(socket_path=ready["socket"], tenant="t") as c:
+            for x in dirs:
+                c.check_dir(x)
+            # a beat for the reader to admit, then SIGTERM with work
+            # queued: admitted requests must drain to journaled +
+            # acked verdicts; anything refused during the drain
+            # (admission closes ATOMICALLY — a request mid-encode is
+            # refused, never stranded in a queue nobody drains) is
+            # resent by the tenant later — never half-acked
+            time.sleep(0.5)
+            proc.send_signal(signal.SIGTERM)
+            try:
+                received = c.collect(timeout=120)
+            except Exception:
+                received = dict(c.verdicts)
+        rc = proc.wait(timeout=120)
+        assert rc == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=60)
+    jp = tenant_journal_path(store, "t")
+    entries = VerdictJournal.load(jp)
+    submitted = {(str(x), "append") for x in dirs}
+    # zero lost: everything acked is journaled; zero duplicated: one
+    # line per journaled verdict; nothing outside the submitted set
+    assert set(entries) <= submitted
+    assert {(k, "append") for k in received} <= set(entries)
+    assert _raw_line_count(jp) == len(entries)
