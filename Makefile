@@ -9,7 +9,8 @@ TSAN_RT := $(shell gcc -print-file-name=libtsan.so)
 
 .PHONY: lint lint-json lint-changed env-table rule-table dur-table \
 	crash-smoke test native native-sanitize bench bench-report \
-	bench-warm obs-smoke serve-smoke trace-report cost-report
+	bench-warm obs-smoke serve-smoke trace-report cost-report \
+	search-report
 
 # Self-hosted static analysis: gate registry, JAX hazards, concurrency
 # discipline, shm lifecycle, tracer discipline, plus the cross-boundary
@@ -151,4 +152,13 @@ trace-report:
 # device roofline section to the report.
 cost-report:
 	JEPSEN_TPU_COSTDB=1 \
+	  $(PY) -m jepsen_tpu.cli analyze-store --store $(STORE) --report
+
+# trace-report with kernel search telemetry on (and the costdb, so
+# the search section's edge-density-vs-device-time join has measured
+# windows): journals one stats line per history to
+# <store>/analytics.jsonl and adds the "search" section (anomaly
+# rate, closure-round + margin distributions) to the report.
+search-report:
+	JEPSEN_TPU_KERNEL_STATS=1 JEPSEN_TPU_COSTDB=1 \
 	  $(PY) -m jepsen_tpu.cli analyze-store --store $(STORE) --report

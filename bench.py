@@ -1275,6 +1275,73 @@ def bench_mesh(n_dev: int, devices) -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_search(n_dev: int, devices) -> dict:
+    """Kernel search telemetry (JEPSEN_TPU_KERNEL_STATS) over a seeded
+    synthetic batch: every 4th history carries an injected G1c cycle,
+    so the anomaly rate is a DETERMINISTIC 0.25 — bench-report gates
+    it (a drift means the kernels' structural evidence changed, not
+    the workload). Reports the margin histogram and mean
+    closure-rounds the near-miss search will seed from, plus the
+    stats dispatch's wall overhead vs the stats-free kernel and a
+    verdict-parity check (stats must never change a verdict)."""
+    from jepsen_tpu import gates, parallel
+    from jepsen_tpu.checker.elle import synth
+    from jepsen_tpu.obs import search as search_obs
+
+    accel = _accel(devices)
+    B = int(os.environ.get("BENCH_SEARCH_B", 48 if accel else 12))
+    T = int(os.environ.get("BENCH_SEARCH_T", 1024 if accel else 256))
+    encs = [synth.synth_encoded_history(T, K=32,
+                                        inject_cycle=(i % 4 == 3))
+            for i in range(B)]
+    mesh = parallel.make_mesh(devices) if n_dev > 1 else None
+    prev = os.environ.get("JEPSEN_TPU_KERNEL_STATS")
+    try:
+        gates.unset("JEPSEN_TPU_KERNEL_STATS")
+        parallel.check_bucketed(encs, mesh)          # compile warmup
+        t0 = time.perf_counter()
+        base = parallel.check_bucketed(encs, mesh)
+        t_off = time.perf_counter() - t0
+        gates.export("JEPSEN_TPU_KERNEL_STATS", True)
+        souts: list = []
+        parallel.check_bucketed(encs, mesh, stats_out=souts)  # warmup
+        souts = []
+        t0 = time.perf_counter()
+        res = parallel.check_bucketed(encs, mesh, stats_out=souts)
+        t_on = time.perf_counter() - t0
+    finally:
+        if prev is None:
+            gates.unset("JEPSEN_TPU_KERNEL_STATS")
+        else:
+            os.environ["JEPSEN_TPU_KERNEL_STATS"] = prev
+    rows = [s for s in souts if s]
+    cyc = [s for s in rows if s.get("cycle_txns")]
+    rounds = [s["closure_rounds"] for s in rows
+              if s.get("closure_rounds", -1) >= 0]
+    margin_hist: dict = {}
+    for s in rows:
+        m = s.get("margin", -1)
+        if m >= 0:
+            margin_hist[str(m)] = margin_hist.get(str(m), 0) + 1
+    return {
+        "histories": B, "txns": T,
+        "anomaly_rate": round(len(cyc) / max(1, len(rows)), 4),
+        "rounds_mean": (round(sum(rounds) / len(rounds), 3)
+                        if rounds else None),
+        "margin_histogram": dict(sorted(margin_hist.items(),
+                                        key=lambda kv: int(kv[0]))),
+        "near_miss": sum(1 for s in cyc
+                         if s.get("margin", -1)
+                         >= search_obs.NEAR_MISS_MARGIN),
+        "stats_overhead_x": round(t_on / t_off, 3) if t_off else None,
+        "verdict_parity": res == base,
+        # the gateable twin (bench-report rejects bools): floor 1.0
+        # fails the round the moment stats ever change a verdict
+        "parity_ok": 1.0 if res == base else 0.0,
+        "stats_secs": round(t_on, 4), "base_secs": round(t_off, 4),
+    }
+
+
 def bench_serve(n_dev: int, devices) -> dict:
     """The verdict service under a multi-tenant OPEN-LOOP load
     generator: an in-process daemon over a synthetic store,
@@ -1467,6 +1534,7 @@ def run_benches() -> int:
             ("dp_scaling", bench_dp_scaling, (n_dev, devices)),
             ("mesh", bench_mesh, (n_dev, devices)),
             ("serve", bench_serve, (n_dev, devices)),
+            ("search", bench_search, (n_dev, devices)),
             ("generator", bench_generator, (reps,))):
         try:
             if name in force_fail:
@@ -1540,7 +1608,7 @@ def main() -> int:
                       + " | ".join(tail))[:400]
 
     blocks = ("knossos", "long_history", "end_to_end", "register_sweep",
-              "north_star", "dp_scaling", "mesh", "serve",
+              "north_star", "dp_scaling", "mesh", "serve", "search",
               "generator")
     cpu_env = {"JEPSEN_TPU_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu",
                "BENCH_ATTEMPT": "cpu-retry"}

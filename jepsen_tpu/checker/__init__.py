@@ -552,10 +552,12 @@ class Linearizable(Checker):
             frontier = gates.get("JEPSEN_TPU_FRONTIER")
         self.frontier = frontier
 
-    def _cpu(self, history: list) -> dict:
+    def _cpu(self, history: list, search_stats: dict | None = None
+             ) -> dict:
         from . import knossos
         return knossos.analysis(self.model, history,
-                                algorithm=self.algorithm)
+                                algorithm=self.algorithm,
+                                search_stats=search_stats)
 
     def check(self, test, history, opts):
         res = self.check_batch(test, [history], opts)[0]
@@ -578,10 +580,18 @@ class Linearizable(Checker):
             logging.getLogger(__name__).warning(
                 "linear.svg render failed", exc_info=True)
 
-    def check_batch(self, test, histories: list[list], opts) -> list[dict]:
+    def check_batch(self, test, histories: list[list], opts,
+                    stats_out: list | None = None) -> list[dict]:
         """Check many histories at once — the TPU batch path used by
         `independent.checker` to shard per-key subhistories across the
         device mesh instead of pmapping JVM threads.
+
+        `stats_out` (a list, JEPSEN_TPU_KERNEL_STATS) is extended with
+        one per-history search-telemetry dict — WGL configs/backtracks
+        on the CPU engine, frontier/grid occupancy on the device
+        kernels; None per history on the race backend (whichever
+        engine wins owns the wall clock, so neither's counters are
+        authoritative).
 
         Device routing is tiered: (1) the dense-bitset config-grid
         kernel (`.knossos.dense`) — exact verdicts, no frontier
@@ -598,9 +608,18 @@ class Linearizable(Checker):
         # Model eligibility first: resolving an auto backend may probe
         # the hardware (bounded, but up to JEPSEN_TPU_PROBE_TIMEOUT on a
         # dead transport) — pointless when only the CPU path can apply.
+        def cpu_all():
+            out = []
+            for hs in histories:
+                sd: dict | None = {} if stats_out is not None else None
+                out.append(self._cpu(hs, search_stats=sd))
+                if stats_out is not None:
+                    stats_out.append(sd or None)
+            return out
+
         if not (type(self.model) is model.CASRegister
                 and self.model.value is None):
-            return [self._cpu(hs) for hs in histories]
+            return cpu_all()
         from ..devices import resolve_backend
         backend = self.backend
         if backend == "auto":
@@ -611,11 +630,13 @@ class Linearizable(Checker):
             backend = gates.get("JEPSEN_TPU_BACKEND") or "auto"
         if backend == "race":
             if resolve_backend("auto") != "tpu":
-                return [self._cpu(hs) for hs in histories]
+                return cpu_all()
+            if stats_out is not None:
+                stats_out.extend(None for _ in histories)
             return self._race(histories)
         if resolve_backend(self.backend) != "tpu":
-            return [self._cpu(hs) for hs in histories]
-        return self._device_batch(histories)
+            return cpu_all()
+        return self._device_batch(histories, stats_out=stats_out)
 
     #: losing race dispatches still draining in background threads;
     #: joined at interpreter exit so teardown can't kill a thread
@@ -708,11 +729,17 @@ class Linearizable(Checker):
                 return list(cpu_res)
             # device errored first: wait for the CPU side to finish
 
-    def _device_batch(self, histories: list[list]) -> list[dict]:
+    def _device_batch(self, histories: list[list],
+                      stats_out: list | None = None) -> list[dict]:
         """The tiered device pipeline (see check_batch's docstring);
-        callers have already checked model eligibility."""
+        callers have already checked model eligibility. With
+        `stats_out`, each tier reports its own search telemetry
+        (grid/frontier occupancy, rounds; the CPU oracle's WGL
+        counters for fallbacks)."""
         from .knossos import dense, kernels
         from .knossos import encode as kenc
+        with_stats = stats_out is not None
+        stats: list = [None] * len(histories)
         dense_encs, dense_idx = [], []
         front_encs, front_idx = [], []
         cpu_idx = []
@@ -745,19 +772,34 @@ class Linearizable(Checker):
                     cpu_idx.append(i)
         results: list[dict | None] = [None] * len(histories)
         if dense_encs:
-            for i, r in zip(dense_idx,
-                            dense.check_encoded_dense_batch(dense_encs)):
+            ds: list | None = [] if with_stats else None
+            for j, (i, r) in enumerate(zip(
+                    dense_idx,
+                    dense.check_encoded_dense_batch(dense_encs,
+                                                    stats_out=ds))):
                 results[i] = r
+                if ds is not None:
+                    stats[i] = ds[j]
         if front_encs:
-            for i, r in zip(front_idx,
-                            kernels.check_encoded_batch(
-                                front_encs, frontier=self.frontier)):
+            fs: list | None = [] if with_stats else None
+            for j, (i, r) in enumerate(zip(
+                    front_idx,
+                    kernels.check_encoded_batch(
+                        front_encs, frontier=self.frontier,
+                        stats_out=fs))):
                 if r.get("valid?") == "unknown":
                     cpu_idx.append(i)  # overflow: exact answer from CPU
                 else:
                     results[i] = r
+                    if fs is not None:
+                        stats[i] = fs[j]
         for i in cpu_idx:
-            results[i] = self._cpu(histories[i])
+            sd: dict | None = {} if with_stats else None
+            results[i] = self._cpu(histories[i], search_stats=sd)
+            if with_stats:
+                stats[i] = sd or None
+        if with_stats:
+            stats_out.extend(stats)
         return results  # type: ignore[return-value]
 
 

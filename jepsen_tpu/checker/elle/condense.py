@@ -208,10 +208,56 @@ def _classify_scc_host(enc: EncodedHistory, rows: np.ndarray,
     return {name: True for name in res}
 
 
+def condensed_stats(enc: EncodedHistory, members, src, dst, cls,
+                    realtime: bool) -> dict:
+    """The host-side search-stats record for a condensed check — the
+    long-history sibling of `kernels.stats_row`. Edge and SCC facts
+    are exact (the condensation computed them anyway: distinct edges
+    per class, nontrivial SCC count/shape from the native Tarjan, and
+    the realtime-edge count via one searchsorted over the completion
+    ranks rather than the O(n²) dense relation); closure-round/margin
+    telemetry is -1 — no dense closure ran on this path, and an
+    invented number would poison the planner's training data."""
+    from . import graph as G2
+    from . import kernels as K
+    if len(src):
+        distinct = np.unique(
+            np.stack([src, dst, cls.astype(np.int64)], axis=1), axis=0)
+        counts = np.bincount(distinct[:, 2], minlength=4)
+    else:
+        counts = np.zeros(4, np.int64)
+    rt = 0
+    if realtime and enc.n:
+        eff = effective_complete_index(enc.status, enc.complete_index)
+        inv = np.asarray(enc.invoke_index, np.int64)
+        # |{(j, i): complete(j) < invoke(i)}| — a txn's own completion
+        # never precedes its invocation, so self-pairs drop out free
+        rt = int(np.searchsorted(np.sort(eff), inv, side="left").sum())
+    sizes = np.asarray([len(m) for m in members], np.int64)
+    has = len(sizes) > 0
+    return {
+        "ww_edges": int(counts[G2.WW]), "wr_edges": int(counts[G2.WR]),
+        "rw_edges": int(counts[G2.RW]), "rt_edges": rt,
+        "proc_edges": int(counts[G2.PROC]),
+        "closure_rounds": -1,
+        "cycle_round": 0 if has else -1,
+        "scc_count": int(len(sizes)),
+        "scc_max": int(sizes.max()) if has else 0,
+        "scc_min": int(sizes.min()) if has else 0,
+        "cycle_txns": int(sizes.sum()) if has else 0,
+        "margin": -1,
+        "n_txns": int(enc.n), "t_pad": int(enc.n),
+        "closure_bound": K.closure_steps(max(enc.n, 1)),
+        "pad_waste_cells": 0,
+        "path": "condensed",
+    }
+
+
 def check_condensed(enc: EncodedHistory, *, classify: bool = True,
                     realtime: bool = False, process_order: bool = False,
                     devices=None,
-                    device_scc_limit: int = DEVICE_SCC_LIMIT) -> dict:
+                    device_scc_limit: int = DEVICE_SCC_LIMIT,
+                    stats_out: list | None = None) -> dict:
     """Check ONE long history via SCC condensation. Returns the same
     {anomaly: True} flag dict as the dense device path.
 
@@ -220,9 +266,15 @@ def check_condensed(enc: EncodedHistory, *, classify: bool = True,
     each SCC subgraph to the batched classification kernel; restriction
     to the SCC is exact (module docstring). SCCs beyond
     `device_scc_limit` rows classify on the host instead (their dense
-    [m,m] matrices are the very thing condensation avoids)."""
+    [m,m] matrices are the very thing condensation avoids).
+
+    `stats_out` (a list) gains one `condensed_stats` record for the
+    history — the JEPSEN_TPU_KERNEL_STATS path."""
     members, (src, dst, cls) = condense(enc, realtime=realtime,
                                         process_order=process_order)
+    if stats_out is not None:
+        stats_out.append(condensed_stats(enc, members, src, dst, cls,
+                                         realtime))
     if not members:
         return {}
     if not classify:

@@ -42,6 +42,58 @@ from .encode import EncodedHistory, effective_complete_index
 G0, G1C, G_SINGLE, G2_ITEM, CYCLE = 0, 1, 2, 3, 4
 FLAG_NAMES = {G0: "G0", G1C: "G1c", G_SINGLE: "G-single", G2_ITEM: "G2-item"}
 
+#: Per-history search-stat row layout ([B, N_STATS] int32) the kernels
+#: return alongside the verdict flags under JEPSEN_TPU_KERNEL_STATS —
+#: the structural evidence behind a verdict (ISSUE 15):
+#:
+#:   ww/wr/rw_edges   distinct dependency edges per class, BEFORE the
+#:                    power-of-two writer-chain shortcuts (so counts
+#:                    match the CPU oracle's graph exactly);
+#:   rt/proc_edges    realtime / process-order edges the kernel built
+#:                    from the timing tensors;
+#:   closure_rounds   squaring rounds the (final) closure actually ran
+#:                    to its fixpoint for THIS history (vs the static
+#:                    `closure_steps` bound the caller reports);
+#:   cycle_round      first round at which a cycle became visible
+#:                    (0 = present in the raw edge set; -1 = acyclic);
+#:   scc_count/max/min  nontrivial SCCs of the full closure, their
+#:                    largest and smallest member counts (0 = none);
+#:   cycle_txns       txn rows participating in any cycle;
+#:   margin           the decision-boundary margin: rounds of closure
+#:                    work sustained before a cycle appeared
+#:                    (= cycle_round for cyclic histories — high means
+#:                    the cycle needs long paths, i.e. near-miss from
+#:                    inside; = closure_rounds for valid ones — high
+#:                    means deep dependency chains, near-miss from
+#:                    outside). Together with the cyclic bit it orders
+#:                    histories by distance to the decision boundary,
+#:                    the signal the adversarial mutation search
+#:                    (ROADMAP item 3) seeds from.
+#:
+#: The JEPSEN_TPU_KERNEL_STATS gate itself has ONE reader —
+#: `obs.search.enabled()` — and the kernels never self-gate: callers
+#: decide by passing `with_stats`/`stats_out`, so the off path stays
+#: byte-identical (executables, dispatch keys, verdicts) with zero
+#: gate reads on the dispatch hot path.
+STAT_FIELDS = ("ww_edges", "wr_edges", "rw_edges", "rt_edges",
+               "proc_edges", "closure_rounds", "cycle_round",
+               "scc_count", "scc_max", "scc_min", "cycle_txns",
+               "margin")
+N_STATS = len(STAT_FIELDS)
+
+
+def stats_row(row, *, n_txns: int, t_pad: int) -> dict:
+    """One device stats row -> the per-history dict the analytics
+    journal records: the named device fields plus the host-side
+    geometry facts (bucket pad, the static closure bound, per-history
+    pad waste in closure cells)."""
+    out = {f: int(v) for f, v in zip(STAT_FIELDS, row)}
+    out["n_txns"] = int(n_txns)
+    out["t_pad"] = int(t_pad)
+    out["closure_bound"] = closure_steps(t_pad)
+    out["pad_waste_cells"] = int(t_pad) ** 2 - int(n_txns) ** 2
+    return out
+
 #: Per-chip peak throughput, keyed by a normalized `device_kind`. The
 #: MFU/roofline numbers used to assume v5e (394 int8 TOPS hard-coded in
 #: bench.py) whatever chip actually ran; now the peak resolves from
@@ -265,11 +317,17 @@ def closure_steps(n_txns: int) -> int:
 
 
 def _edges_one(appends: jnp.ndarray, reads: jnp.ndarray, n_keys: int,
-               max_pos: int, n_txns: int):
+               max_pos: int, n_txns: int, with_counts: bool = False):
     """Build [T,T] boolean adjacency matrices for ww/wr/rw from triples.
 
     appends: [A,3] (txn,key,pos), pos>=1 observed, -1 unobserved/dead.
     reads:   [R,3] (txn,key,pos-of-last), 0 empty read, -1 dead.
+
+    With `with_counts` (the kernel-stats path) a fourth output carries
+    the [3] int32 distinct-edge counts — ww counted BEFORE the
+    power-of-two shortcut edges below, so the number matches the CPU
+    oracle's adjacent-version graph, not the shortcut-augmented one
+    the closure runs on.
     """
     T = n_txns
     a_txn, a_key, a_pos = appends[:, 0], appends[:, 1], appends[:, 2]
@@ -296,6 +354,7 @@ def _edges_one(appends: jnp.ndarray, reads: jnp.ndarray, n_keys: int,
     # ww: writer of pos-1 -> writer of pos
     prev_w = W[k_idx, jnp.maximum(p_idx - 1, 0)]
     ww = scatter_edges(prev_w, a_txn, a_live & (a_pos >= 2))
+    ww_raw = ww if with_counts else None
 
     # Power-of-two shortcut edges along each key's writer chain: an
     # edge W[k,p] -> W[k,p+s] is implied by transitivity whenever every
@@ -324,6 +383,10 @@ def _edges_one(appends: jnp.ndarray, reads: jnp.ndarray, n_keys: int,
     # rw: reader -> writer of pos+1
     rp1 = jnp.where(r_live, jnp.minimum(r_pos + 1, max_pos + 1), max_pos + 1)
     rw = scatter_edges(r_txn, W[rk, rp1], r_live)
+    if with_counts:
+        counts = jnp.stack([jnp.sum(ww_raw), jnp.sum(wr), jnp.sum(rw)]
+                           ).astype(jnp.int32)
+        return ww, wr, rw, counts
     return ww, wr, rw
 
 
@@ -366,27 +429,101 @@ def _closure_batched(m: jnp.ndarray, steps: int, constrain,
 
     def body(carry):
         m, _, i = carry
-        if use_pallas:
-            from . import pallas_square
-            m2 = pallas_square.closure_square(
-                m, interpret=pallas_square.INTERPRET, int8=use_int8)
-        elif use_int8:
-            mb = constrain(m.astype(jnp.int8))
-            m2 = jax.lax.dot_general(
-                mb, mb, (((2,), (1,)), ((0,), (0,))),
-                preferred_element_type=jnp.int32) > 0
-            m2 = constrain(m2)
-        else:
-            mb = constrain(m.astype(jnp.bfloat16))
-            m2 = jax.lax.dot_general(
-                mb, mb, (((2,), (1,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32) > 0
-            m2 = constrain(m2)
+        m2 = _square(m, constrain, use_pallas, use_int8)
         return m2, jnp.any(m2 != m), i + 1
 
     m, _, i = jax.lax.while_loop(
         cond, body, (m, jnp.bool_(True), jnp.int32(0)))
     return m, i
+
+
+def _square(m, constrain, use_pallas: bool, use_int8: bool):
+    """ONE boolean matrix squaring — the loop body shared by
+    `_closure_batched` and `_closure_batched_stats`, so the stats
+    closure is bit-identical to the production one by construction
+    (the telemetry variant only adds bookkeeping around it)."""
+    if use_pallas:
+        from . import pallas_square
+        return pallas_square.closure_square(
+            m, interpret=pallas_square.INTERPRET, int8=use_int8)
+    if use_int8:
+        mb = constrain(m.astype(jnp.int8))
+        m2 = jax.lax.dot_general(
+            mb, mb, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.int32) > 0
+        return constrain(m2)
+    mb = constrain(m.astype(jnp.bfloat16))
+    m2 = jax.lax.dot_general(
+        mb, mb, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32) > 0
+    return constrain(m2)
+
+
+def _closure_batched_stats(m: jnp.ndarray, steps: int, constrain,
+                           use_pallas: bool = False,
+                           use_int8: bool = False):
+    """`_closure_batched` with per-HISTORY search telemetry: the same
+    squaring loop (same `_square` body, same batch-level fixpoint
+    exit, so the returned closure — and every flag derived from it —
+    is bit-identical to the stats-off kernel), additionally tracking
+    for each history the round its own matrix reached fixpoint and the
+    first round at which a cycle (an off-diagonal mutual-reachability
+    pair) became visible. Returns (closure, rounds [B], cycle_round
+    [B]; -1 = no cycle; cycle_round 0 = a cycle already present in
+    the raw edge set)."""
+    T = m.shape[-1]
+    eye = jnp.eye(T, dtype=bool)
+    nI = ~eye
+    m = m | eye
+    B = m.shape[0]
+
+    def has_cycle(mm):
+        return jnp.any(mm & jnp.swapaxes(mm, 1, 2) & nI, axis=(1, 2))
+
+    def cond(carry):
+        return carry[1] & (carry[2] < steps)
+
+    def body(carry):
+        m, _, i, rounds, cyc_round = carry
+        m2 = _square(m, constrain, use_pallas, use_int8)
+        changed_h = jnp.any(m2 != m, axis=(1, 2))
+        rounds = jnp.where(changed_h, i + 1, rounds)
+        cyc_round = jnp.where((cyc_round < 0) & has_cycle(m2), i + 1,
+                              cyc_round)
+        return m2, jnp.any(changed_h), i + 1, rounds, cyc_round
+
+    cyc0 = jnp.where(has_cycle(m), jnp.int32(0), jnp.int32(-1))
+    m, _, _, rounds, cyc_round = jax.lax.while_loop(
+        cond, body, (m, jnp.bool_(True), jnp.int32(0),
+                     jnp.zeros((B,), jnp.int32), cyc0))
+    return m, rounds, cyc_round
+
+
+def _graph_stats(edge_counts, rt_cnt, proc_cnt, c_full, rounds,
+                 cyc_round, nI) -> jnp.ndarray:
+    """Assemble the [B, N_STATS] stat rows from the full closure: SCC
+    shape via mutual reachability (i and j share an SCC iff each
+    reaches the other — the closure is reflexive, so the diagonal is
+    excluded with nI), plus the edge counts and round telemetry
+    gathered along the way. The SCC representative trick: the
+    first-True index of `mutual[i, :]` is the SCC's minimum member, so
+    counting rows that are their own argmax counts distinct SCCs."""
+    T = c_full.shape[-1]
+    mutual = c_full & jnp.swapaxes(c_full, 1, 2)       # [B,T,T]
+    on_cycle = jnp.any(mutual & nI, axis=2)            # [B,T]
+    scc_size = jnp.sum(mutual, axis=2).astype(jnp.int32)
+    cycle_txns = jnp.sum(on_cycle, axis=1).astype(jnp.int32)
+    scc_max = jnp.max(jnp.where(on_cycle, scc_size, 0), axis=1)
+    scc_min = jnp.min(jnp.where(on_cycle, scc_size, T + 1), axis=1)
+    scc_min = jnp.where(cycle_txns > 0, scc_min, 0)
+    rep = on_cycle & (jnp.argmax(mutual, axis=2)
+                      == jnp.arange(T, dtype=jnp.int32)[None, :])
+    scc_count = jnp.sum(rep, axis=1).astype(jnp.int32)
+    margin = jnp.where(cyc_round >= 0, cyc_round, rounds)
+    return jnp.stack(
+        [edge_counts[:, 0], edge_counts[:, 1], edge_counts[:, 2],
+         rt_cnt, proc_cnt, rounds, cyc_round, scc_count, scc_max,
+         scc_min, cycle_txns, margin], axis=-1).astype(jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnames=("n_keys", "max_pos",
@@ -420,18 +557,28 @@ def check_batched_impl(appends, reads, invoke_index, complete_index, process,
                        process_order: bool, constrain,
                        use_pallas: bool = False,
                        use_int8: bool = False,
-                       fused: bool = True) -> jnp.ndarray:
+                       fused: bool = True,
+                       with_stats: bool = False):
     """THE cycle-check kernel: packed [B,...] tensors -> [B] int32 flag
     words. `n_live` is the per-history real txn count ([B]); rows beyond
-    it are excluded from realtime/process edges."""
+    it are excluded from realtime/process edges. With `with_stats`
+    (JEPSEN_TPU_KERNEL_STATS) the return is `(flags, stats)` where
+    stats is the [B, N_STATS] int32 search-telemetry matrix — the
+    flags themselves are bit-identical either way."""
     edges = jax.vmap(functools.partial(
-        _edges_one, n_keys=n_keys, max_pos=max_pos, n_txns=n_txns))
-    ww, wr, rw = edges(appends, reads)
+        _edges_one, n_keys=n_keys, max_pos=max_pos, n_txns=n_txns,
+        with_counts=with_stats))
+    if with_stats:
+        ww, wr, rw, counts = edges(appends, reads)
+    else:
+        ww, wr, rw = edges(appends, reads)
+        counts = None
     return classify_matrices_impl(
         ww, wr, rw, invoke_index, complete_index, process, n_live,
         steps=steps, classify=classify, realtime=realtime,
         process_order=process_order, constrain=constrain,
-        use_pallas=use_pallas, use_int8=use_int8, fused=fused)
+        use_pallas=use_pallas, use_int8=use_int8, fused=fused,
+        with_stats=with_stats, edge_counts=counts)
 
 
 def _flags_from_closures(ww, wr, rw, c_ww, c_wwr, c_full, cycle,
@@ -457,15 +604,36 @@ def classify_matrices_impl(ww, wr, rw, invoke_index, complete_index, process,
                            realtime: bool, process_order: bool,
                            constrain, use_pallas: bool = False,
                            use_int8: bool = False,
-                           fused: bool = True) -> jnp.ndarray:
+                           fused: bool = True,
+                           with_stats: bool = False,
+                           edge_counts=None):
     """Closure + anomaly classification over explicit [B,T,T] boolean edge
     matrices. Entry point for checkers (rw-register) whose edge
     construction happens host-side from inferred version graphs rather
-    than from per-key position chains."""
+    than from per-key position chains.
+
+    With `with_stats`, returns `(flags, stats)` — see STAT_FIELDS. The
+    WIDEST closure of whichever strategy runs (the from-scratch full
+    closure in detect/fused mode; the final seeded stage of the
+    unfused chain) supplies the round/margin telemetry, and
+    `edge_counts` ([B,3], from `_edges_one(with_counts=True)`) the
+    pre-shortcut ww/wr/rw counts; None (this host-built-matrix entry
+    point) counts the RAW incoming matrices instead — host builders
+    emit no shortcut edges, so the counts match their edge lists."""
     T = ww.shape[-1]
     nI = ~jnp.eye(T, dtype=bool)
     live = jnp.arange(T)[None, :] < n_live[:, None]          # [B,T]
     live2 = live[:, :, None] & live[:, None, :]              # [B,T,T]
+
+    if with_stats and edge_counts is None:
+        edge_counts = jnp.stack(
+            [jnp.sum(ww, axis=(1, 2)), jnp.sum(wr, axis=(1, 2)),
+             jnp.sum(rw, axis=(1, 2))], axis=-1).astype(jnp.int32)
+    rt_cnt = proc_cnt = None
+    if with_stats:
+        B = ww.shape[0]
+        rt_cnt = jnp.zeros((B,), jnp.int32)
+        proc_cnt = jnp.zeros((B,), jnp.int32)
 
     if process_order:
         # Consecutive txns of one process in completion order: link row i
@@ -478,21 +646,44 @@ def classify_matrices_impl(ww, wr, rw, invoke_index, complete_index, process,
         big = jnp.where(cand, complete_index[:, None, :],
                         jnp.iinfo(complete_index.dtype).max)
         nxt = jnp.min(big, axis=2, keepdims=True)
-        ww = ww | (cand & (big == nxt))
+        proc_add = cand & (big == nxt)
+        if with_stats:
+            proc_cnt = jnp.sum(proc_add, axis=(1, 2)).astype(jnp.int32)
+        ww = ww | proc_add
     if realtime:
         # j completed before i invoked => j precedes i in real time.
         # Indeterminate txns carry NEVER_COMPLETED and emit no rt edges.
         rt = complete_index[:, :, None] < invoke_index[:, None, :]
-        ww = ww | (rt & live2 & nI)
+        rt_add = rt & live2 & nI
+        if with_stats:
+            rt_cnt = jnp.sum(rt_add, axis=(1, 2)).astype(jnp.int32)
+        ww = ww | rt_add
+
+    def closure(m):
+        """The widest closure + its telemetry: the stats variant runs
+        the SAME loop body, so the matrix (and every flag below) is
+        bit-identical with the gate on or off."""
+        if with_stats:
+            return _closure_batched_stats(m, steps, constrain,
+                                          use_pallas, use_int8)
+        c, _ = _closure_batched(m, steps, constrain, use_pallas,
+                                use_int8)
+        return c, None, None
+
+    def result(flags, c_full, rounds, cyc_round):
+        if not with_stats:
+            return flags
+        return flags, _graph_stats(edge_counts, rt_cnt, proc_cnt,
+                                   c_full, rounds, cyc_round, nI)
 
     wwr = ww | wr
     full = wwr | rw
     if not classify:
-        c_full, _ = _closure_batched(full, steps, constrain, use_pallas,
-                                     use_int8)
+        c_full, rounds, cyc_round = closure(full)
         cycle = jnp.any(full & jnp.swapaxes(c_full, 1, 2) & nI,
                         axis=(1, 2))
-        return cycle.astype(jnp.int32) << CYCLE
+        return result(cycle.astype(jnp.int32) << CYCLE, c_full,
+                      rounds, cyc_round)
     if fused:
         # Fused detect/classify (Elle's own design point: classification
         # falls out of the same graph detection walks): run the detect
@@ -506,8 +697,7 @@ def classify_matrices_impl(ww, wr, rw, invoke_index, complete_index, process,
         # subset of `full` and each per-class closure a subset of
         # c_full), so a batch where the detect test fires nowhere can
         # only classify to zero flags.
-        c_full, _ = _closure_batched(full, steps, constrain, use_pallas,
-                                     use_int8)
+        c_full, rounds, cyc_round = closure(full)
         cycle = jnp.any(full & jnp.swapaxes(c_full, 1, 2) & nI,
                         axis=(1, 2))
 
@@ -523,8 +713,9 @@ def classify_matrices_impl(ww, wr, rw, invoke_index, complete_index, process,
         def _clean(ops):
             return ops[4].astype(jnp.int32) << CYCLE
 
-        return jax.lax.cond(jnp.any(cycle), _classify, _clean,
-                            (ww, wr, rw, c_full, cycle))
+        flags = jax.lax.cond(jnp.any(cycle), _classify, _clean,
+                             (ww, wr, rw, c_full, cycle))
+        return result(flags, c_full, rounds, cyc_round)
     # Unfused baseline (JEPSEN_TPU_FUSED_CLASSIFY=0): chained warm
     # starts — closure(A|B) == closure(closure(A)|B), so seeding each
     # wider closure with the previous result is exact and each seeded
@@ -534,11 +725,11 @@ def classify_matrices_impl(ww, wr, rw, invoke_index, complete_index, process,
                                use_int8)
     c_wwr, _ = _closure_batched(c_ww | wr, steps, constrain, use_pallas,
                                 use_int8)
-    c_full, _ = _closure_batched(c_wwr | rw, steps, constrain,
-                                 use_pallas, use_int8)
+    c_full, rounds, cyc_round = closure(c_wwr | rw)
     cycle = jnp.any(full & jnp.swapaxes(c_full, 1, 2) & nI, axis=(1, 2))
-    return _flags_from_closures(ww, wr, rw, c_ww, c_wwr, c_full, cycle,
-                                nI)
+    return result(
+        _flags_from_closures(ww, wr, rw, c_ww, c_wwr, c_full, cycle,
+                             nI), c_full, rounds, cyc_round)
 
 
 def _identity(x):
@@ -547,7 +738,7 @@ def _identity(x):
 
 @functools.partial(jax.jit, static_argnames=(
     "n_keys", "max_pos", "n_txns", "steps", "classify", "realtime",
-    "process_order", "use_pallas", "use_int8", "fused"))
+    "process_order", "use_pallas", "use_int8", "fused", "with_stats"))
 def check_batch_device(appends, reads, invoke_index, complete_index, process,
                        n_live, *, n_keys: int, max_pos: int, n_txns: int,
                        steps: int, classify: bool = True,
@@ -555,32 +746,36 @@ def check_batch_device(appends, reads, invoke_index, complete_index, process,
                        process_order: bool = False,
                        use_pallas: bool = False,
                        use_int8: bool = False,
-                       fused: bool = True) -> jnp.ndarray:
-    """Single-device jitted entry over a packed batch: [B] int32 flags."""
+                       fused: bool = True,
+                       with_stats: bool = False):
+    """Single-device jitted entry over a packed batch: [B] int32 flags
+    (plus the [B, N_STATS] stats matrix under with_stats)."""
     return check_batched_impl(
         appends, reads, invoke_index, complete_index, process, n_live,
         n_keys=n_keys, max_pos=max_pos, n_txns=n_txns, steps=steps,
         classify=classify, realtime=realtime, process_order=process_order,
         constrain=_identity, use_pallas=use_pallas, use_int8=use_int8,
-        fused=fused)
+        fused=fused, with_stats=with_stats)
 
 
 @functools.partial(jax.jit, static_argnames=(
     "steps", "classify", "realtime", "process_order", "use_pallas",
-    "use_int8", "fused"))
+    "use_int8", "fused", "with_stats"))
 def classify_matrices_device(ww, wr, rw, invoke_index, complete_index,
                              process, n_live, *, steps: int,
                              classify: bool = True, realtime: bool = False,
                              process_order: bool = False,
                              use_pallas: bool = False,
                              use_int8: bool = False,
-                             fused: bool = True) -> jnp.ndarray:
+                             fused: bool = True,
+                             with_stats: bool = False):
     """Jitted single-device entry over packed [B,T,T] edge matrices."""
     return classify_matrices_impl(
         ww, wr, rw, invoke_index, complete_index, process, n_live,
         steps=steps, classify=classify, realtime=realtime,
         process_order=process_order, constrain=_identity,
-        use_pallas=use_pallas, use_int8=use_int8, fused=fused)
+        use_pallas=use_pallas, use_int8=use_int8, fused=fused,
+        with_stats=with_stats)
 
 
 def pack_edge_matrices(per_history: list[dict], multiple: int = 128) -> dict:
@@ -620,13 +815,18 @@ def pack_edge_matrices(per_history: list[dict], multiple: int = 128) -> dict:
 def check_edge_batch(per_history: list[dict], realtime: bool = False,
                      process_order: bool = False,
                      classify: bool = True, devices=None,
-                     fused: bool | None = None) -> list[dict]:
+                     fused: bool | None = None,
+                     stats_out: list | None = None) -> list[dict]:
     """Device cycle check over host-built edge lists: per-history
     {anomaly-name: True} dicts (the rw-register device path, and the
     per-SCC classify stage of the condensed long-history path).
 
     With several devices the batch axis shards over a 1-D dp mesh,
-    ragged batches padded by replicating the last entry."""
+    ragged batches padded by replicating the last entry.
+
+    `stats_out` (a list) is EXTENDED with one `stats_row` dict per
+    input history when given — the kernel then also computes the
+    search-telemetry matrix (same flags either way)."""
     if not per_history:
         return []
     n = len(per_history)
@@ -650,16 +850,24 @@ def check_edge_batch(per_history: list[dict], realtime: bool = False,
         single_device=len(devices) == 1)
     if fused is None:
         fused = fused_classify_enabled()
-    flags = classify_matrices_device(
+    with_stats = stats_out is not None
+    out = classify_matrices_device(
         *args, steps=closure_steps(p["T"]), classify=classify,
         realtime=realtime, process_order=process_order,
-        use_pallas=use_pallas, use_int8=use_int8, fused=fused)
+        use_pallas=use_pallas, use_int8=use_int8, fused=fused,
+        with_stats=with_stats)
+    flags, dev_stats = out if with_stats else (out, None)
     # the np.asarray below is an implicit device wait: bound it with
     # the dispatch watchdog so a wedged device can't hang the wr sweep
     # (JEPSEN_TPU_DISPATCH_TIMEOUT_S; no-op when the gate is off)
     from ...parallel import _block_flags
     from ... import trace as _trace
     flags = _block_flags(flags, _trace.get_current())
+    if with_stats:
+        rows = np.asarray(dev_stats)[:n]
+        stats_out.extend(
+            stats_row(rows[i], n_txns=per_history[i]["n"],
+                      t_pad=p["T"]) for i in range(n))
     return [flags_to_names(int(w)) for w in np.asarray(flags)[:n]]
 
 
@@ -668,29 +876,38 @@ def check_edge_batch_bucketed(per_history: list[dict],
                               process_order: bool = False,
                               classify: bool = True, devices=None,
                               budget_cells: int = 1 << 27,
-                              fused: bool | None = None) -> list[dict]:
+                              fused: bool | None = None,
+                              stats_out: list | None = None) -> list[dict]:
     """check_edge_batch with device-memory-aware length bucketing: the
     packed matrices are B·T_pad² cells × 3 edge classes, so one
     unbucketed dispatch over a big store would blow HBM. Reuses
     parallel.bucket_by_length (including its dp-padding headroom —
     check_edge_batch replicates the last entry up to a device
-    multiple); results return in input order."""
+    multiple); results return in input order, and `stats_out` (when
+    given) is extended with per-history stats dicts in the SAME
+    order."""
     if not per_history:
         return []
     from ...parallel import bucket_by_length
     dp = (len(devices) if devices is not None
           else len(default_devices()))
     out: list[dict | None] = [None] * len(per_history)
+    sout: list = [None] * len(per_history)
     for bucket in bucket_by_length(per_history,
                                    budget_cells=budget_cells,
                                    dp=max(1, dp)):
+        bstats: list | None = [] if stats_out is not None else None
         res = check_edge_batch([per_history[j] for j in bucket],
                                realtime=realtime,
                                process_order=process_order,
                                classify=classify, devices=devices,
-                               fused=fused)
-        for j, r in zip(bucket, res):
+                               fused=fused, stats_out=bstats)
+        for i, (j, r) in enumerate(zip(bucket, res)):
             out[j] = r
+            if bstats is not None:
+                sout[j] = bstats[i]
+    if stats_out is not None:
+        stats_out.extend(sout)
     return out  # type: ignore[return-value]
 
 
@@ -709,7 +926,8 @@ def check_encoded_batch(encs: list[EncodedHistory],
                         realtime: bool = False,
                         process_order: bool = False,
                         classify: bool = True,
-                        devices=None) -> list[dict]:
+                        devices=None,
+                        stats_out: list | None = None) -> list[dict]:
     """Check a batch of encoded histories on device; returns per-history
     dicts {anomaly-name: True} for the cycle anomalies.
 
@@ -737,10 +955,17 @@ def check_encoded_batch(encs: list[EncodedHistory],
 
     use_pallas, use_int8 = resolve_formulation(
         single_device=len(devices) == 1)
-    flags = check_batch_device(
+    with_stats = stats_out is not None
+    out = check_batch_device(
         *args, n_keys=shape.n_keys, max_pos=shape.max_pos,
         n_txns=shape.n_txns, steps=closure_steps(shape.n_txns),
         classify=classify, realtime=realtime, process_order=process_order,
         use_pallas=use_pallas, use_int8=use_int8,
-        fused=fused_classify_enabled())
+        fused=fused_classify_enabled(), with_stats=with_stats)
+    flags, dev_stats = out if with_stats else (out, None)
+    if with_stats:
+        rows = np.asarray(dev_stats)[:n]
+        stats_out.extend(
+            stats_row(rows[i], n_txns=encs[i].n, t_pad=shape.n_txns)
+            for i in range(n))
     return [flags_to_names(int(w)) for w in np.asarray(flags)[:n]]

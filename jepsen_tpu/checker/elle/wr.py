@@ -482,22 +482,28 @@ class WrChecker(Checker):
         artifacts.attach(res, res.get("device-host-divergence", {}),
                          test, opts)
 
-    def check_batch(self, test, histories: list, opts) -> list[dict]:
+    def check_batch(self, test, histories: list, opts,
+                    stats_out: list | None = None) -> list[dict]:
         """Batched per-key dispatch: host version-order inference per
         history, then length-bucketed device cycle dispatches over the
         packed edge matrices (kernels.check_edge_batch_bucketed);
-        flagged histories re-run the host oracle for witnesses."""
+        flagged histories re-run the host oracle for witnesses.
+        `stats_out` (a list) is extended with per-history kernel
+        search-stat dicts on the device path (None per history on the
+        CPU oracle — it runs no closure to report on)."""
         from ...devices import resolve_backend
         backend = resolve_backend(self.backend)
         encs = [encode_wr_history(h, **self.opts) for h in histories]
         kw = dict(realtime=self.realtime,
                   process_order=self.process_order)
         if backend != "tpu":
+            if stats_out is not None:
+                stats_out.extend(None for _ in encs)
             return [render_wr_verdict(e, cycle_anomalies_cpu(e, **kw),
                                       self.prohibited) for e in encs]
         from . import artifacts, kernels
         cycles_list = kernels.check_edge_batch_bucketed(
-            [to_edge_dict(e) for e in encs], **kw)
+            [to_edge_dict(e) for e in encs], stats_out=stats_out, **kw)
         out = []
         for enc, cycles in zip(encs, cycles_list):
             divergent: dict = {}

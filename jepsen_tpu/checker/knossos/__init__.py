@@ -132,7 +132,8 @@ def _lift(e: Entry) -> None:
 
 
 def wgl(model: models.Model, raw_history: list[dict],
-        max_configs: int = 10_000_000) -> dict:
+        max_configs: int = 10_000_000,
+        search_stats: dict | None = None) -> dict:
     """Wing-Gong-Lowe linearizability search with memoization.
 
     Walks the entry list looking for a call to linearize next; lifting a
@@ -149,20 +150,29 @@ def wgl(model: models.Model, raw_history: list[dict],
     cache discipline, same verdicts (differential parity pinned in
     tests/test_knossos.py); final-paths/configs witnesses are lean
     there. This Python engine is the oracle, the fallback, and the
-    only engine for every other model."""
+    only engine for every other model.
+
+    `search_stats` (a dict, filled in place — the kernel-stats
+    telemetry seam) gains the engine's search counters: configs
+    explored (the memo-cache size), max linearization depth, and — on
+    the Python engine, whose walk exposes them — backtracks. The
+    verdict dict itself never changes shape."""
     if type(model) is models.CASRegister and model.value is None:
-        res = _wgl_native(raw_history, max_configs, "cas")
+        res = _wgl_native(raw_history, max_configs, "cas",
+                          search_stats)
         if res is not None:
             return res
     elif type(model) is models.Mutex and model.locked is False:
-        res = _wgl_native(raw_history, max_configs, "mutex")
+        res = _wgl_native(raw_history, max_configs, "mutex",
+                          search_stats)
         if res is not None:
             return res
-    return _wgl_python(model, raw_history, max_configs)
+    return _wgl_python(model, raw_history, max_configs, search_stats)
 
 
 def _wgl_native(raw_history: list[dict], max_configs: int,
-                model_kind: str = "cas") -> dict | None:
+                model_kind: str = "cas",
+                search_stats: dict | None = None) -> dict | None:
     """Run the native WGL (CAS register or mutex); None -> use the
     Python engine (lib missing, unencodable history, or un-internable
     values)."""
@@ -192,6 +202,11 @@ def _wgl_native(raw_history: list[dict], max_configs: int,
     L.jt_wgl_run(ev.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
                  ev.shape[0], max_configs, model_id, out)
     verdict, n, depth, fail_op, _cache = out
+    if search_stats is not None:
+        # the C++ ABI exposes the cache size and depth, not the
+        # backtrack count — no "backtracks" key rather than a fake 0
+        search_stats.update(engine="wgl-native", configs=int(_cache),
+                            max_depth=int(depth), op_count=int(n))
     if n == 0:
         return {"valid?": True, "op-count": 0, "analyzer": "wgl"}
     if verdict == 1:
@@ -212,11 +227,25 @@ def _wgl_native(raw_history: list[dict], max_configs: int,
 
 
 def _wgl_python(model: models.Model, raw_history: list[dict],
-                max_configs: int = 10_000_000) -> dict:
-    """The pure-Python WGL engine (any model; the parity oracle)."""
+                max_configs: int = 10_000_000,
+                search_stats: dict | None = None) -> dict:
+    """The pure-Python WGL engine (any model; the parity oracle).
+    `search_stats` gains the walk's own telemetry: configs (memo-cache
+    size), backtracks (forced un-linearizations — exactly 0 on a
+    history the greedy depth-first path linearizes outright), and the
+    deepest linearization reached."""
     hist = reduce_history(raw_history)
     head, n, returns_left = _build_entries(hist)
+    backtracks = 0
+
+    def _note(configs: int, depth: int) -> None:
+        if search_stats is not None:
+            search_stats.update(engine="wgl", configs=configs,
+                                backtracks=backtracks, max_depth=depth,
+                                op_count=n)
+
     if n == 0:
+        _note(0, 0)
         return {"valid?": True, "op-count": 0, "analyzer": "wgl"}
 
     state: Any = model
@@ -232,6 +261,7 @@ def _wgl_python(model: models.Model, raw_history: list[dict],
             # cannot happen while returns remain, but guard for safety.
             if not stack:
                 break
+            backtracks += 1
             frame = stack.pop()
             e2 = frame.entry
             _unlift(e2)
@@ -247,6 +277,7 @@ def _wgl_python(model: models.Model, raw_history: list[dict],
             key = (linearized | (1 << entry.op_id), s2)
             if not models.is_inconsistent(s2) and key not in cache:
                 if len(cache) >= max_configs:
+                    _note(len(cache), best_depth)
                     return {"valid?": "unknown", "op-count": n,
                             "analyzer": "wgl",
                             "cause": ":config-cache-exhausted",
@@ -267,11 +298,13 @@ def _wgl_python(model: models.Model, raw_history: list[dict],
         else:
             # A completed op we failed to linearize before its return.
             if not stack:
+                _note(len(cache), best_depth)
                 return {"valid?": False, "op-count": n, "analyzer": "wgl",
                         "op": entry.op,
                         "max-depth": best_depth,
                         "final-paths": _final_paths(stack),
                         "configs": [_config_map(state, linearized)]}
+            backtracks += 1
             frame = stack.pop()
             e2 = frame.entry
             _unlift(e2)
@@ -282,6 +315,7 @@ def _wgl_python(model: models.Model, raw_history: list[dict],
             state = frame.state
             entry = e2.next
 
+    _note(len(cache), best_depth)
     return {"valid?": True, "op-count": n, "analyzer": "wgl",
             "max-depth": best_depth,
             "final-paths": _final_paths(stack)}
@@ -298,7 +332,8 @@ def _final_paths(stack: list[_Frame]) -> list[dict]:
 
 
 def analysis(model: models.Model, raw_history: list[dict],
-             algorithm: str = "wgl", **kw: Any) -> dict:
+             algorithm: str = "wgl",
+             search_stats: dict | None = None, **kw: Any) -> dict:
     """Entry point matching knossos.{wgl,linear,competition}/analysis.
 
     On CPU every algorithm name routes to the WGL engine (knossos's
@@ -308,4 +343,4 @@ def analysis(model: models.Model, raw_history: list[dict],
     see `.kernels`)."""
     if algorithm not in ("wgl", "linear", "competition"):
         raise ValueError(f"unknown algorithm {algorithm!r}")
-    return wgl(model, raw_history, **kw)
+    return wgl(model, raw_history, search_stats=search_stats, **kw)

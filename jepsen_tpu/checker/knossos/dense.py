@@ -208,8 +208,11 @@ def _has_bit_table(S: int) -> np.ndarray:
     return ((m >> s) & 1).astype(bool)
 
 
-def _scan_dense(regs, comp, V: int, S: int):
-    """One history: regs [C, S, 4], comp [C] -> valid? (exact).
+def _scan_dense(regs, comp, V: int, S: int, with_stats: bool = False):
+    """One history: regs [C, S, 4], comp [C] -> valid? (exact); with
+    `with_stats`, additionally (peak occupied configurations, total
+    expansion rounds) — the dense grid's search telemetry (the grid
+    has no overflow, so occupancy IS the frontier-width analogue).
 
     Gather-free: the mask-axis index maps (m -> m & ~bit_s on expansion,
     m -> m | bit_s on retire) are wrap-free shifts by 2^s over the
@@ -225,7 +228,8 @@ def _scan_dense(regs, comp, V: int, S: int):
 
     valid0 = jnp.zeros((V, M), bool).at[0, 0].set(True)
 
-    def step(valid, xs):
+    def step(carry, xs):
+        valid, *stats = carry
         r, cs = xs
         f, a1, a2, known = r[:, 0], r[:, 1], r[:, 2], r[:, 3]
         occupied = f >= 0
@@ -265,8 +269,11 @@ def _scan_dense(regs, comp, V: int, S: int):
         def cond(carry):
             return carry[1] & (carry[2] < S + 2)
 
-        valid, _, _ = jax.lax.while_loop(
+        valid, _, rnd = jax.lax.while_loop(
             cond, round_, (valid, cs >= 0, jnp.int32(0)))
+        if with_stats:
+            occ = jnp.sum(valid).astype(jnp.int32)
+            stats = (jnp.maximum(stats[0], occ), stats[1] + rnd)
 
         # completion deadline: survivors linearized slot cs; retire its
         # bit: valid'[v, m'] = valid[v, m' | bit_cs] for m' lacking cs —
@@ -277,21 +284,31 @@ def _scan_dense(regs, comp, V: int, S: int):
             r_s = jnp.roll(valid, -(1 << s), axis=1) & lacks_t[s][None, :]
             retired = jnp.where(cs == s, r_s, retired)
         valid = jnp.where(cs >= 0, retired, valid)
-        return valid, None
+        return (valid,) + tuple(stats), None
 
-    valid, _ = jax.lax.scan(step, valid0, (regs, comp))
-    return jnp.any(valid)
+    init = (valid0, jnp.int32(1), jnp.int32(0)) if with_stats \
+        else (valid0,)
+    carry, _ = jax.lax.scan(step, init, (regs, comp))
+    if with_stats:
+        return jnp.any(carry[0]), carry[1], carry[2]
+    return jnp.any(carry[0])
 
 
-@functools.partial(jax.jit, static_argnames=("n_values", "n_slots"))
-def check_dense_device(regs, comp, *, n_values: int, n_slots: int):
-    """Jitted batched entry: regs [B,C,S,4], comp [B,C] -> valid [B]."""
+@functools.partial(jax.jit, static_argnames=("n_values", "n_slots",
+                                             "with_stats"))
+def check_dense_device(regs, comp, *, n_values: int, n_slots: int,
+                       with_stats: bool = False):
+    """Jitted batched entry: regs [B,C,S,4], comp [B,C] -> valid [B]
+    (plus peak-occupancy and rounds [B] int32 under with_stats)."""
     return jax.vmap(
-        functools.partial(_scan_dense, V=n_values, S=n_slots))(regs, comp)
+        functools.partial(_scan_dense, V=n_values, S=n_slots,
+                          with_stats=with_stats))(regs, comp)
 
 
 def check_encoded_dense_batch(encs: list[DenseEncoded],
-                              devices=None) -> list[dict]:
+                              devices=None,
+                              stats_out: list | None = None
+                              ) -> list[dict]:
     """Check dense-encoded histories on device; exact verdicts.
 
     Histories are bucketed by pending-slot peak so one high-concurrency
@@ -307,6 +324,8 @@ def check_encoded_dense_batch(encs: list[DenseEncoded],
         # diversity for at most one doubling of M within a bucket
         buckets.setdefault(e.n_slots + (e.n_slots & 1), []).append(i)
     out: list[dict | None] = [None] * len(encs)
+    with_stats = stats_out is not None
+    sout: list = [None] * len(encs)
     for _slots, idxs in sorted(buckets.items()):
         group = [encs[i] for i in idxs]
         padded = pad_to_multiple(group, len(devices))
@@ -320,9 +339,28 @@ def check_encoded_dense_batch(encs: list[DenseEncoded],
                 mesh, jax.sharding.PartitionSpec("dp"))
             regs = jax.device_put(regs, sharding)
             comp = jax.device_put(comp, sharding)
-        valid = np.asarray(check_dense_device(
-            regs, comp, n_values=shape.n_values, n_slots=shape.n_slots))
+        if with_stats:
+            valid, peak, rounds = check_dense_device(
+                regs, comp, n_values=shape.n_values,
+                n_slots=shape.n_slots, with_stats=True)
+            peak = np.asarray(peak)
+            rounds = np.asarray(rounds)
+        else:
+            valid = check_dense_device(
+                regs, comp, n_values=shape.n_values,
+                n_slots=shape.n_slots)
+        valid = np.asarray(valid)
         for j, i in enumerate(idxs):
             out[i] = {"valid?": bool(valid[j]), "analyzer": "tpu-dense",
                       "op-count": encs[i].n_ops}
+            if with_stats:
+                sout[i] = {
+                    "engine": "tpu-dense",
+                    "frontier_peak": int(peak[j]),
+                    "grid_configs": int(shape.n_values
+                                        * (1 << shape.n_slots)),
+                    "rounds": int(rounds[j]),
+                    "n_slots": int(shape.n_slots)}
+    if with_stats:
+        stats_out.extend(sout)
     return out  # type: ignore[return-value]

@@ -104,15 +104,18 @@ def _sorted_unique(states, masks, valid, F: int):
 
 
 def _expand_fixpoint(states, masks, valid, slot_f, slot_a1, slot_a2,
-                     slot_known, enabled, F: int, S: int):
+                     slot_known, enabled, F: int, S: int,
+                     with_stats: bool = False):
     """Close the frontier under single-op linearization: repeatedly apply
     every occupied, unapplied slot to every configuration until the
     sorted frontier stops changing. Returns (states, masks, valid,
-    overflow)."""
+    overflow) — plus (peak frontier width, rounds run, candidate
+    configurations generated) under `with_stats` (the kernel-stats
+    telemetry path; the frontier math itself is identical — the extra
+    carry only observes it)."""
     slot_bits = jnp.int32(1) << jnp.arange(S, dtype=jnp.int32)
 
-    def round_(front):
-        states, masks, valid, _, overflow, _r = front
+    def round_(states, masks, valid):
         occupied = slot_f >= 0                               # [S]
         unapplied = (masks[:, None] & slot_bits[None, :]) == 0
         can = valid[:, None] & occupied[None, :] & unapplied  # [F,S]
@@ -129,7 +132,8 @@ def _expand_fixpoint(states, masks, valid, slot_f, slot_a1, slot_a2,
                                     F)
         changed = ~(jnp.all((s == states) & (m == masks))
                     & jnp.all(v == valid))
-        return s, m, v, changed, n > F, _r
+        n_cand = jnp.sum(can.astype(jnp.int32))
+        return s, m, v, changed, n > F, n, n_cand
 
     def cond(front):
         # Bounded by S+2 rounds: any forced chain applies at most S ops,
@@ -137,9 +141,23 @@ def _expand_fixpoint(states, masks, valid, slot_f, slot_a1, slot_a2,
         # truncation (where the verdict is already "unknown").
         return front[3] & (front[5] < S + 2)
 
+    if with_stats:
+        def body(front):
+            s, m, v, changed, ovf, n, nc = round_(front[0], front[1],
+                                                  front[2])
+            return (s, m, v, changed, front[4] | ovf, front[5] + 1,
+                    jnp.maximum(front[6], n), front[7] + nc)
+
+        init = (states, masks, valid, enabled, jnp.bool_(False),
+                jnp.int32(0), jnp.int32(0), jnp.int32(0))
+        (states, masks, valid, _, overflow, rounds, peak,
+         explored) = jax.lax.while_loop(cond, body, init)
+        return states, masks, valid, overflow, peak, rounds, explored
+
     def body(front):
-        s, m, v, changed, ovf, r = round_(front)
-        return s, m, v, changed, front[4] | ovf, r + 1
+        s, m, v, changed, ovf, _n, _nc = round_(front[0], front[1],
+                                                front[2])
+        return s, m, v, changed, front[4] | ovf, front[5] + 1
 
     # First round unconditionally sorts/dedups the incoming frontier
     # (it may be unsorted after a completion filter); the exit test
@@ -151,9 +169,10 @@ def _expand_fixpoint(states, masks, valid, slot_f, slot_a1, slot_a2,
     return states, masks, valid, overflow
 
 
-def _scan_history(events, F: int, S: int):
+def _scan_history(events, F: int, S: int, with_stats: bool = False):
     """Run the event walk for one history. events: [E, 6] int32.
-    Returns (valid?, overflow)."""
+    Returns (valid?, overflow) — plus (peak frontier width, expansion
+    rounds, configurations generated) under `with_stats`."""
     E = events.shape[0]
 
     init = (
@@ -166,10 +185,14 @@ def _scan_history(events, F: int, S: int):
         jnp.zeros((S,), jnp.int32),                       # slot_known
         jnp.bool_(False),                                 # overflow
     )
+    if with_stats:
+        init = init + (jnp.int32(1),                      # peak width
+                       jnp.int32(0),                      # rounds
+                       jnp.int32(0))                      # explored
 
     def step(carry, ev):
         (states, masks, valid, slot_f, slot_a1, slot_a2, slot_known,
-         overflow) = carry
+         overflow, *stats) = carry
         kind, slot, f, a1, a2, known = (ev[0], ev[1], ev[2], ev[3],
                                         ev[4], ev[5])
         is_inv = kind == INVOKE_EV
@@ -184,9 +207,17 @@ def _scan_history(events, F: int, S: int):
         slot_known = slot_known.at[slot].set(
             jnp.where(is_inv, known, slot_known[slot]))
 
-        states, masks, valid, ovf = _expand_fixpoint(
-            states, masks, valid, slot_f, slot_a1, slot_a2, slot_known,
-            is_comp, F, S)
+        if with_stats:
+            (states, masks, valid, ovf, peak, rounds,
+             explored) = _expand_fixpoint(
+                states, masks, valid, slot_f, slot_a1, slot_a2,
+                slot_known, is_comp, F, S, with_stats=True)
+            stats = (jnp.maximum(stats[0], peak), stats[1] + rounds,
+                     stats[2] + explored)
+        else:
+            states, masks, valid, ovf = _expand_fixpoint(
+                states, masks, valid, slot_f, slot_a1, slot_a2,
+                slot_known, is_comp, F, S)
         overflow |= ovf
 
         # Completion deadline: only configurations that linearized the
@@ -199,26 +230,33 @@ def _scan_history(events, F: int, S: int):
             jnp.where(is_comp, -1, slot_f[slot]))
 
         return (states, masks, valid, slot_f, slot_a1, slot_a2,
-                slot_known, overflow), None
+                slot_known, overflow) + tuple(stats), None
 
     carry, _ = jax.lax.scan(step, init, events, length=E)
+    if with_stats:
+        valid, overflow = carry[2], carry[7]
+        return (jnp.any(valid), overflow, carry[8], carry[9],
+                carry[10])
     states, masks, valid, *_rest, overflow = carry
     return jnp.any(valid), overflow
 
 
-@functools.partial(jax.jit, static_argnames=("frontier", "n_slots"))
+@functools.partial(jax.jit, static_argnames=("frontier", "n_slots",
+                                             "with_stats"))
 def check_batch_device(events, *, frontier: int = 512,
-                       n_slots: int = 16):
+                       n_slots: int = 16, with_stats: bool = False):
     """Jitted batched entry: events [B, E, 6] -> (valid [B] bool,
-    overflow [B] bool)."""
+    overflow [B] bool), plus (peak, rounds, explored) [B] int32 each
+    under with_stats."""
     return jax.vmap(
-        functools.partial(_scan_history, F=frontier, S=n_slots))(events)
+        functools.partial(_scan_history, F=frontier, S=n_slots,
+                          with_stats=with_stats))(events)
 
 
 def check_encoded_batch(encs: list[EncodedRegisterHistory],
                         frontier: int = 512,
-                        devices=None, packed: bool | None = None
-                        ) -> list[dict]:
+                        devices=None, packed: bool | None = None,
+                        stats_out: list | None = None) -> list[dict]:
     """Check encoded register histories on device. Returns knossos-shaped
     verdicts: {"valid?": True|False|"unknown", "analyzer": "tpu-jit"}.
 
@@ -253,11 +291,24 @@ def check_encoded_batch(encs: list[EncodedRegisterHistory],
 
     from .packed import packable
     fits = all(packable(e.n_values, shape.n_slots) for e in encs)
-    packed = fits if packed is None else (packed and fits)
+    with_stats = stats_out is not None
+    # stats requested -> the unpacked kernel (the only one carrying
+    # the telemetry carry); verdict parity between the two kernels is
+    # pinned by tests, so the downgrade is observability-only
+    packed = (fits if packed is None else (packed and fits)) \
+        and not with_stats
+    peak = rounds = explored = None
     if packed:
         from .packed import check_batch_device_packed
         valid, overflow = check_batch_device_packed(
             events, frontier=frontier, n_slots=shape.n_slots)
+    elif with_stats:
+        valid, overflow, peak, rounds, explored = check_batch_device(
+            events, frontier=frontier, n_slots=shape.n_slots,
+            with_stats=True)
+        peak = np.asarray(peak)
+        rounds = np.asarray(rounds)
+        explored = np.asarray(explored)
     else:
         valid, overflow = check_batch_device(
             events, frontier=frontier, n_slots=shape.n_slots)
@@ -273,4 +324,13 @@ def check_encoded_batch(encs: list[EncodedRegisterHistory],
                         "analyzer": "tpu-jit",
                         "op-count": int(
                             (e.events[:, 0] == INVOKE_EV).sum())})
+        if with_stats:
+            stats_out.append({
+                "engine": "tpu-jit",
+                "frontier_peak": int(peak[i]),
+                "frontier": int(frontier),
+                "rounds": int(rounds[i]),
+                "configs": int(explored[i]),
+                "overflow": bool(overflow[i]),
+                "n_slots": int(shape.n_slots)})
     return out

@@ -429,7 +429,8 @@ def analyze_store(store: Store, checker: str = "append",
     from . import shm as _shm
     from . import supervisor as sv
     from .obs import device as device_obs
-    from .store import VerdictJournal, costdb_path
+    from .obs import search as search_obs
+    from .store import VerdictJournal, analytics_path, costdb_path
     if report is None:
         report = gates.get("JEPSEN_TPU_REPORT")
     if mesh is None:
@@ -446,6 +447,8 @@ def analyze_store(store: Store, checker: str = "append",
     # a fresh sweep must not inherit a previous sweep's records or
     # half-open dispatch windows (no-op-cheap; gate read at capture)
     device_obs.reset()
+    # so is the kernel search-telemetry ledger (JEPSEN_TPU_KERNEL_STATS)
+    search_obs.reset()
     if getattr(tr, "enabled", False) and store.base.is_dir():
         # point the worker trace fabric at the store: pool workers
         # spool spans to <spool_dir>/trace-<pid>.jsonl; stale spools
@@ -511,6 +514,20 @@ def analyze_store(store: Store, checker: str = "append",
                           file=sys.stderr)
             except Exception:
                 log.warning("costdb flush failed", exc_info=True)
+            # the analytics ledger follows the same contract: journal
+            # before reset_events so its flight-recorder mark lands;
+            # zero files with the gate off
+            try:
+                n_stats = search_obs.flush(
+                    analytics_path(store.base,
+                                   shard if mesh else None))
+                if n_stats:
+                    print(f"analytics: {n_stats} record(s) appended "
+                          f"to "
+                          f"{analytics_path(store.base, shard if mesh else None)}",
+                          file=sys.stderr)
+            except Exception:
+                log.warning("analytics flush failed", exc_info=True)
         obs.reset_events()
         if getattr(tr, "enabled", False) and store.base.is_dir():
             try:
@@ -549,6 +566,9 @@ def analyze_store(store: Store, checker: str = "append",
                             store.base, evs, tr.metrics_dict(),
                             device_records=(device_obs.records()
                                             if device_obs.enabled()
+                                            else None),
+                            search_records=(search_obs.records()
+                                            if search_obs.enabled()
                                             else None))
                         print(f"report written to {rj}",
                               file=sys.stderr)
@@ -686,6 +706,22 @@ def _analyze_store_impl(store: Store, checker: str = "append",
     # and on CPU-only hosts it doubles as the virtual-mesh dryrun.
     host_only = gates.get("JEPSEN_TPU_BACKEND") == "cpu"
 
+    # Kernel search telemetry (JEPSEN_TPU_KERNEL_STATS): dispatches
+    # additionally return per-history stats rows, recorded into the
+    # per-sweep ledger keyed by the SAME store-relative dir string the
+    # verdict journal uses. The host-oracle sweep runs no kernels, so
+    # it records nothing for the elle checkers.
+    from .obs import search as search_obs
+    want_stats = search_obs.enabled() and not host_only
+    _rel = journal.rel if journal is not None else str
+
+    def record_stats(d, checker_name: str, sd, cycles=None) -> None:
+        if sd is not None:
+            search_obs.record(
+                _rel(d), checker_name, sd,
+                anomalies=(cycles if isinstance(cycles, dict)
+                           else None))
+
     # Encodable histories get the batched device sweep; the rest fall
     # back to their own stored checker host-side. Ingest shards run
     # dirs across a process pool (ingest.py, SURVEY.md §5.7).
@@ -794,10 +830,16 @@ def _analyze_store_impl(store: Store, checker: str = "append",
                     dense.append(enc)
                     dense_map.append(d)
             if dense:
-                cycles_per = parallel.check_bucketed(dense, get_mesh())
-                for d, enc, cycles in zip(dense_map, dense, cycles_per):
+                souts: list | None = [] if want_stats else None
+                cycles_per = parallel.check_bucketed(
+                    dense, get_mesh(), stats_out=souts)
+                for i, (d, enc, cycles) in enumerate(
+                        zip(dense_map, dense, cycles_per)):
                     worst = max(worst, emit_append(d, enc, cycles))
+                    if souts is not None:
+                        record_stats(d, "append", souts[i], cycles)
         for d, enc in zip(huge_map, huge):
+            shuge: list | None = [] if want_stats else None
             try:
                 if host_only:
                     cycles = elle.cycle_anomalies_cpu(enc)
@@ -808,7 +850,8 @@ def _analyze_store_impl(store: Store, checker: str = "append",
                     # default_devices() (the dp batch mesh would be
                     # wrong for B=1 anyway)
                     cycles = parallel.check_long_history(
-                        enc, None, dense_limit=parallel.DENSE_TXN_LIMIT)
+                        enc, None, dense_limit=parallel.DENSE_TXN_LIMIT,
+                        stats_out=shuge)
             except Exception as e:
                 # one monster history must fail alone, not take the
                 # whole sweep's remaining verdicts with it
@@ -816,6 +859,8 @@ def _analyze_store_impl(store: Store, checker: str = "append",
                     d, e, "check", checker, journal=journal))
                 continue
             worst = max(worst, emit_append(d, enc, cycles))
+            if shuge:
+                record_stats(d, "append", shuge[0], cycles)
         for d in fallback:
             worst = max(worst, _stored_fallback(d, stored_check,
                                                 checker,
@@ -833,22 +878,25 @@ def _analyze_store_impl(store: Store, checker: str = "append",
                 if encodable(d, enc, fallback)]
         if not good:
             continue
+        wr_stats: list | None = [] if want_stats else None
         if host_only:
             cycles_per = [elle_wr.cycle_anomalies_cpu(e)
                           for _d, e in good]
         else:
             cycles_per = _wr_chunk_with_backdown(
-                good, elle_kernels, elle_wr)
+                good, elle_kernels, elle_wr, stats_out=wr_stats)
         # emit per chunk: verdicts persist incrementally (an
         # interrupted sweep --resumes from the last chunk, not from
         # zero) and encodings free as we go
-        for (d, enc), cycles in zip(good, cycles_per):
+        for i, ((d, enc), cycles) in enumerate(zip(good, cycles_per)):
             if hasattr(cycles, "verdict"):   # supervisor.Quarantined
                 worst = max(worst, emit(d, cycles.verdict("wr")))
                 continue
             res = elle_wr.render_wr_verdict(enc, cycles, prohibited)
             res["checker"] = "wr"       # --resume marker
             worst = max(worst, emit(d, res))
+            if wr_stats is not None and i < len(wr_stats):
+                record_stats(d, "wr", wr_stats[i], cycles)
 
     for d in fallback:
         worst = max(worst, _stored_fallback(d, stored_check, checker,
@@ -856,7 +904,8 @@ def _analyze_store_impl(store: Store, checker: str = "append",
     return worst
 
 
-def _wr_chunk_with_backdown(good, elle_kernels, elle_wr):
+def _wr_chunk_with_backdown(good, elle_kernels, elle_wr,
+                            stats_out: list | None = None):
     """One wr chunk's device dispatch with the supervisor's OOM and
     watchdog degradation: the bucketed batch first; on
     RESOURCE_EXHAUSTED (or a watchdog timeout) the chunk re-checks one
@@ -865,7 +914,11 @@ def _wr_chunk_with_backdown(good, elle_kernels, elle_wr):
     alone quarantines. Two CONSECUTIVE singleton watchdog timeouts mean
     the device is wedged, not the data: the chunk's remainder
     quarantines without re-probing. Other errors (and strict mode)
-    re-raise — fail-fast exactly as before."""
+    re-raise — fail-fast exactly as before.
+
+    `stats_out` (a list) is extended with one kernel-stats dict per
+    history in chunk order (None for quarantined histories) — only
+    on completion, so a re-raised failure leaves it untouched."""
     from . import supervisor
 
     def recoverable(e) -> bool:
@@ -875,8 +928,18 @@ def _wr_chunk_with_backdown(good, elle_kernels, elle_wr):
 
     edges = [elle_wr.to_edge_dict(e) for _d, e in good]
     tr = trace.get_current()
+    # the stats kwarg is passed ONLY when requested: the supervisor
+    # tests drive this ladder through duck-typed fake kernels whose
+    # stats-free signature must keep working
     try:
-        return elle_kernels.check_edge_batch_bucketed(edges)
+        if stats_out is not None:
+            batch_stats: list = []
+            res = elle_kernels.check_edge_batch_bucketed(
+                edges, stats_out=batch_stats)
+            stats_out.extend(batch_stats)
+        else:
+            res = elle_kernels.check_edge_batch_bucketed(edges)
+        return res
     except Exception as e:
         if not recoverable(e):
             raise
@@ -886,6 +949,7 @@ def _wr_chunk_with_backdown(good, elle_kernels, elle_wr):
             # bench's robustness block can tell the two causes apart
             tr.counter("oom_retries").inc()
     out = []
+    souts: list | None = [] if stats_out is not None else None
     wedged = 0
     for ed in edges:
         if wedged >= 2:
@@ -901,9 +965,18 @@ def _wr_chunk_with_backdown(good, elle_kernels, elle_wr):
             out.append(supervisor.Quarantined(
                 "watchdog", "device wedged: consecutive singleton "
                 "watchdog timeouts"))
+            if souts is not None:
+                souts.append(None)
             continue
         try:
-            out.append(elle_kernels.check_edge_batch_bucketed([ed])[0])
+            if souts is not None:
+                s1: list = []
+                out.append(elle_kernels.check_edge_batch_bucketed(
+                    [ed], stats_out=s1)[0])
+                souts.append(s1[0] if s1 else None)
+            else:
+                out.append(
+                    elle_kernels.check_edge_batch_bucketed([ed])[0])
             wedged = 0
         except Exception as e:
             if not recoverable(e):
@@ -919,6 +992,10 @@ def _wr_chunk_with_backdown(good, elle_kernels, elle_wr):
             obs.emit("quarantine", stage=stage, histories=1,
                      cause=repr(e)[:300])
             out.append(supervisor.Quarantined(stage, repr(e)))
+            if souts is not None:
+                souts.append(None)
+    if stats_out is not None:
+        stats_out.extend(souts)
     return out
 
 
@@ -1120,14 +1197,18 @@ def _analyze_store_register(store: Store, run_dirs: list,
             subs.append(by_key[k] if ks else hist)
             owners.append((i, k))
 
+    from .obs import search as search_obs
+    ksouts: list | None = [] if search_obs.enabled() else None
     try:
-        results = c.check_batch({}, subs, {}) if subs else []
+        results = c.check_batch({}, subs, {}, stats_out=ksouts) \
+            if subs else []
     except Exception:
         # one malformed run must not sink the sweep: re-dispatch each
         # subhistory in isolation, degrading only the broken ones
         log.warning("batched register sweep failed; isolating per key",
                     exc_info=True)
         results = []
+        ksouts = None   # isolation retries run telemetry-free
         for s in subs:
             try:
                 results.append(c.check_batch({}, [s], {})[0])
@@ -1135,8 +1216,12 @@ def _analyze_store_register(store: Store, run_dirs: list,
                 results.append({"valid?": "unknown",
                                 "error": repr(e)[:200]})
     per_run: dict[int, dict] = {}
-    for (i, k), res in zip(owners, results):
+    per_run_stats: dict[int, list] = {}
+    for j, ((i, k), res) in enumerate(zip(owners, results)):
         per_run.setdefault(i, {})[k] = res
+        if ksouts is not None:
+            per_run_stats.setdefault(i, []).append(
+                (k, len(subs[j]), ksouts[j]))
 
     worst = 0
     for i, d in enumerate(run_dirs):
@@ -1156,7 +1241,41 @@ def _analyze_store_register(store: Store, run_dirs: list,
                                   if r.get("valid?") is False)}
         worst = max(worst, _write_results(d, res, "register",
                                           journal=journal))
+        if ksouts is not None and i in per_run_stats:
+            rel = journal.rel if journal is not None else str
+            search_obs.record(
+                rel(d), "register",
+                _register_run_stats(per_run_stats[i]),
+                anomalies=res["failures"] or None)
     return worst
+
+
+def _register_run_stats(keyed: list) -> dict | None:
+    """One run's register-sweep search record: the per-key subhistory
+    sizes the native split produced (the WGL cost driver) plus the
+    engines' own counters aggregated across keys — summed where the
+    quantity is additive (configs, backtracks, rounds), maxed where it
+    is a peak (frontier width, depth)."""
+    sizes = [n for _k, n, _s in keyed]
+    stats = [s for _k, _n, s in keyed if isinstance(s, dict)]
+    if not sizes:
+        return None
+    out: dict = {
+        "keys": len(sizes),
+        "subhistory_ops": {"min": min(sizes), "max": max(sizes),
+                           "mean": round(sum(sizes) / len(sizes), 2)},
+        "engines": sorted({s.get("engine") for s in stats
+                           if s.get("engine")}),
+    }
+    for f in ("configs", "backtracks", "rounds"):
+        vals = [s[f] for s in stats if isinstance(s.get(f), int)]
+        if vals:
+            out[f] = sum(vals)
+    for f in ("frontier_peak", "max_depth"):
+        vals = [s[f] for s in stats if isinstance(s.get(f), int)]
+        if vals:
+            out[f] = max(vals)
+    return out
 
 
 def _json_safe(v):

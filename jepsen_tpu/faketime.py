@@ -29,9 +29,13 @@ def install(test: dict | None = None, node: str | None = None) -> None:
     src = os.path.join(NATIVE_DIR, "faketime_shim.cc")
     sess.upload(src, "/tmp/faketime_shim.cc")
     su.exec("mv", "/tmp/faketime_shim.cc", f"{SHIM_DIR}/faketime_shim.cc")
+    # -pthread: the shim calls pthread_once, and a preloaded .so that
+    # leaves the reference undefined breaks any host binary that does
+    # not itself link libpthread (glibc's `date` on current distros:
+    # "symbol lookup error: undefined symbol: pthread_once")
     su.exec(control.Lit(
-        f"g++ -O2 -fPIC -shared -o {SHIM_SO} {SHIM_DIR}/faketime_shim.cc "
-        f"-ldl"))
+        f"g++ -O2 -fPIC -shared -pthread -o {SHIM_SO} "
+        f"{SHIM_DIR}/faketime_shim.cc -ldl"))
 
 
 def script(cmd: str, init_offset: float, rate: float) -> str:

@@ -180,6 +180,19 @@ _g("JEPSEN_TPU_RESIDENCY_INTERVAL_S", "float", 5.0,
    "`hbm_device_bytes` residency gauge (the cheap gauges still "
    "publish per dispatch); `<=0` disables the poll; only read when "
    "`JEPSEN_TPU_COSTDB` is on")
+_g("JEPSEN_TPU_KERNEL_STATS", "bool", False,
+   "set: kernel search telemetry — checker dispatches additionally "
+   "return a per-history graph/search stats vector (edge counts, "
+   "closure rounds, SCC shape, decision-boundary margin; WGL "
+   "frontier/backtrack counters), journaled to "
+   "`<store>/analytics.jsonl` and aggregated into the report's "
+   "\"search\" section; off (the default) leaves verdicts, files and "
+   "executables byte-identical at <1µs per dispatch")
+_g("JEPSEN_TPU_KERNEL_STATS_SAMPLE", "int", 1,
+   "journal every Nth history's stats line into `analytics.jsonl` "
+   "(in-memory aggregates and the report still cover every history); "
+   "`1` (the default) journals all; only read when "
+   "`JEPSEN_TPU_KERNEL_STATS` is on")
 # -- kernels / backend ------------------------------------------------------
 _g("JEPSEN_TPU_BACKEND", "str", None,
    "analysis backend override: `tpu`|`cpu`|`race` (the CLI's "

@@ -277,6 +277,19 @@ STORE_ARTIFACTS: tuple[StoreArtifact, ...] = (
             "shards append `costdb-shard<k>.jsonl`, the coordinator "
             "replaces the merged `costdb.jsonl` atomically"),
     StoreArtifact(
+        "analytics ledger", ("analytics*.jsonl",), "journal",
+        writers=("jepsen_tpu/store.py:append_analytics",
+                 "jepsen_tpu/mesh.py:merge_analytics"),
+        readers=("jepsen_tpu/store.py:load_analytics",),
+        retention="merged",
+        helpers=("analytics_path",),
+        doc="kernel search telemetry (JEPSEN_TPU_KERNEL_STATS): one "
+            "stats line per checked history (edge counts, closure "
+            "rounds, SCC shape, decision-boundary margin); mesh "
+            "shards append `analytics-shard<k>.jsonl`, the "
+            "coordinator replaces the merged `analytics.jsonl` "
+            "atomically"),
+    StoreArtifact(
         # jt-lint: ok JT-TRACE-004 (the registry's declared pattern, not an ad-hoc spool writer)
         "worker trace spool", ("trace-*.jsonl",), "spool",
         writers=("jepsen_tpu/trace.py:ensure_worker_tracer",

@@ -240,6 +240,7 @@ def coordinator_merge(store, checker: str, shard: int, n_shards: int,
             "incomplete_shards": incomplete,
             "valid?": worst == 0}))
         cost_records: list = []
+        search_records: list = []
         if Path(store.base).is_dir():
             # evidence-driven like the trace merge: shard costdbs
             # exist iff the shards ran with JEPSEN_TPU_COSTDB — merge
@@ -250,6 +251,14 @@ def coordinator_merge(store, checker: str, shard: int, n_shards: int,
                 cost_records = merge_costdbs(store.base, n_shards)
             except Exception:
                 log.warning("mesh costdb merge failed", exc_info=True)
+            # same evidence rule for the kernel-stats ledger: shard
+            # analytics exist iff the shards ran with
+            # JEPSEN_TPU_KERNEL_STATS
+            try:
+                search_records = merge_analytics(store.base, n_shards)
+            except Exception:
+                log.warning("mesh analytics merge failed",
+                            exc_info=True)
         if tracer is not None and getattr(tracer, "enabled", False) \
                 and Path(store.base).is_dir():
             try:
@@ -257,7 +266,8 @@ def coordinator_merge(store, checker: str, shard: int, n_shards: int,
                     store.base, n_shards, report,
                     fleet_complete=not (lost or crashed or incomplete
                                         or unaccounted),
-                    device_records=cost_records)
+                    device_records=cost_records,
+                    search_records=search_records)
             except Exception:
                 log.warning("mesh trace merge failed", exc_info=True)
         return worst
@@ -289,9 +299,36 @@ def merge_costdbs(store_base, n_shards: int) -> list[dict]:
     return merged
 
 
+def merge_analytics(store_base, n_shards: int) -> list[dict]:
+    """Fold every present per-shard `analytics-shard<k>.jsonl` into
+    one `<store>/analytics.jsonl`. Shards partition the run dirs, so
+    records can't collide across files; within one file, the last
+    record per (dir, checker) wins (the resume semantics — a
+    re-swept history's fresher stats replace its older line). The
+    merged file is a derived artifact written atomically: a repeat
+    merge replaces, never doubles. Returns the merged records ([]
+    when no shard captured any — gate off)."""
+    from . import trace as _trace
+    from .store import ANALYTICS_NAME, analytics_path, load_analytics
+    merged: dict[tuple, dict] = {}
+    for k in range(n_shards):
+        for rec in load_analytics(analytics_path(store_base, k)):
+            merged[(rec.get("dir"), rec.get("checker"))] = rec
+    if not merged:
+        return []
+    out = list(merged.values())
+    _trace.atomic_write_text(
+        Path(store_base) / ANALYTICS_NAME,
+        "".join(json.dumps(r) + "\n" for r in out))
+    print(f"merged analytics: {len(out)} record(s) across "
+          f"{n_shards} shard(s)", file=sys.stderr)
+    return out
+
+
 def _merge_trace_artifacts(store_base, n_shards: int, report: bool,
                            fleet_complete: bool = True,
-                           device_records: list | None = None) -> None:
+                           device_records: list | None = None,
+                           search_records: list | None = None) -> None:
     """trace.json / metrics.json / report.{json,md} from the per-shard
     exports (a lost shard's missing files are skipped, not fatal).
     `device_records` is the ALREADY-merged costdb set the coordinator
@@ -313,7 +350,8 @@ def _merge_trace_artifacts(store_base, n_shards: int, report: bool,
         from .obs import attribution
         rj, _md = attribution.write_report(
             store_base, evs, metrics, per_shard_events=per_shard,
-            device_records=device_records or None)
+            device_records=device_records or None,
+            search_records=search_records or None)
         print(f"merged mesh report written to {rj}", file=sys.stderr)
     # every shard's spans now live in its trace-shard<k>.json export —
     # but ONLY when the whole fleet is accounted for: a lost/crashed/

@@ -305,6 +305,10 @@ def render_report_md(rep: dict) -> str:
     dev = rep.get("device") or {}
     if dev:
         lines += render_device_md(dev)
+    search_sec = rep.get("search") or {}
+    if search_sec:
+        from . import search as search_mod
+        lines += search_mod.render_search_md(search_sec)
     lines += ["", "## What-if", "", f"- {summary_line(rep)}"]
     if rep.get("counters"):
         keep = ("runs_verdicted", "buckets_dispatched", "cache_hits",
@@ -411,7 +415,8 @@ def analyze_shards(per_shard_events: dict) -> dict:
 
 def write_report(store_base, events: list, metrics: dict | None = None,
                  window_us=None, per_shard_events: dict | None = None,
-                 device_records: list | None = None):
+                 device_records: list | None = None,
+                 search_records: list | None = None):
     """Write `<store>/report.json` + `report.md` (atomically — the
     journal discipline) and return their paths. With
     `per_shard_events` ({shard: event list} — a mesh sweep's
@@ -422,7 +427,10 @@ def write_report(store_base, events: list, metrics: dict | None = None,
     merged across shards by the coordinator) it carries the `device`
     roofline section: per-(executable, geometry) achieved-vs-peak
     FLOPs and bandwidth from captured `cost_analysis()` joined with
-    the measured dispatch windows."""
+    the measured dispatch windows. With `search_records` (the kernel
+    search-telemetry ledger, JEPSEN_TPU_KERNEL_STATS) it carries the
+    `search` section: anomaly-rate and margin distributions plus the
+    edge-density-vs-device-time join against the costdb."""
     base = Path(store_base)
     rep = analyze(events, window_us=window_us,
                   counters=(metrics or {}).get("counters"))
@@ -433,6 +441,12 @@ def write_report(store_base, events: list, metrics: dict | None = None,
         dev = device_section(device_records)
         if dev is not None:
             rep["device"] = dev
+    if search_records:
+        from . import search as search_mod
+        sec = search_mod.search_section(search_records,
+                                        cost_records=device_records)
+        if sec is not None:
+            rep["search"] = sec
     jp = trace.atomic_write_text(base / "report.json",
                                  json.dumps(rep, indent=2))
     mp = trace.atomic_write_text(base / "report.md",

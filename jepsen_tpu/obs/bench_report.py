@@ -47,10 +47,12 @@ class MetricSpec:
     # cannot legitimately exceed it (an achieved-bandwidth share past
     # ~1 means the byte model is wrong, not that the chip got faster)
     ceiling: float | None = None
-    # absolute floor (higher-is-better metrics only): the latest value
-    # falling below it regresses even with no predecessor — the
-    # mesh scaling-efficiency contract ("2 shards must buy ≥1.4x")
-    # holds from the first round that reports it
+    # absolute floor: the latest value falling below it regresses even
+    # with no predecessor — the mesh scaling-efficiency contract
+    # ("2 shards must buy ≥1.4x") holds from the first round that
+    # reports it. Also usable WITH a ceiling to pin a deterministic
+    # value from both sides (the seeded search anomaly rate: a
+    # collapse to 0 must not read as an improvement).
     floor: float | None = None
 
 
@@ -136,6 +138,24 @@ METRICS: tuple[MetricSpec, ...] = (
     MetricSpec("ns_bw_share", "north-star achieved-bandwidth share",
                ("north_star", "device_cost", "achieved_bw_share"),
                True, 0.30, ceiling=1.05),
+    # the kernel search-telemetry block: the seeded anomaly rate is
+    # DETERMINISTIC (every 4th synthetic history carries a G1c), so
+    # the gate pins BOTH directions from the first reporting round —
+    # ceiling 0.30 catches false positives, floor 0.20 catches the
+    # kernels going blind (a collapse to 0 must not read as an
+    # improvement; the seeded truth is 0.25). verdict parity is a
+    # hard floor-1.0 contract: stats changing a single verdict fails
+    # the round outright. The stats dispatch's wall overhead vs the
+    # stats-free kernel is bounded too: telemetry creeping past ~2x
+    # the plain closure would defeat the always-on ambition.
+    MetricSpec("search_anomaly_rate", "search seeded anomaly rate",
+               ("search", "anomaly_rate"), False, 0.0,
+               ceiling=0.30, floor=0.20),
+    MetricSpec("search_parity", "search verdict parity",
+               ("search", "parity_ok"), True, 0.0, floor=1.0),
+    MetricSpec("search_overhead_x", "kernel-stats overhead (x)",
+               ("search", "stats_overhead_x"), False, 0.50,
+               ceiling=3.0),
 )
 
 

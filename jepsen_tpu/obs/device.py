@@ -113,10 +113,13 @@ def dispatch_cost_key(kw: dict, shape, single_device: bool,
         return ExecutableResidency.dispatch_key(kw, shape, donate)
     from ..checker.elle import kernels as K
     use_pallas, use_int8 = K.resolve_formulation(single_device=False)
+    # the kernel-stats marker is appended only when on, mirroring
+    # ExecutableResidency.dispatch_key: the gate-off key never churns
     return (kw.get("classify", True), kw.get("realtime", False),
             kw.get("process_order", False), kw.get("fused"),
             use_pallas, use_int8, bool(donate),
-            shape.n_keys, shape.max_pos, shape.n_txns)
+            shape.n_keys, shape.max_pos, shape.n_txns) \
+        + (("stats",) if kw.get("with_stats") else ())
 
 
 def _cost_dict(obj) -> dict | None:

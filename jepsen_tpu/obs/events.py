@@ -58,6 +58,8 @@ EVENT_KINDS = frozenset({
     #                       re-assignable via JEPSEN_TPU_MESH_SHARD)
     "costdb_flush",       # path, records (device cost observatory
     #                       appended its per-executable records)
+    "analytics_flush",    # path, records (kernel search telemetry
+    #                       appended its per-history stats lines)
     "events_rotated",     # rotated_to, size (the log hit
     #                       JEPSEN_TPU_EVENTS_MAX_BYTES and was
     #                       renamed aside; first line of the new log)

@@ -41,6 +41,10 @@ def main() -> int:
     # residency gauges on /metrics + health.json, the report's device
     # section and the costdb contract
     gates.export("JEPSEN_TPU_COSTDB", 1)
+    # kernel search telemetry: the assertions below pin the analytics
+    # ledger, the report "search" section, and the kernel.* series on
+    # a live sweep
+    gates.export("JEPSEN_TPU_KERNEL_STATS", 1)
 
     root = Path(tempfile.mkdtemp(prefix="obs-smoke-"))
     try:
@@ -175,6 +179,31 @@ def main() -> int:
                 (store.base / "report.md").read_text():
             print("obs-smoke: report.md has no device roofline "
                   "section")
+            return 1
+        # -- kernel search telemetry contract --------------------------
+        from ..store import load_analytics
+        stats_recs = load_analytics(store.base)
+        if len(stats_recs) != 3:
+            print(f"obs-smoke: analytics.jsonl has {len(stats_recs)} "
+                  "record(s), expected 3 (one per run)")
+            return 1
+        if any("margin" not in r or "closure_rounds" not in r
+               for r in stats_recs):
+            print(f"obs-smoke: analytics record missing stat fields: "
+                  f"{stats_recs[:1]}")
+            return 1
+        if "search" not in rep or rep["search"].get("histories") != 3:
+            print(f"obs-smoke: report.json search section missing or "
+                  f"wrong: {rep.get('search')}")
+            return 1
+        if "Search telemetry" not in \
+                (store.base / "report.md").read_text():
+            print("obs-smoke: report.md has no search section")
+            return 1
+        if not any(ln.startswith("jepsen_tpu_kernel_stats_records ")
+                   for ln in page_lines):
+            print("obs-smoke: kernel.stats_records missing from "
+                  "/metrics render")
             return 1
         print("obs-smoke: OK — health.json "
               f"(seq {health['heartbeat']['seq']}), /metrics scraped "

@@ -114,7 +114,8 @@ def sharded_check_fn(mesh: Mesh | None, shape: K.BatchShape, *,
                      use_pallas: bool | None = None,
                      use_int8: bool | None = None,
                      fused: bool | None = None,
-                     donate: bool = False):
+                     donate: bool = False,
+                     with_stats: bool = False):
     """Build a jitted batched checker around kernels.check_batched_impl.
     With a mesh, inputs are expected sharded over 'dp' and the closure
     matrices are constrained to P('dp', None, 'mp'); without one, it's
@@ -150,7 +151,7 @@ def sharded_check_fn(mesh: Mesh | None, shape: K.BatchShape, *,
     donate = bool(donate) and mesh is None
     return _sharded_check_fn_cached(mesh, shape, classify, realtime,
                                     process_order, use_pallas, use_int8,
-                                    fused, donate)
+                                    fused, donate, bool(with_stats))
 
 
 # Executable residency + donated-slot ownership live in
@@ -170,7 +171,8 @@ def _sharded_check_fn_cached(mesh: Mesh | None, shape: K.BatchShape,
                              use_pallas: bool = False,
                              use_int8: bool = False,
                              fused: bool = False,
-                             donate: bool = False):
+                             donate: bool = False,
+                             with_stats: bool = False):
     if mesh is not None:
         spec = P("dp", None, "mp")
 
@@ -186,7 +188,7 @@ def _sharded_check_fn_cached(mesh: Mesh | None, shape: K.BatchShape,
         n_txns=shape.n_txns, steps=K.closure_steps(shape.n_txns),
         classify=classify, realtime=realtime, process_order=process_order,
         constrain=constrain, use_pallas=use_pallas, use_int8=use_int8,
-        fused=fused)
+        fused=fused, with_stats=with_stats)
     if mesh is None:
         if donate:
             # donated inputs: XLA reuses the six packed tensors' HBM
@@ -275,7 +277,8 @@ DENSE_TXN_LIMIT = 32_768
 def check_long_history(enc, mesh: Mesh | None = None, *,
                        classify: bool = True, realtime: bool = False,
                        process_order: bool = False,
-                       dense_limit: int = DENSE_TXN_LIMIT) -> dict:
+                       dense_limit: int = DENSE_TXN_LIMIT,
+                       stats_out: list | None = None) -> dict:
     """Check ONE long encoded history; returns {anomaly: True} flags.
 
     Up to `dense_limit` txns: the dense closure with the op axis
@@ -283,27 +286,39 @@ def check_long_history(enc, mesh: Mesh | None = None, *,
     SCC condensation (vectorized edge build + native Tarjan) feeding
     the device classification kernel per nontrivial SCC — the 100k-op
     path (BASELINE config #5), exact by SCC-locality of every anomaly
-    query (elle/condense.py module doc)."""
+    query (elle/condense.py module doc).
+
+    `stats_out` (a list) gains one stats dict for the history —
+    device-computed on the dense path, host-derived (edge/SCC facts
+    from the condensation's own Tarjan, no closure telemetry) past
+    the dense limit."""
     if enc.n > dense_limit:
         from ..checker.elle import condense
         return condense.check_condensed(
             enc, classify=classify, realtime=realtime,
             process_order=process_order,
             devices=(list(mesh.devices.flat) if mesh is not None
-                     else None))
+                     else None), stats_out=stats_out)
     mesh = mesh if mesh is not None else sp_mesh()
     shape = K.BatchShape.plan([enc])
     packed = K.pack_batch([enc], shape)
+    with_stats = stats_out is not None
     fn = sharded_check_fn(mesh, shape, classify=classify,
-                          realtime=realtime, process_order=process_order)
+                          realtime=realtime, process_order=process_order,
+                          with_stats=with_stats)
     args = shard_batch(mesh, packed)
-    pending = fn(*args)
+    out = fn(*args)
+    pending, dev_stats = out if with_stats else (out, None)
     # window opens AFTER the enqueue returns (first-call compile is
     # host time, not device time — same contract as the bucket path)
     t_disp = time.perf_counter()
     flags = np.asarray(_block_flags(pending, trace.get_current()))
     trace.get_current().device_complete("long-history", t_disp,
                                         txns=enc.n)
+    if with_stats:
+        stats_out.append(K.stats_row(np.asarray(dev_stats)[0],
+                                     n_txns=enc.n,
+                                     t_pad=shape.n_txns))
     return K.flags_to_names(int(flags[0]))
 
 
@@ -374,16 +389,20 @@ class PendingVerdicts:
     def __init__(self, n: int, parts: list, finish=None):
         self._n = n
         # [(bucket indices, flags, dispatch-enqueue time|None,
-        #   donated)] — flags is a live device array, or (already
-        # resolved) a list of per-history flag words / Quarantined
-        # aligned with indices; `donated` marks a dispatch holding a
-        # device-slot ledger entry the finish closure must release
+        #   donated, smeta)] — flags is a live device array, or
+        # (already resolved) a list of per-history flag words /
+        # (word, stats-dict) pairs / Quarantined aligned with indices;
+        # `donated` marks a dispatch holding a device-slot ledger
+        # entry the finish closure must release; `smeta` is None or
+        # (device stats matrix, BatchShape) for a kernel-stats
+        # dispatch (JEPSEN_TPU_KERNEL_STATS)
         self._parts = parts
         # finish(idx, device_flags) -> resolved list: the dispatcher's
         # watchdog + OOM-backdown closure; None (bare construction)
         # blocks plainly with no recovery.
         self._finish = finish
         self._result: list | None = None
+        self._stats: list = [None] * n
 
     def is_ready(self) -> bool:
         """True when every bucket's flags have materialized (no block):
@@ -391,7 +410,15 @@ class PendingVerdicts:
         whose flags are already ready before the next host stall must
         not count that stall as pipeline overlap."""
         return all(getattr(f, "is_ready", lambda: True)()
-                   for _, f, _, _ in self._parts)
+                   for _, f, _, _, _ in self._parts)
+
+    def stats(self) -> list:
+        """Per-history `kernels.stats_row` dicts aligned with the
+        verdict list (None for histories whose dispatch carried no
+        stats: gate off, quarantined, or resolved through the OOM/
+        watchdog backdown whose retries run stats-free). Only
+        populated after `.result()`."""
+        return self._stats
 
     def result(self, phases: dict | None = None) -> list[dict]:
         # Idempotent: callers can observe readiness and collect from
@@ -404,13 +431,14 @@ class PendingVerdicts:
         t0 = time.perf_counter()
         tr = trace.get_current()
         out: list[dict | None] = [None] * self._n
-        for idx, flags, t_disp, donated in self._parts:
+        for idx, flags, t_disp, donated, smeta in self._parts:
             if not isinstance(flags, list):
                 if self._finish is not None:
                     # the finish closure owns the device window (logged
                     # on its success path only — a recovered bucket's
                     # device time is the backdown's own windows)
-                    flags = self._finish(idx, flags, t_disp, donated)
+                    flags = self._finish(idx, flags, t_disp, donated,
+                                         smeta)
                 else:
                     arr = np.asarray(jax.block_until_ready(flags))
                     # padded replicas (flags beyond the bucket's own
@@ -422,6 +450,8 @@ class PendingVerdicts:
                     tr.device_complete("bucket", t_disp,
                                        histories=len(idx))
             for i, w in zip(idx, flags):
+                if isinstance(w, tuple):
+                    w, self._stats[i] = w
                 out[i] = (w if isinstance(w, sv.Quarantined)
                           else K.flags_to_names(int(w)))
         self._parts = []
@@ -596,7 +626,12 @@ def _sync_check(encs, idx: list, mesh, budget_cells: int, kw: dict,
     caller owns the split/quarantine policy. Donation here is
     self-contained: the slot acquired for this retry releases in the
     finally, whatever the outcome — backdown recursion holds only its
-    own halves' slots, never an ancestor's."""
+    own halves' slots, never an ancestor's. Retries run stats-free
+    (kernel-stats is observability; a re-planned bucket keeps its
+    verdicts and drops its telemetry rather than re-keying the
+    recovery executable)."""
+    if kw.get("with_stats"):
+        kw = {**kw, "with_stats": False}
     dp = mesh.devices.shape[0] if mesh is not None else 1
     bucket, bucket_mesh, shape, args = _h2d_bucket(
         _prep_bucket(encs, idx, mesh, dp, budget_cells, tr, phases),
@@ -669,7 +704,7 @@ def _oom_backdown(encs, idx: list, mesh, budget_cells: int, kw: dict,
 
 def _finish_part(encs, idx: list, flags, mesh, budget_cells: int,
                  kw: dict, tr, phases, t_disp=None,
-                 donated: bool = False) -> list:
+                 donated: bool = False, smeta=None) -> list:
     """Resolve one dispatched bucket to per-history flag words (padded
     replicas dropped), recovering from OOM (backdown) and watchdog
     timeouts (quarantine) unless strict. The dispatch->materialized
@@ -679,14 +714,26 @@ def _finish_part(encs, idx: list, flags, mesh, budget_cells: int,
     recovery (which would double-count the device track). A donated
     dispatch's ledger slot releases the moment its fate is decided —
     in particular BEFORE an OOM backdown re-plans, so a split bucket
-    drops its original slot and the halves acquire their own."""
+    drops its original slot and the halves acquire their own.
+
+    `smeta` ((device stats, BatchShape) — a kernel-stats dispatch)
+    resolves to (word, stats-dict) pairs instead of bare words; the
+    recovery paths resolve stats-free (a quarantined or re-planned
+    history yields verdict evidence only)."""
     try:
         arr = np.asarray(_block_flags(flags, tr))
         if donated:
             _slots.release()
         tr.device_complete("bucket", t_disp, histories=len(idx))
         obs_device.close_dispatch(flags, t_disp, len(idx), tr)
-        return [int(w) for w in arr[:len(idx)]]
+        words = [int(w) for w in arr[:len(idx)]]
+        if smeta is not None:
+            rows = np.asarray(smeta[0])
+            t_pad = smeta[1].n_txns
+            return [(w, K.stats_row(rows[j], n_txns=_size_of(encs[i]),
+                                    t_pad=t_pad))
+                    for j, (i, w) in enumerate(zip(idx, words))]
+        return words
     except BaseException as e:
         # the abandoned dispatch's cost window is discarded, never
         # recorded: a recovered bucket's device time is the backdown's
@@ -708,7 +755,8 @@ def check_bucketed_async(encs: Sequence, mesh: Mesh | None = None, *,
                          budget_cells: int = 1 << 27,
                          fused: bool | None = None,
                          max_inflight: int = 2,
-                         phases: dict | None = None) -> PendingVerdicts:
+                         phases: dict | None = None,
+                         with_stats: bool = False) -> PendingVerdicts:
     """Dispatch a bucketed sweep WITHOUT blocking on the device: every
     bucket is packed, transferred and queued (JAX dispatch is async),
     and the returned PendingVerdicts resolves the flags later. This is
@@ -763,7 +811,8 @@ def check_bucketed_async(encs: Sequence, mesh: Mesh | None = None, *,
     dp = mesh.devices.shape[0] if mesh is not None else 1
     tr = trace.get_current()
     kw = dict(classify=classify, realtime=realtime,
-              process_order=process_order, fused=fused)
+              process_order=process_order, fused=fused,
+              with_stats=bool(with_stats))
     t0 = time.perf_counter()
     eff_budget = max(1, budget_cells // depth)
     buckets = bucket_by_length(encs, budget_cells=eff_budget, dp=dp)
@@ -776,9 +825,9 @@ def check_bucketed_async(encs: Sequence, mesh: Mesh | None = None, *,
                if _est_cells(encs, b, dp) <= eff_budget]
     _acc_phase(phases, "pack", t0)
 
-    def finish(idx, flags, t_disp=None, donated=False):
+    def finish(idx, flags, t_disp=None, donated=False, smeta=None):
         out = _finish_part(encs, idx, flags, mesh, eff_budget, kw,
-                           tr, phases, t_disp, donated)
+                           tr, phases, t_disp, donated, smeta)
         # dispatched-vs-resolved parity for the live health snapshot:
         # exactly the buckets `buckets_dispatched` counted resolve
         # through here (sync-resolved OOM paths were never dispatched)
@@ -788,9 +837,9 @@ def check_bucketed_async(encs: Sequence, mesh: Mesh | None = None, *,
     def resolve_oldest():
         j = inflight.pop(0)
         t0 = time.perf_counter()
-        idx, flags, t_disp, donated = parts[j]
-        parts[j] = (idx, finish(idx, flags, t_disp, donated), None,
-                    False)
+        idx, flags, t_disp, donated, smeta = parts[j]
+        parts[j] = (idx, finish(idx, flags, t_disp, donated, smeta),
+                    None, False, None)
         tr.gauge("inflight_depth").set(len(inflight))
         _acc_phase(phases, "collect", t0)
 
@@ -804,10 +853,17 @@ def check_bucketed_async(encs: Sequence, mesh: Mesh | None = None, *,
         fn = _dispatch_fn(bucket_mesh, shape, kw, args, donate)
         try:
             sv.maybe_inject_oom()
-            flags = fn(*args)
+            out = fn(*args)
+            # a kernel-stats dispatch returns (flags, stats); the
+            # flags array stays the dispatch's identity (device
+            # windows, cost observatory) and the stats ride as smeta
+            flags, dev_stats = out if isinstance(out, tuple) \
+                else (out, None)
             if donate:
                 _note_donation(tr, args)
-            parts.append((bucket, flags, time.perf_counter(), donate))
+            parts.append((bucket, flags, time.perf_counter(), donate,
+                          (dev_stats, shape) if dev_stats is not None
+                          else None))
             obs_device.begin_dispatch(flags, kw, shape,
                                       bucket_mesh is None, donate,
                                       args, tr)
@@ -817,7 +873,7 @@ def check_bucketed_async(encs: Sequence, mesh: Mesh | None = None, *,
             _acc_phase(phases, "dispatch", t0)
             parts.append((bucket, _oom_backdown(
                 encs, bucket, mesh, eff_budget, kw, tr, phases, e),
-                None, False))
+                None, False, None))
             return False
         inflight.append(len(parts) - 1)
         tr.counter("buckets_dispatched").inc()
@@ -838,11 +894,11 @@ def check_bucketed_async(encs: Sequence, mesh: Mesh | None = None, *,
         if sv.is_oom_error(e):
             parts.append((bucket, _oom_backdown(
                 encs, bucket, mesh, eff_budget, kw, tr, phases, e),
-                None, False))
+                None, False, None))
         else:
             parts.append((bucket,
                           _quarantine_bucket(bucket, "pack", e, tr),
-                          None, False))
+                          None, False, None))
 
     _FAILED = object()
 
@@ -955,7 +1011,8 @@ def check_bucketed(encs: Sequence, mesh: Mesh | None = None, *,
                    budget_cells: int = 1 << 27,
                    two_pass: bool | None = None,
                    fused: bool | None = None,
-                   phases: dict | None = None) -> list[dict]:
+                   phases: dict | None = None,
+                   stats_out: list | None = None) -> list[dict]:
     """Check many encoded histories bucketed by length: one device
     dispatch per bucket, results returned in input order.
 
@@ -978,7 +1035,14 @@ def check_bucketed(encs: Sequence, mesh: Mesh | None = None, *,
     JEPSEN_TPU_FUSED_CLASSIFY=0) sweeps every bucket in detect mode and
     re-dispatches ONLY flagged histories with the chained classification
     closures. Verdicts are identical on every strategy because a
-    cycle-free graph classifies to zero flags."""
+    cycle-free graph classifies to zero flags.
+
+    `stats_out` (a list) is EXTENDED with one `kernels.stats_row` dict
+    per input history — the kernel-stats telemetry path
+    (JEPSEN_TPU_KERNEL_STATS); entries are None for quarantined or
+    backdown-recovered histories. On the two-pass strategy the stats
+    come from the DETECT pass (the from-scratch full closure — the
+    uniform definition); the classify re-dispatch runs stats-free."""
     if not len(encs):
         return []
     if fused is None:
@@ -989,7 +1053,8 @@ def check_bucketed(encs: Sequence, mesh: Mesh | None = None, *,
         detect = check_bucketed(encs, mesh, classify=False,
                                 realtime=realtime,
                                 process_order=process_order,
-                                budget_cells=budget_cells, phases=phases)
+                                budget_cells=budget_cells, phases=phases,
+                                stats_out=stats_out)
         # quarantined sentinels pass straight through: there is
         # nothing to classify for a history the supervisor abandoned
         flagged = [i for i, f in enumerate(detect)
@@ -1007,7 +1072,12 @@ def check_bucketed(encs: Sequence, mesh: Mesh | None = None, *,
         for i, r in zip(flagged, full):
             out[i] = r
         return out
-    return check_bucketed_async(
+    pv = check_bucketed_async(
         encs, mesh, classify=classify, realtime=realtime,
         process_order=process_order, budget_cells=budget_cells,
-        fused=fused, phases=phases).result(phases)
+        fused=fused, phases=phases,
+        with_stats=stats_out is not None)
+    res = pv.result(phases)
+    if stats_out is not None:
+        stats_out.extend(pv.stats())
+    return res

@@ -158,12 +158,19 @@ class FoldDispatcher:
         from .. import gates
         return gates.get("JEPSEN_TPU_BACKEND") == "cpu"
 
-    def verdicts(self, encs: list, checker: str = "append") -> list[dict]:
+    def verdicts(self, encs: list, checker: str = "append",
+                 stats_out: list | None = None) -> list[dict]:
         """Per-history verdict dicts for one fold, aligned with
         `encs`. Entries that are Exceptions (a failed encode riding
-        the queue) quarantine individually at the `encode` stage."""
+        the queue) quarantine individually at the `encode` stage.
+        `stats_out` (a list, JEPSEN_TPU_KERNEL_STATS) is extended with
+        one kernel-stats dict per history, aligned with the verdicts
+        (None for quarantined/failed ones) — the serve daemon attaches
+        them to reply frames BESIDE the result, so streamed verdicts
+        stay byte-identical to the post-hoc sweep's."""
         from .. import supervisor as sv
         out: list = [None] * len(encs)
+        stats: list = [None] * len(encs)
         good_idx = [i for i, e in enumerate(encs)
                     if not isinstance(e, Exception)]
         for i, e in enumerate(encs):
@@ -171,24 +178,33 @@ class FoldDispatcher:
                 out[i] = sv.quarantine_verdict(e, "encode", checker)
         good = [encs[i] for i in good_idx]
         if good:
+            gs: list | None = [] if stats_out is not None else None
             try:
-                rendered = self._check(good, checker)
+                rendered = self._check(good, checker, stats_out=gs)
             except Exception as e:
                 log.warning("fold dispatch failed; quarantining %d "
                             "histories", len(good), exc_info=True)
                 rendered = [sv.quarantine_verdict(e, "dispatch",
                                                   checker)
                             for _ in good]
-            for i, res in zip(good_idx, rendered):
+                gs = None
+            for j, (i, res) in enumerate(zip(good_idx, rendered)):
                 out[i] = res
+                if gs is not None and j < len(gs):
+                    stats[i] = gs[j]
+        if stats_out is not None:
+            stats_out.extend(stats)
         return out
 
-    def _check(self, encs: list, checker: str) -> list[dict]:
+    def _check(self, encs: list, checker: str,
+               stats_out: list | None = None) -> list[dict]:
         from .. import parallel, supervisor as sv
         from ..checker import elle
         from ..checker.elle import kernels as elle_kernels
         from ..checker.elle import wr as elle_wr
         host_only = self._host_only()
+        want_stats = stats_out is not None and not host_only
+        fold_stats: list = [None] * len(encs)
         if checker == "append":
             prohibited = elle.AppendChecker().prohibited
             if host_only:
@@ -204,19 +220,26 @@ class FoldDispatcher:
                 dense = [i for i, e in enumerate(encs)
                          if e.n <= parallel.DENSE_TXN_LIMIT]
                 if dense:
+                    ds: list | None = [] if want_stats else None
                     got = parallel.check_bucketed(
                         [encs[i] for i in dense], self.mesh,
                         budget_cells=self.budget_cells,
-                        phases=self.phases)
-                    for i, cy in zip(dense, got):
+                        phases=self.phases, stats_out=ds)
+                    for j, (i, cy) in enumerate(zip(dense, got)):
                         cycles_per[i] = cy
+                        if ds is not None:
+                            fold_stats[i] = ds[j]
                 for i, e in enumerate(encs):
                     if e.n <= parallel.DENSE_TXN_LIMIT:
                         continue
+                    hs: list | None = [] if want_stats else None
                     try:
                         cycles_per[i] = parallel.check_long_history(
                             e, None,
-                            dense_limit=parallel.DENSE_TXN_LIMIT)
+                            dense_limit=parallel.DENSE_TXN_LIMIT,
+                            stats_out=hs)
+                        if hs:
+                            fold_stats[i] = hs[0]
                     except Exception as err:
                         # one monster history fails alone (the cli
                         # huge-path contract)
@@ -230,6 +253,8 @@ class FoldDispatcher:
                 res = elle.render_verdict(enc, cycles, prohibited)
                 res["checker"] = "append"
                 out.append(res)
+            if stats_out is not None:
+                stats_out.extend(fold_stats)
             return out
         if checker == "wr":
             prohibited = elle_wr.WrChecker().prohibited
@@ -241,8 +266,12 @@ class FoldDispatcher:
                 # -> singletons -> quarantine), shared with cli so the
                 # two dispatch owners can't drift
                 from ..cli import _wr_chunk_with_backdown
+                ws: list | None = [] if want_stats else None
                 cycles_per = _wr_chunk_with_backdown(
-                    [(None, e) for e in encs], elle_kernels, elle_wr)
+                    [(None, e) for e in encs], elle_kernels, elle_wr,
+                    stats_out=ws)
+                if ws is not None:
+                    fold_stats[:len(ws)] = ws
             out = []
             for enc, cycles in zip(encs, cycles_per):
                 if hasattr(cycles, "verdict"):   # supervisor.Quarantined
@@ -251,5 +280,7 @@ class FoldDispatcher:
                 res = elle_wr.render_wr_verdict(enc, cycles, prohibited)
                 res["checker"] = "wr"
                 out.append(res)
+            if stats_out is not None:
+                stats_out.extend(fold_stats)
             return out
         raise ValueError(f"unknown checker {checker!r}")

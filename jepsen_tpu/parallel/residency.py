@@ -80,13 +80,18 @@ class ExecutableResidency:
         """The stable half of the AOT cache key for a single-device
         dispatch: kernel flags + the RESOLVED closure formulation +
         batch geometry (aot itself adds input avals, backend topology
-        and jax/jaxlib versions)."""
+        and jax/jaxlib versions). A kernel-stats dispatch
+        (JEPSEN_TPU_KERNEL_STATS) returns a second output and so
+        compiles a different executable — the marker is APPENDED only
+        when the flag is on, so the gate-off key (and every cached
+        executable keyed under it) is byte-identical to before."""
         from ..checker.elle import kernels as K
         use_pallas, use_int8 = K.resolve_formulation(single_device=True)
         return (kw.get("classify", True), kw.get("realtime", False),
                 kw.get("process_order", False), kw.get("fused"),
                 use_pallas, use_int8, donate,
-                shape.n_keys, shape.max_pos, shape.n_txns)
+                shape.n_keys, shape.max_pos, shape.n_txns) \
+            + (("stats",) if kw.get("with_stats") else ())
 
 
 class DeviceSlots:

@@ -595,19 +595,37 @@ class VerdictDaemon:
                 log.exception("fold processing failed")
 
     def _run_fold(self, checker: str, picked: list, tr) -> None:
+        from ..obs import search as obs_search
         by_tenant: dict[str, int] = {}
         for r in picked:
             by_tenant[r.tenant] = by_tenant.get(r.tenant, 0) + 1
         obs_events.emit("serve_admit", checker=checker,
                         histories=len(picked), tenants=by_tenant)
+        # kernel search telemetry (JEPSEN_TPU_KERNEL_STATS): stats ride
+        # the reply frame BESIDE "result" — the journaled/acked verdict
+        # bytes stay identical with the gate on or off — and feed the
+        # kernel.* metrics only (the daemon is long-lived; the
+        # per-sweep ledger is analyze-store's)
+        souts: list | None = [] if obs_search.enabled() else None
         with tr.span("serve_fold", checker=checker,
                      histories=len(picked),
                      tenants=len(by_tenant)):
-            results = self._dispatcher.verdicts(
-                [r.enc for r in picked], checker)
+            # the stats kwarg is passed only when requested, so
+            # stats-free dispatcher doubles (test seams) keep working
+            if souts is not None:
+                results = self._dispatcher.verdicts(
+                    [r.enc for r in picked], checker,
+                    stats_out=souts)
+            else:
+                results = self._dispatcher.verdicts(
+                    [r.enc for r in picked], checker)
         tr.counter("serve_folds").inc()
         tr.histogram("serve_fold_histories").observe(len(picked))
-        for r, res in zip(picked, results):
+        for k, (r, res) in enumerate(zip(picked, results)):
+            stats = souts[k] if souts is not None \
+                and k < len(souts) else None
+            if stats is not None:
+                obs_search.note_metrics(stats, tr)
             res = _json_safe(res)
             ent = self._tenant_state(r.tenant)
             with self._jlock:
@@ -641,6 +659,8 @@ class VerdictDaemon:
             if r.conn is not None and r.conn.alive:
                 frame = {"op": "verdict", "id": r.rid,
                          "checker": checker, "result": res}
+                if stats is not None:
+                    frame["stats"] = stats
                 if not journaled:
                     frame["journaled"] = False
                 r.conn.send(frame)

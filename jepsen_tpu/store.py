@@ -531,6 +531,92 @@ def load_costdb(path) -> list[dict]:
 
 
 # ---------------------------------------------------------------------------
+# The kernel search-telemetry ledger: analytics.jsonl at the store
+# root (JEPSEN_TPU_KERNEL_STATS, jepsen_tpu/obs/search.py). One JSON
+# line per checked history — the per-relation edge counts, closure
+# rounds, SCC shape and decision-boundary margin the checker kernels
+# now emit beside the verdict — flushed as written with the costdb's
+# torn-tail discipline. Mesh shards write `analytics-shard<k>.jsonl`;
+# the coordinator folds them into one analytics.jsonl. The ledger is
+# the seed corpus for the adversarial near-miss search (ROADMAP item
+# 3) and, joined with the costdb, the planner's empirical complexity
+# model (item 4).
+# ---------------------------------------------------------------------------
+
+ANALYTICS_NAME = "analytics.jsonl"
+
+
+def analytics_path(store_base, shard: int | None = None) -> Path:
+    """The analytics ledger for a store — per-shard under a mesh
+    sweep, so two hosts never interleave appends in one file."""
+    if shard is None:
+        return Path(store_base) / ANALYTICS_NAME
+    return Path(store_base) / f"analytics-shard{shard}.jsonl"
+
+
+def append_analytics(path, records: list[dict]) -> int:
+    """Append stats records as JSON lines, each flushed as written; a
+    crash-torn tail from a previous writer is sealed first (the
+    journal's rule). Best-effort: a read-only store returns 0, never
+    raises.
+
+    Deliberately mirrors append_costdb rather than sharing a helper:
+    the JT-DUR prover attributes append-handle flush discipline to
+    the REGISTRY-DECLARED writer qualname, and hoisting the open/
+    write/flush loop into a path-parameterized helper would take
+    these exact lines out of static proof — keep the twins in sync
+    by hand (they are also crash-sim tested independently)."""
+    p = Path(path)
+    n = 0
+    try:
+        p.parent.mkdir(parents=True, exist_ok=True)
+        with open(p, "a") as f:
+            if f.tell() > 0:
+                with open(p, "rb") as rf:
+                    rf.seek(-1, os.SEEK_END)
+                    if rf.read(1) != b"\n":
+                        f.write("\n")
+            for rec in records:
+                try:
+                    line = json.dumps(rec)
+                except (TypeError, ValueError):
+                    continue
+                f.write(line + "\n")
+                f.flush()
+                n += 1
+    except OSError:
+        log.debug("analytics append failed for %s", p, exc_info=True)
+    return n
+
+
+def load_analytics(path) -> list[dict]:
+    """Records from an existing analytics ledger, in file order;
+    unparseable lines (the crash-torn tail) are skipped, mirroring
+    VerdictJournal.load."""
+    out: list[dict] = []
+    p = Path(path)
+    if p.is_dir():
+        p = p / ANALYTICS_NAME
+    if not p.is_file():
+        return out
+    try:
+        lines = p.read_text().splitlines()
+    except OSError:
+        return out
+    for ln in lines:
+        ln = ln.strip()
+        if not ln:
+            continue
+        try:
+            rec = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "checker" in rec:
+            out.append(rec)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Verdict-service artifacts: the `jepsen-tpu serve` daemon's on-disk
 # surface, all at the store root (the flat per-shard convention of
 # verdicts-<k>.jsonl / costdb-shard<k>.jsonl):

@@ -65,6 +65,7 @@ DECLARED_METRICS: dict[str, frozenset] = {
         "buffers_donated", "cache_hits", "cache_misses",
         "compile_cache_hits", "compile_cache_misses", "cost_records",
         "donated_bytes", "h2d_bytes",
+        "kernel.cyclic_histories", "kernel.stats_records",
         "native_fallback", "oom_retries", "pad_waste_cells",
         "quarantined", "runs_verdicted",
         "serve_backpressure", "serve_folds", "serve_replays",
@@ -78,7 +79,11 @@ DECLARED_METRICS: dict[str, frozenset] = {
                          "reorder_depth", "resident_executables",
                          "runs_total", "serve_pending",
                          "serve_tenants"}),
-    "histograms": frozenset({"bucket_cells", "serve_fold_histories",
+    "histograms": frozenset({"bucket_cells",
+                             "kernel.backtracks",
+                             "kernel.closure_rounds", "kernel.edges",
+                             "kernel.margin", "kernel.scc_max",
+                             "serve_fold_histories",
                              "serve_latency_ms"}),
 }
 

@@ -40,9 +40,14 @@ def test_rand_factor_bounds():
 
 @pytest.fixture(scope="module")
 def shim_so(tmp_path_factory):
+    # -pthread mirrors faketime.install's build line: the shim calls
+    # pthread_once, and without the link flag a preloaded .so breaks
+    # any host binary that doesn't link libpthread itself (`date` on
+    # current glibc fails with "undefined symbol: pthread_once" —
+    # the cause of the old test_shim_offset failure)
     out = tmp_path_factory.mktemp("shim") / "libfaketime_shim.so"
     r = subprocess.run(
-        ["g++", "-O2", "-fPIC", "-shared", "-o", str(out),
+        ["g++", "-O2", "-fPIC", "-shared", "-pthread", "-o", str(out),
          os.path.join(NATIVE, "faketime_shim.cc"), "-ldl"],
         capture_output=True, text=True)
     if r.returncode != 0:

@@ -34,6 +34,8 @@ ALL_GATES = [
     "JEPSEN_TPU_EVENTS_MAX_BYTES",
     "JEPSEN_TPU_COSTDB",
     "JEPSEN_TPU_RESIDENCY_INTERVAL_S",
+    "JEPSEN_TPU_KERNEL_STATS",
+    "JEPSEN_TPU_KERNEL_STATS_SAMPLE",
     "JEPSEN_TPU_BACKEND",
     "JEPSEN_TPU_PLATFORM",
     "JEPSEN_TPU_CLOSURE",

@@ -7,12 +7,29 @@ dp-sharded classify step over the global mesh."""
 import os
 import sys
 
+import pytest
+
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
-def test_two_process_multihost_dryrun():
+def _dryrun(*args, **kw):
+    """dryrun_multihost with the capability-probe skip: some jaxlib
+    CPU builds cannot run cross-process computations at all
+    ("Multiprocess computations aren't implemented on the CPU
+    backend") — a missing backend capability, not a repo regression,
+    so the dryrun skips with the reason instead of failing tier-1.
+    The mesh-sweep CLI dryrun below avoids the capability by design
+    (per-shard local dispatch) and keeps gating the mesh path."""
     import __graft_entry__ as g
-    summary = g.dryrun_multihost(2, 2)   # 2 procs x 2 devices = 4 global
+    try:
+        return g.dryrun_multihost(*args, **kw)
+    except g.MultihostUnsupported as e:
+        pytest.skip("jaxlib CPU backend lacks multiprocess "
+                    f"computations: {str(e)[:200]}")
+
+
+def test_two_process_multihost_dryrun():
+    summary = _dryrun(2, 2)   # 2 procs x 2 devices = 4 global
     assert summary.count("MULTIHOST_WORKER_OK") == 2
     assert "pid=0/2" in summary and "pid=1/2" in summary
     # the REAL analyze-store --mesh CLI path: both processes
@@ -45,8 +62,7 @@ def test_multihost_non_power_of_two_devices():
     non-contiguous host-local shards. (mesh_sweep=False: the CLI-path
     dryrun above already covers the sweep; this test pins the mesh
     SHAPE invariant only.)"""
-    import __graft_entry__ as g
-    summary = g.dryrun_multihost(2, 3, mesh_sweep=False)  # 6 global
+    summary = _dryrun(2, 3, mesh_sweep=False)  # 6 global
     assert summary.count("MULTIHOST_WORKER_OK") == 2
     assert "devices=6" in summary
     # the invariant itself: dp rows aligned to processes, (2, 3) not
