@@ -10,7 +10,7 @@ TSAN_RT := $(shell gcc -print-file-name=libtsan.so)
 .PHONY: lint lint-json lint-changed env-table rule-table dur-table \
 	crash-smoke test native native-sanitize bench bench-report \
 	bench-warm obs-smoke serve-smoke trace-report cost-report \
-	search-report
+	search-report planner-report
 
 # Self-hosted static analysis: gate registry, JAX hazards, concurrency
 # discipline, shm lifecycle, tracer discipline, plus the cross-boundary
@@ -161,4 +161,13 @@ cost-report:
 # rate, closure-round + margin distributions) to the report.
 search-report:
 	JEPSEN_TPU_KERNEL_STATS=1 JEPSEN_TPU_COSTDB=1 \
+	  $(PY) -m jepsen_tpu.cli analyze-store --store $(STORE) --report
+
+# search-report with the cost-aware planner on: routes the sweep
+# through the fitted model (warm-started from <store>/plan.json when
+# one exists), refits the plan from this sweep's measured costdb ×
+# analytics join at the end, and adds the "planner" section
+# (decisions, fallbacks, predicted-vs-measured error) to the report.
+planner-report:
+	JEPSEN_TPU_PLANNER=1 JEPSEN_TPU_KERNEL_STATS=1 JEPSEN_TPU_COSTDB=1 \
 	  $(PY) -m jepsen_tpu.cli analyze-store --store $(STORE) --report

@@ -1342,6 +1342,123 @@ def bench_search(n_dev: int, devices) -> dict:
     }
 
 
+def bench_planner(n_dev: int, devices) -> dict:
+    """The cost-aware planner (JEPSEN_TPU_PLANNER) over a MIXED-
+    geometry workload: history lengths cycle through four size
+    classes, so no single fixed bucket multiple is optimal for the
+    whole batch. The block times the same sweep under every FIXED
+    geometry candidate (a planner shim pinning one multiple), then
+    under the real planner warm-started from a calibration pass's
+    measured costdb, and reports `planner_speedup` = best fixed wall
+    over planner wall — the tentpole claim is that the modeled router
+    matches or beats every fixed configuration (>= ~1.0; bench-report
+    trends it with a floor well under the noise band). Verdict parity
+    across every configuration is the hard floor-1.0 contract: a
+    placement decision changing one verdict fails the round."""
+    from jepsen_tpu import gates, parallel, planner
+    from jepsen_tpu.checker.elle import synth
+    from jepsen_tpu.obs import device as device_obs
+
+    accel = _accel(devices)
+    B = int(os.environ.get("BENCH_PLANNER_B", 32 if accel else 12))
+    sizes = ((256, 512, 1024, 1536) if accel
+             else (64, 128, 256, 320))
+    reps = int(os.environ.get("BENCH_PLANNER_REPS", 3))
+    encs = [synth.synth_encoded_history(sizes[i % len(sizes)], K=16,
+                                        inject_cycle=(i % 5 == 4))
+            for i in range(B)]
+    mesh = parallel.make_mesh(devices) if n_dev > 1 else None
+
+    class _FixedGeometry:
+        """A planner shim pinning one bucket multiple — the 'fixed
+        config' arm of the race; every other lever is the default."""
+
+        def __init__(self, multiple: int):
+            self.multiple = multiple
+            self.plan = None
+            self.source = f"fixed-{multiple}"
+            self.modeled = False
+
+        def plan_buckets(self, encs, *, budget_cells, dp=1):
+            return parallel.bucket_by_length(
+                encs, multiple=self.multiple,
+                budget_cells=budget_cells, dp=dp)
+
+        def fused_choice(self, default, **kw):
+            return default
+
+        def split_native(self, n_ops):
+            return True
+
+        def admission_cost(self, n_txns, checker="append"):
+            from jepsen_tpu.parallel import folding
+            return folding.fold_cost(int(n_txns))
+
+    def timed_sweep():
+        best = None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            res = parallel.check_bucketed(encs, mesh)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return res, best
+
+    prev_pl = os.environ.get("JEPSEN_TPU_PLANNER")
+    prev_cost = os.environ.get("JEPSEN_TPU_COSTDB")
+    try:
+        gates.unset("JEPSEN_TPU_PLANNER")
+        # calibration pass: warm every executable AND capture the
+        # measured costdb the model trains on
+        device_obs.reset()
+        gates.export("JEPSEN_TPU_COSTDB", True)
+        parallel.check_bucketed(encs, mesh)
+        cost_records = device_obs.records()
+        if prev_cost is None:
+            gates.unset("JEPSEN_TPU_COSTDB")
+        base, base_wall = timed_sweep()
+
+        gates.export("JEPSEN_TPU_PLANNER", True)
+        fixed_walls: dict = {}
+        parity = True
+        for m in planner.GEOMETRY_CANDIDATES:
+            planner._active = _FixedGeometry(m)
+            parallel.check_bucketed(encs, mesh)     # compile warmup
+            res, wall = timed_sweep()
+            fixed_walls[str(m)] = round(wall, 4)
+            parity = parity and res == base
+
+        plan = planner.fit_plan(cost_records, [])
+        planner._active = planner.Planner(plan, "fit")
+        parallel.check_bucketed(encs, mesh)         # compile warmup
+        res, planner_wall = timed_sweep()
+        parity = parity and res == base
+    finally:
+        planner.deactivate()
+        for name, prev in (("JEPSEN_TPU_PLANNER", prev_pl),
+                           ("JEPSEN_TPU_COSTDB", prev_cost)):
+            if prev is None:
+                gates.unset(name)
+            else:
+                os.environ[name] = prev
+    best_fixed = min(fixed_walls, key=lambda k: fixed_walls[k])
+    return {
+        "histories": B, "size_mix": list(sizes),
+        "base_secs": round(base_wall, 4),
+        "fixed_secs": fixed_walls,
+        "best_fixed_multiple": int(best_fixed),
+        "planner_secs": round(planner_wall, 4),
+        "planner_speedup": round(
+            fixed_walls[best_fixed] / planner_wall, 3)
+        if planner_wall else None,
+        "modeled": plan is not None,
+        "trained_records": (plan or {}).get("trained_records", 0),
+        "verdict_parity": parity,
+        # the gateable twin (bench-report rejects bools): floor 1.0
+        # fails the round if any placement decision changed a verdict
+        "parity_ok": 1.0 if parity else 0.0,
+    }
+
+
 def bench_serve(n_dev: int, devices) -> dict:
     """The verdict service under a multi-tenant OPEN-LOOP load
     generator: an in-process daemon over a synthetic store,
@@ -1535,6 +1652,7 @@ def run_benches() -> int:
             ("mesh", bench_mesh, (n_dev, devices)),
             ("serve", bench_serve, (n_dev, devices)),
             ("search", bench_search, (n_dev, devices)),
+            ("planner", bench_planner, (n_dev, devices)),
             ("generator", bench_generator, (reps,))):
         try:
             if name in force_fail:
@@ -1609,7 +1727,7 @@ def main() -> int:
 
     blocks = ("knossos", "long_history", "end_to_end", "register_sweep",
               "north_star", "dp_scaling", "mesh", "serve", "search",
-              "generator")
+              "planner", "generator")
     cpu_env = {"JEPSEN_TPU_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu",
                "BENCH_ATTEMPT": "cpu-retry"}
 

@@ -449,6 +449,11 @@ def analyze_store(store: Store, checker: str = "append",
     device_obs.reset()
     # so is the kernel search-telemetry ledger (JEPSEN_TPU_KERNEL_STATS)
     search_obs.reset()
+    # the cost-aware planner is per-sweep state too: load the store's
+    # persisted plan.json (warm start) or run cold — a no-op with
+    # JEPSEN_TPU_PLANNER off
+    from . import planner as planner_mod
+    planner_mod.activate(store.base)
     if getattr(tr, "enabled", False) and store.base.is_dir():
         # point the worker trace fabric at the store: pool workers
         # spool spans to <spool_dir>/trace-<pid>.jsonl; stale spools
@@ -528,6 +533,25 @@ def analyze_store(store: Store, checker: str = "append",
                           file=sys.stderr)
             except Exception:
                 log.warning("analytics flush failed", exc_info=True)
+            # sweep-end planner refit from the full on-disk tables
+            # (this sweep's fresh records included): plan.json is what
+            # the NEXT sweep and the daemon warm-start from. Mesh
+            # shards skip it — the coordinator refits once over the
+            # merged fleet tables instead.
+            if not mesh and planner_mod.enabled():
+                try:
+                    from .store import load_analytics, load_costdb
+                    plan = planner_mod.refresh(
+                        store.base,
+                        load_costdb(costdb_path(store.base)),
+                        load_analytics(analytics_path(store.base)))
+                    if plan is not None:
+                        print(f"planner: plan.json refit from "
+                              f"{plan['trained_records']} record(s)",
+                              file=sys.stderr)
+                except Exception:
+                    log.warning("planner refresh failed",
+                                exc_info=True)
         obs.reset_events()
         if getattr(tr, "enabled", False) and store.base.is_dir():
             try:

@@ -294,6 +294,19 @@ _g("JEPSEN_TPU_SERVE_DRAIN_S", "float", 30.0,
    "seconds the `serve` daemon spends draining admitted work on "
    "SIGTERM before closing; work never admitted (or past the "
    "deadline) is left for the tenant to resend — never half-acked")
+# -- cost-aware planner -----------------------------------------------------
+_g("JEPSEN_TPU_PLANNER", "bool", False,
+   "set: the cost-aware dispatch planner — route per-history tier "
+   "(python/native/TPU split + dispatch), bucket geometry and "
+   "fused-vs-two-pass choice, and price `serve` admission, from a "
+   "cost model fit on `costdb.jsonl` × `analytics.jsonl` (persisted "
+   "as `<store>/plan.json`); cold start (no costdb, unseen device "
+   "kind, corrupt plan) degrades to the exact current heuristics — "
+   "planner decisions never change verdicts, only placement")
+_g("JEPSEN_TPU_PLANNER_PATH", "str", None,
+   "explicit `plan.json` path for the planner (load AND save), e.g. "
+   "one shared model across stores or a daemon fleet; default "
+   "`<store>/plan.json`; only read when `JEPSEN_TPU_PLANNER` is on")
 # -- robustness -------------------------------------------------------------
 _g("JEPSEN_TPU_STRICT", "bool", False,
    "set: restore fail-fast — no quarantine, no OOM backdown; the "

@@ -324,7 +324,17 @@ def subhistories_path(history: list, path, stats: dict | None = None) -> dict:
     different/edited file). `stats`, when given, counts which path
     ACTUALLY ran per call ("native"/"python") so reporters can't
     mistake availability for use."""
-    if native_split_enabled():
+    use_native = native_split_enabled()
+    if use_native:
+        # the cost-aware planner may DECLINE native for histories
+        # below its fitted threshold (it can never force native on
+        # past the user's gate pin); both splitters produce identical
+        # per-key lists, so the tier choice moves only time
+        from . import planner as _planner
+        pl = _planner.get()
+        if pl is not None and not pl.split_native(len(history)):
+            use_native = False
+    if use_native:
         from . import native_lib
         got = native_lib.split_key_ids(path)
         if got is not None:

@@ -394,6 +394,18 @@ STORE_ARTIFACTS: tuple[StoreArtifact, ...] = (
         doc="the daemon's pid + listen address, published atomically "
             "(temp+`os.replace`), removed at drain"),
     StoreArtifact(
+        "dispatch plan", ("plan.json",), "snapshot",
+        writers=("jepsen_tpu/planner.py:save_plan",),
+        readers=("jepsen_tpu/planner.py:load_plan",),
+        retention="replaced",
+        helpers=("plan_path",),
+        doc="the cost-aware planner's fitted model "
+            "(JEPSEN_TPU_PLANNER): per-mode device-seconds "
+            "coefficients fit from costdb × analytics, published "
+            "temp+`os.replace` at sweep end; a corrupt or stale plan "
+            "degrades to the deterministic heuristic fallback, never "
+            "to a failed sweep"),
+    StoreArtifact(
         "encoded sidecar", ("encoded*.bin",), "sidecar",
         writers=("jepsen_tpu/store.py:save_encoded",),
         readers=("jepsen_tpu/store.py:load_encoded",),

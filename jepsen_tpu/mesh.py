@@ -259,6 +259,16 @@ def coordinator_merge(store, checker: str, shard: int, n_shards: int,
             except Exception:
                 log.warning("mesh analytics merge failed",
                             exc_info=True)
+            # one planner refit over the merged fleet tables (the
+            # per-shard sweeps skipped theirs): plan.json then serves
+            # every host's next warm sweep; no-op with the gate off
+            try:
+                from . import planner as planner_mod
+                planner_mod.refresh(store.base, cost_records,
+                                    search_records)
+            except Exception:
+                log.warning("mesh planner refresh failed",
+                            exc_info=True)
         if tracer is not None and getattr(tracer, "enabled", False) \
                 and Path(store.base).is_dir():
             try:
@@ -279,15 +289,22 @@ def merge_costdbs(store_base, n_shards: int) -> list[dict]:
     """Fold every present per-shard `costdb-shard<k>.jsonl` into one
     deduplicated `<store>/costdb.jsonl` (obs.device.merge_records:
     same (executable, geometry) on two shards → one record with the
-    measured windows summed and the roofline re-derived). Returns the
-    merged records ([] when no shard captured any — gate off). The
-    merged file is written atomically: it is a derived artifact, and
-    a repeat merge must replace, not double, the fleet's records."""
+    measured windows summed and the roofline re-derived). An absent
+    shard file (that shard ran gate-off, or was lost) is an EMPTY
+    typed table from load_costdb, not an error — merging a partial
+    fleet is the norm, not the exception. Returns the merged records
+    ([] when no shard captured any — gate off). The merged file is
+    written atomically: it is a derived artifact, and a repeat merge
+    must replace, not double, the fleet's records."""
     from . import trace as _trace
     from .obs import device as device_obs
     from .store import COSTDB_NAME, costdb_path, load_costdb
     lists = [load_costdb(costdb_path(store_base, k))
              for k in range(n_shards)]
+    absent = sum(1 for t in lists if not t.exists)
+    if absent:
+        log.debug("costdb merge: %d/%d shard file(s) absent",
+                  absent, n_shards)
     if not any(lists):
         return []
     merged = device_obs.merge_records(lists)

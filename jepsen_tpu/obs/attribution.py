@@ -309,6 +309,10 @@ def render_report_md(rep: dict) -> str:
     if search_sec:
         from . import search as search_mod
         lines += search_mod.render_search_md(search_sec)
+    planner_sec = rep.get("planner") or {}
+    if planner_sec:
+        from .. import planner as planner_mod
+        lines += planner_mod.render_planner_md(planner_sec)
     lines += ["", "## What-if", "", f"- {summary_line(rep)}"]
     if rep.get("counters"):
         keep = ("runs_verdicted", "buckets_dispatched", "cache_hits",
@@ -447,6 +451,15 @@ def write_report(store_base, events: list, metrics: dict | None = None,
                                         cost_records=device_records)
         if sec is not None:
             rep["search"] = sec
+    from .. import planner as planner_mod
+    if planner_mod.enabled():
+        # the planner section reads the PROCESS state (active plan +
+        # this sweep's decision counters) rather than taking another
+        # records parameter: a cold sweep still reports its fallback
+        # tally, which is the section's whole point
+        rep["planner"] = planner_mod.planner_section(
+            planner_mod.current_plan(), cost_records=device_records,
+            metrics=metrics)
     jp = trace.atomic_write_text(base / "report.json",
                                  json.dumps(rep, indent=2))
     mp = trace.atomic_write_text(base / "report.md",

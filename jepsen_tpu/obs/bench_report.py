@@ -156,6 +156,19 @@ METRICS: tuple[MetricSpec, ...] = (
     MetricSpec("search_overhead_x", "kernel-stats overhead (x)",
                ("search", "stats_overhead_x"), False, 0.50,
                ceiling=3.0),
+    # the cost-aware planner block: planner_speedup is planner-on
+    # wall over the BEST fixed geometry's wall on a mixed workload —
+    # the tentpole claim is >= ~1.0 (the modeled router never loses
+    # to a fixed config it could have picked). The 0.85 floor sits
+    # under the CI noise band so only a real routing regression (the
+    # model steering into a slower geometry) fails the round; the
+    # parity pin is the absolute contract — one placement decision
+    # changing one verdict fails outright.
+    MetricSpec("planner_speedup", "planner vs best fixed config (x)",
+               ("planner", "planner_speedup"), True, 0.15,
+               floor=0.85),
+    MetricSpec("planner_parity", "planner verdict parity",
+               ("planner", "parity_ok"), True, 0.0, floor=1.0),
 )
 
 

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import planner as _planner
 from .. import supervisor as sv
 from .. import trace
 from ..obs import device as obs_device
@@ -815,7 +816,16 @@ def check_bucketed_async(encs: Sequence, mesh: Mesh | None = None, *,
               with_stats=bool(with_stats))
     t0 = time.perf_counter()
     eff_budget = max(1, budget_cells // depth)
-    buckets = bucket_by_length(encs, budget_cells=eff_budget, dp=dp)
+    pl = _planner.get()
+    if pl is not None:
+        # the cost-aware planner races candidate pad multiples on
+        # predicted device seconds and keeps the winner's composition;
+        # it answers bucket_by_length's exact output (multiple 128)
+        # whenever it has no model — and composition only moves
+        # histories between dispatches, never changes a verdict
+        buckets = pl.plan_buckets(encs, budget_cells=eff_budget, dp=dp)
+    else:
+        buckets = bucket_by_length(encs, budget_cells=eff_budget, dp=dp)
     # Singleton buckets whose one history alone exceeds the per-slot
     # budget cannot honor depth-sharing: peel them off to dispatch
     # strictly alone after the pipelined buckets drain.
@@ -1047,6 +1057,17 @@ def check_bucketed(encs: Sequence, mesh: Mesh | None = None, *,
         return []
     if fused is None:
         fused = K.fused_classify_enabled()
+        pl = _planner.get()
+        if pl is not None and classify:
+            # the planner may flip the classify strategy when the
+            # costdb has measured BOTH fused and two-pass at this
+            # workload's geometry (verdicts are pinned identical
+            # across strategies); an explicit fused= argument or a
+            # cold planner keeps the gate's choice
+            t_pad = K.pad_to(max((_size_of(e) for e in encs),
+                                 default=1), 128)
+            fused = pl.fused_choice(fused, classify=classify,
+                                    t_pad=t_pad)
     if two_pass is None:
         two_pass = classify and not fused
     if classify and two_pass:

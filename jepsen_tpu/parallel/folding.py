@@ -27,7 +27,11 @@ to the dispatcher it feeds, in two pieces:
     tenant costs its bucket share, never the daemon.
 
 Both are plain objects with no socket/tenant knowledge — the serve
-daemon composes them; the mesh sweep (or a future planner) can too.
+daemon composes them; the mesh sweep can too. The cost-aware planner
+(jepsen_tpu/planner.py, JEPSEN_TPU_PLANNER) slots in above this
+layer: it replaces the `fold_cost` PRICE with a model prediction in
+the same cell unit, while `plan_fold`'s DRR mechanics — which only
+ever read `.cost` as a positive number — stay untouched.
 """
 
 from __future__ import annotations
@@ -51,7 +55,11 @@ def fold_cost(n_txns: int, multiple: int = 128) -> int:
     """The padded closure footprint one history contributes to a
     shared bucket: T_pad² cells with the txn axis rounded up to the
     MXU tile — `bucket_by_length`'s unit, restated jax-free so
-    admission can price a request before any device work."""
+    admission can price a request before any device work. This is
+    the ANALYTIC proxy; with JEPSEN_TPU_PLANNER on, the serve daemon
+    prices admission with `planner.admission_cost` — the fitted cost
+    model's prediction normalized back to this same cell unit, with
+    this function as its bit-exact cold-start fallback."""
     t = max(int(n_txns), 1)
     t = max(multiple, ((t + multiple - 1) // multiple) * multiple)
     return t * t

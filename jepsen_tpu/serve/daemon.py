@@ -210,6 +210,11 @@ class VerdictDaemon:
             self.budget_cells = folding.DEFAULT_FOLD_CELLS
         self._dispatcher = folding.FoldDispatcher(
             budget_cells=self.budget_cells)
+        # load the store's fitted dispatch plan (JEPSEN_TPU_PLANNER):
+        # admission pricing then uses model-predicted cost instead of
+        # the T_pad² proxy; gate off (or no plan.json yet) is a no-op
+        from .. import planner as planner_mod
+        planner_mod.activate(base)
         self._spool = RequestSpool(base)
         self._bind()
         trace.atomic_write_text(
@@ -499,9 +504,18 @@ class VerdictDaemon:
                 >= self.admission.max_queue:
             self._send_backpressure(conn, rid, tr)
             return
+        from .. import planner as planner_mod
         from ..parallel import folding
         enc = self._resolve_payload(frame, checker)
-        cost = folding.fold_cost(int(getattr(enc, "n", 1) or 1))
+        n_txns = int(getattr(enc, "n", 1) or 1)
+        pl = planner_mod.get()
+        # admission price: the planner's model-predicted device
+        # seconds normalized to fold_cost's cell unit when
+        # JEPSEN_TPU_PLANNER is on (and fold_cost bit-exact on its
+        # cold-start fallback); any positive cost preserves
+        # plan_fold's weighted-DRR fairness semantics
+        cost = (pl.admission_cost(n_txns, checker) if pl is not None
+                else folding.fold_cost(n_txns))
         req = scheduler.Request(conn.tenant, rid, checker, enc, cost,
                                 conn)
         if not self.admission.admit(req):

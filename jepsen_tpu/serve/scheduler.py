@@ -5,11 +5,15 @@ One `Admission` object owns every tenant's scheduling state: a
 queue-depth cap (JEPSEN_TPU_SERVE_MAX_QUEUE), and the weighted
 deficit-round-robin fold selection (`parallel.folding.plan_fold`) the
 daemon's dispatch loop pulls from. Admission control is priced by
-HISTORY SIZE (padded closure cells, `folding.fold_cost`), not request
-count — the arxiv 1908.04509 posture: one tenant's 5000-txn histories
-cost it 1500x the fold share of another tenant's 128-txn ones, so the
-queue-depth cap plus the cell-priced fairness bound both dimensions a
-tenant can hog.
+PREDICTED WORK, not request count — the arxiv 1908.04509 posture: one
+tenant's 5000-txn histories cost it ~1500x the fold share of another
+tenant's 128-txn ones, so the queue-depth cap plus the cost-priced
+fairness bound both dimensions a tenant can hog. The price is
+`folding.fold_cost`'s T_pad² cell proxy by default; with
+JEPSEN_TPU_PLANNER on, the daemon prices with the fitted cost model's
+predicted device seconds normalized to the SAME cell unit
+(`planner.admission_cost`), so budgets and the DRR below are
+unchanged either way.
 
 Backpressure is EXPLICIT: a full lane rejects the request and the
 daemon answers a `retry-after` frame with a depth-derived delay hint —

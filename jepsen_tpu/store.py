@@ -503,20 +503,45 @@ def append_costdb(path, records: list[dict]) -> int:
     return n
 
 
-def load_costdb(path) -> list[dict]:
-    """Records from an existing costdb, in file order; unparseable
-    lines (the crash-torn tail) are skipped, mirroring
-    VerdictJournal.load."""
+class CostTable(list):
+    """The typed costdb read result: the record dicts plus the
+    provenance every consumer was re-deriving by hand — which path was
+    read and whether that file existed at all. Subclasses `list`, so
+    every existing consumer (iteration, truthiness, the mesh merge's
+    `any(lists)`) keeps working unchanged: a missing or empty shard
+    reads as a falsy table, never an exception or a sentinel the
+    caller must special-case."""
+
+    __slots__ = ("path", "exists")
+
+    def __init__(self, records=(), *, path=None, exists: bool = False):
+        super().__init__(records)
+        self.path = Path(path) if path is not None else None
+        self.exists = bool(exists)
+
+    @property
+    def empty(self) -> bool:
+        """No records — the planner's cold-start predicate (an absent
+        file and a present-but-recordless one both count)."""
+        return not self
+
+
+def load_costdb(path) -> CostTable:
+    """Records from a costdb as a `CostTable`, in file order;
+    unparseable lines (the crash-torn tail) are skipped, mirroring
+    VerdictJournal.load. A missing or unreadable file returns a typed
+    EMPTY table (`exists=False`) instead of making every consumer
+    re-implement the existence check."""
     out: list[dict] = []
     p = Path(path)
     if p.is_dir():
         p = p / COSTDB_NAME
     if not p.is_file():
-        return out
+        return CostTable(path=p, exists=False)
     try:
         lines = p.read_text().splitlines()
     except OSError:
-        return out
+        return CostTable(path=p, exists=False)
     for ln in lines:
         ln = ln.strip()
         if not ln:
@@ -527,7 +552,30 @@ def load_costdb(path) -> list[dict]:
             continue
         if isinstance(rec, dict) and "geometry" in rec:
             out.append(rec)
-    return out
+    return CostTable(out, path=p, exists=True)
+
+
+# ---------------------------------------------------------------------------
+# The fitted dispatch plan: plan.json at the store root — the
+# cost-aware planner's model snapshot (JEPSEN_TPU_PLANNER,
+# jepsen_tpu/planner.py). Published whole via temp + os.replace
+# (snapshot protocol, declared in lint/contracts.py STORE_ARTIFACTS)
+# so warm sweeps and the serve daemon load the fit instead of
+# re-deriving it from the costdb every start.
+# ---------------------------------------------------------------------------
+
+PLAN_NAME = "plan.json"
+
+
+def plan_path(store_base) -> Path:
+    """The planner's fitted-model snapshot for a store.
+    `JEPSEN_TPU_PLANNER_PATH` overrides — one shared plan across
+    stores or a daemon fleet loads (and saves) there instead."""
+    from . import gates
+    override = gates.get("JEPSEN_TPU_PLANNER_PATH")
+    if override:
+        return Path(override)
+    return Path(store_base) / PLAN_NAME
 
 
 # ---------------------------------------------------------------------------

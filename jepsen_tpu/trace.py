@@ -67,6 +67,8 @@ DECLARED_METRICS: dict[str, frozenset] = {
         "donated_bytes", "h2d_bytes",
         "kernel.cyclic_histories", "kernel.stats_records",
         "native_fallback", "oom_retries", "pad_waste_cells",
+        "planner.cold_starts", "planner.decisions",
+        "planner.fallbacks", "planner.pred_checked",
         "quarantined", "runs_verdicted",
         "serve_backpressure", "serve_folds", "serve_replays",
         "serve_requests", "serve_verdicts", "shm_bytes",
@@ -76,6 +78,7 @@ DECLARED_METRICS: dict[str, frozenset] = {
     }),
     "gauges": frozenset({"donate_slots_inflight", "hbm_device_bytes",
                          "hbm_modeled_bytes", "inflight_depth",
+                         "planner.pred_err_permille",
                          "reorder_depth", "resident_executables",
                          "runs_total", "serve_pending",
                          "serve_tenants"}),
@@ -90,9 +93,10 @@ DECLARED_METRICS: dict[str, frozenset] = {
 #: Sanctioned dynamic-name families: an f-string metric name must
 #: start with one of these (`phase.<key>`, `device.<kernel>`,
 #: `native_fallback.<component>`, `worker.<stage>` — the per-task
-#: stage-seconds digests ingest relays from pool workers).
+#: stage-seconds digests ingest relays from pool workers;
+#: `planner.<lever>` — per-lever modeled-decision counters).
 METRIC_PREFIXES = ("phase.", "device.", "native_fallback.", "worker.",
-                   "serve.")
+                   "serve.", "planner.")
 
 #: Synthetic tid for the device track (real thread idents are pthread
 #: addresses, nowhere near this; named tracks count down from here).

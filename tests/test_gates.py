@@ -64,6 +64,8 @@ ALL_GATES = [
     "JEPSEN_TPU_SERVE_MAX_QUEUE",
     "JEPSEN_TPU_SERVE_WEIGHTS",
     "JEPSEN_TPU_SERVE_DRAIN_S",
+    "JEPSEN_TPU_PLANNER",
+    "JEPSEN_TPU_PLANNER_PATH",
     "JEPSEN_TPU_STRICT",
     "JEPSEN_TPU_DISPATCH_TIMEOUT_S",
     "JEPSEN_TPU_FAULT_INJECT",

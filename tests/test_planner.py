@@ -1,0 +1,463 @@
+"""The cost-aware dispatch planner (jepsen_tpu/planner.py).
+
+Pins the ISSUE-16 contract: verdicts are byte-identical with the
+planner off, on-but-cold, and on-with-a-fitted-model across the
+bucketed sweep, the async pipeline, the fold dispatcher, and the
+per-key split; every cold-start decision is the bit-exact heuristic
+fallback (admission_cost == fold_cost, plan_buckets ==
+bucket_by_length); the fit/save/load/corrupt-degrade snapshot
+lifecycle; routing goldens on a seeded costdb; the predicted-vs-
+measured honesty loop; and the costdb cold-start ergonomics
+(typed empty CostTable). All CPU-safe.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+
+import pytest
+
+from jepsen_tpu import planner, trace
+from jepsen_tpu import store as jstore
+from jepsen_tpu.parallel import folding
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.delenv("JEPSEN_TPU_PLANNER", raising=False)
+    monkeypatch.delenv("JEPSEN_TPU_PLANNER_PATH", raising=False)
+    planner.deactivate()
+    trace.reset()
+    yield
+    planner.deactivate()
+    trace.reset()
+
+
+def _cost_records(tpads=(128, 256, 512), *, fused=True, scale=1e-3,
+                  formulation="xla-int8", provenance="measured"):
+    """A synthetic quadratic costdb: device_secs grows as (T/128)²."""
+    return [{
+        "kernel": {"classify": True, "realtime": False,
+                   "process_order": False, "fused": fused},
+        "formulation": formulation,
+        "geometry": {"B": 8, "n_txns": t, "n_keys": 4},
+        "windows": {"dispatches": 4,
+                    "device_secs": 4 * (t / 128) ** 2 * scale,
+                    "histories": 32, "min_secs": scale},
+        "backend": "cpu", "device_kind": "cpu",
+        "provenance": provenance,
+    } for t in tpads]
+
+
+def _search_records(tpads=(128, 256, 512)):
+    return [{"dir": "r", "checker": "append", "t_pad": t, "n_txns": t,
+             "closure_rounds": 3, "ww_edges": t, "wr_edges": t,
+             "rw_edges": t // 2, "rt_edges": 0, "proc_edges": t,
+             "margin": 1, "scc_max": 1} for t in tpads]
+
+
+def _encs(n=6, base_T=40):
+    from jepsen_tpu.checker.elle import encode as enc_mod
+    from jepsen_tpu.checker.elle.synth import synth_append_history
+    return [enc_mod.encode_history(
+        synth_append_history(T=base_T + 37 * i, K=4, seed=i))
+        for i in range(n)]
+
+
+def _fitted(tpads=(128, 256, 512)):
+    plan = planner.fit_plan(_cost_records(tpads),
+                            _search_records(tpads))
+    assert plan is not None
+    return plan
+
+
+def _install(plan, monkeypatch):
+    monkeypatch.setenv("JEPSEN_TPU_PLANNER", "1")
+    pl = planner.Planner(plan, "fit")
+    planner._active = pl
+    return pl
+
+
+# ---------------------------------------------------------------------------
+# Gates
+# ---------------------------------------------------------------------------
+
+class TestGates:
+    def test_default_off(self):
+        assert planner.enabled() is False
+        assert planner.get() is None
+
+    def test_gate_on_yields_cold_planner(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_PLANNER", "1")
+        pl = planner.get()
+        assert pl is not None and not pl.modeled
+        assert pl.source == "cold"
+
+    def test_planner_path_override(self, tmp_path, monkeypatch):
+        assert jstore.plan_path(tmp_path) == tmp_path / "plan.json"
+        pinned = tmp_path / "elsewhere" / "pinned.json"
+        monkeypatch.setenv("JEPSEN_TPU_PLANNER_PATH", str(pinned))
+        assert jstore.plan_path(tmp_path) == pinned
+
+
+# ---------------------------------------------------------------------------
+# Cold start: every lever is the bit-exact heuristic fallback
+# ---------------------------------------------------------------------------
+
+class TestColdFallback:
+    def test_admission_cost_is_fold_cost_exactly(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_PLANNER", "1")
+        pl = planner.get()
+        for n in (1, 7, 100, 128, 129, 1000, 4096, 50_000):
+            assert pl.admission_cost(n) == folding.fold_cost(n)
+
+    def test_plan_buckets_is_bucket_by_length_exactly(self, monkeypatch):
+        from jepsen_tpu import parallel
+        monkeypatch.setenv("JEPSEN_TPU_PLANNER", "1")
+        encs = _encs()
+        pl = planner.get()
+        got = pl.plan_buckets(encs, budget_cells=1 << 27)
+        assert got == parallel.bucket_by_length(
+            encs, budget_cells=1 << 27)
+
+    def test_fused_and_split_keep_defaults(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_PLANNER", "1")
+        pl = planner.get()
+        assert pl.fused_choice(True) is True
+        assert pl.fused_choice(False) is False
+        assert pl.split_native(1) is True
+        assert pl.split_native(10 ** 9) is True
+
+    def test_every_cold_decision_counts_as_fallback(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_PLANNER", "1")
+        pl = planner.get()
+        pl.admission_cost(100)
+        pl.fused_choice(True)
+        pl.split_native(5)
+        md = trace.get_current().metrics_dict()["counters"]
+        assert md["planner.decisions"] == 3
+        assert md["planner.fallbacks"] == 3
+
+
+# ---------------------------------------------------------------------------
+# Model fit + prediction
+# ---------------------------------------------------------------------------
+
+class TestFit:
+    def test_empty_tables_fit_none(self):
+        assert planner.fit_plan([], []) is None
+        assert planner.fit_plan(None, None) is None
+        # estimated-only rows with no measured window are unusable too
+        bad = [{"kernel": {"classify": True}, "geometry": {},
+                "windows": {}}]
+        assert planner.fit_plan(bad, []) is None
+
+    def test_fit_recovers_quadratic_scaling(self):
+        plan = _fitted()
+        p128 = planner.predict_secs(plan, 128)
+        p256 = planner.predict_secs(plan, 256)
+        p512 = planner.predict_secs(plan, 512)
+        assert p128 and p256 and p512
+        assert p256 / p128 == pytest.approx(4.0, rel=0.2)
+        assert p512 / p128 == pytest.approx(16.0, rel=0.2)
+
+    def test_unseen_strategy_predicts_none(self):
+        plan = _fitted()   # classify-only training data
+        assert planner.predict_secs(plan, 128, classify=False) is None
+
+    def test_prediction_is_always_finite(self):
+        plan = _fitted()
+        # absurd extrapolation stays a finite, orderable float
+        wild = planner.predict_secs(plan, 1 << 40)
+        assert wild is not None and math.isfinite(wild)
+        assert wild <= math.exp(5.0)
+
+    def test_plan_carries_provenance_and_overhead(self):
+        plan = _fitted()
+        assert plan["provenance"] == "measured"
+        assert plan["device_kind"] == "cpu"
+        assert plan["trained_records"] == 3
+        assert plan["overhead_secs"] == pytest.approx(1e-3)
+        assert plan["split_min_ops"] == 0
+
+
+# ---------------------------------------------------------------------------
+# plan.json persistence — snapshot protocol
+# ---------------------------------------------------------------------------
+
+class TestPersistence:
+    def test_save_load_roundtrip(self, tmp_path):
+        plan = _fitted()
+        p = tmp_path / "plan.json"
+        assert planner.save_plan(p, plan) is True
+        got = planner.load_plan(p)
+        assert got == json.loads(json.dumps(plan))
+
+    def test_missing_and_corrupt_degrade_to_none(self, tmp_path):
+        assert planner.load_plan(tmp_path / "absent.json") is None
+        p = tmp_path / "plan.json"
+        p.write_text("{corrupt")
+        assert planner.load_plan(p) is None
+
+    def test_alien_shape_degrades(self, tmp_path):
+        p = tmp_path / "plan.json"
+        p.write_text(json.dumps([1, 2, 3]))
+        assert planner.load_plan(p) is None
+        p.write_text(json.dumps({"v": 999, "modes": {}}))
+        assert planner.load_plan(p) is None
+        p.write_text(json.dumps({"v": 1, "modes": "nope"}))
+        assert planner.load_plan(p) is None
+
+    def test_refresh_persists_and_activate_warm_starts(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_PLANNER", "1")
+        plan = planner.refresh(tmp_path, _cost_records(),
+                               _search_records())
+        assert plan is not None
+        assert (tmp_path / "plan.json").is_file()
+        planner.deactivate()
+        pl = planner.activate(tmp_path)
+        assert pl is not None and pl.modeled
+        assert pl.source == "plan"
+        assert planner.current_plan() == json.loads(
+            json.dumps(plan))
+
+    def test_refresh_with_nothing_to_fit_is_a_noop(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_PLANNER", "1")
+        assert planner.refresh(tmp_path, [], []) is None
+        assert not (tmp_path / "plan.json").exists()
+
+    def test_activate_gate_off_is_none(self, tmp_path):
+        assert planner.activate(tmp_path) is None
+        assert planner.get() is None
+
+
+# ---------------------------------------------------------------------------
+# THE invariant: planner decisions never change verdicts
+# ---------------------------------------------------------------------------
+
+class TestVerdictParity:
+    def test_bucketed_sweep_parity(self, monkeypatch):
+        from jepsen_tpu import parallel
+        encs = _encs()
+        base = json.dumps(parallel.check_bucketed(encs))
+        monkeypatch.setenv("JEPSEN_TPU_PLANNER", "1")
+        planner.deactivate()   # cold
+        assert json.dumps(parallel.check_bucketed(encs)) == base
+        _install(_fitted(), monkeypatch)   # warm
+        assert json.dumps(parallel.check_bucketed(encs)) == base
+
+    def test_async_pipeline_parity(self, monkeypatch):
+        from jepsen_tpu import parallel
+        encs = _encs()
+        pv = parallel.check_bucketed_async(encs)
+        base = json.dumps(pv.result({}))
+        _install(_fitted(), monkeypatch)
+        pv = parallel.check_bucketed_async(encs)
+        assert json.dumps(pv.result({})) == base
+
+    def test_fold_dispatcher_parity(self, monkeypatch):
+        encs = _encs(4)
+        base = json.dumps(folding.FoldDispatcher().verdicts(encs))
+        monkeypatch.setenv("JEPSEN_TPU_PLANNER", "1")
+        planner.deactivate()
+        cold = json.dumps(folding.FoldDispatcher().verdicts(encs))
+        assert cold == base
+        _install(_fitted(), monkeypatch)
+        warm = json.dumps(folding.FoldDispatcher().verdicts(encs))
+        assert warm == base
+
+    def test_split_decline_keeps_subhistories_identical(
+            self, tmp_path, monkeypatch):
+        from jepsen_tpu import independent
+        ops = []
+        for i in range(20):
+            k = i % 3
+            ops.append({"type": "invoke", "process": i % 4,
+                        "f": "read", "value": [k, None]})
+            ops.append({"type": "ok", "process": i % 4,
+                        "f": "read", "value": [k, i]})
+        p = tmp_path / "h.jsonl"
+        p.write_text("\n".join(json.dumps(o) for o in ops) + "\n")
+        hist = [json.loads(ln) for ln in p.read_text().splitlines()]
+        base = independent.subhistories_path(hist, p)
+        plan = _fitted()
+        plan["split_min_ops"] = 10 ** 6   # decline native everywhere
+        pl = _install(plan, monkeypatch)
+        assert pl.split_native(len(hist)) is False
+        stats: dict = {}
+        got = independent.subhistories_path(hist, p, stats=stats)
+        assert list(got) == list(base)
+        for k in base:
+            assert got[k] == base[k]
+        assert stats.get("native", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# Routing goldens on a seeded model
+# ---------------------------------------------------------------------------
+
+def _const_mode(secs):
+    """A mode row predicting a constant `secs` at every geometry."""
+    return {"coeffs": [math.log(secs), 0.0, 0.0, 0.0], "points": 3,
+            "t_pad_min": 128, "t_pad_max": 512}
+
+
+class TestRoutingGoldens:
+    def test_fused_choice_follows_the_cheaper_strategy(
+            self, monkeypatch):
+        plan = {"v": 1, "device_kind": "cpu", "backend": "cpu",
+                "provenance": "measured", "trained_records": 6,
+                "modes": {
+                    "classify|nort|fused|xla-int8": _const_mode(1e-2),
+                    "classify|nort|twopass|xla-int8":
+                        _const_mode(1e-3)},
+                "analytics": {}, "overhead_secs": 1e-3,
+                "split_min_ops": 0}
+        pl = _install(plan, monkeypatch)
+        # two-pass modeled 10x cheaper: the default flips off
+        assert pl.fused_choice(True) is False
+        # flip the curves: fused wins
+        plan["modes"]["classify|nort|fused|xla-int8"] = \
+            _const_mode(1e-4)
+        assert pl.fused_choice(False) is True
+
+    def test_fused_choice_needs_both_strategies_measured(
+            self, monkeypatch):
+        pl = _install(_fitted(), monkeypatch)   # fused-only training
+        assert pl.fused_choice(True) is True
+        assert pl.fused_choice(False) is False
+
+    def test_admission_cost_preserves_the_cell_unit(self, monkeypatch):
+        pl = _install(_fitted(), monkeypatch)
+        # a T_pad=128 history costs exactly 128^2 cells by construction
+        assert pl.admission_cost(100) == 128 * 128
+        # and the quadratic model tracks the proxy's scale elsewhere
+        for n in (300, 1000, 4000):
+            proxy = folding.fold_cost(n)
+            got = pl.admission_cost(n)
+            assert got == pytest.approx(proxy, rel=0.1)
+            assert got >= 1
+
+    def test_plan_buckets_is_a_partition_within_budget(
+            self, monkeypatch):
+        from jepsen_tpu import parallel
+        encs = _encs(8)
+        pl = _install(_fitted(), monkeypatch)
+        budget = 1 << 22
+        got = pl.plan_buckets(encs, budget_cells=budget)
+        flat = sorted(i for b in got for i in b)
+        assert flat == list(range(len(encs)))
+        base = parallel.bucket_by_length(encs, budget_cells=budget)
+        assert len(got) <= len(base)
+
+    def test_geometry_race_prefers_fewer_dispatches_under_overhead(
+            self, monkeypatch):
+        from jepsen_tpu import parallel
+
+        class E:
+            def __init__(self, n):
+                self.n = n
+
+        encs = [E(n) for n in (100, 120, 200, 220, 450, 500)]
+        plan = _fitted()
+        # dispatch overhead dwarfs per-history cost: coarser buckets
+        # (fewer dispatches) must win the race
+        plan["overhead_secs"] = 10.0
+        pl = _install(plan, monkeypatch)
+        budget = 1 << 27
+        got = pl.plan_buckets(encs, budget_cells=budget)
+        candidates = [parallel.bucket_by_length(
+            encs, multiple=m, budget_cells=budget)
+            for m in planner.GEOMETRY_CANDIDATES]
+        assert got in candidates
+        assert len(got) == min(len(c) for c in candidates)
+
+
+# ---------------------------------------------------------------------------
+# Predicted-vs-measured honesty loop + report section
+# ---------------------------------------------------------------------------
+
+class TestScoreAndReport:
+    def test_score_against_fresh_records(self, monkeypatch):
+        pl = _install(_fitted(), monkeypatch)
+        err = pl.score_against(_cost_records())
+        assert err is not None
+        assert err["records"] == 3
+        assert 0.0 <= err["mean_rel_err"] <= err["max_rel_err"]
+        assert err["mean_rel_err"] < 0.5   # it trained on these
+        md = trace.get_current().metrics_dict()
+        assert md["counters"]["planner.pred_checked"] == 3
+        assert "planner.pred_err_permille" in md["gauges"]
+
+    def test_score_cold_or_alien_records_is_none(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_PLANNER", "1")
+        assert planner.get().score_against(_cost_records()) is None
+        pl = _install(_fitted(), monkeypatch)
+        assert pl.score_against([]) is None
+        assert pl.score_against([{"windows": {}}, "junk"]) is None
+
+    def test_section_and_markdown(self, monkeypatch):
+        pl = _install(_fitted(), monkeypatch)
+        pl.admission_cost(100)
+        pl.fused_choice(True)
+        sec = planner.planner_section(pl.plan,
+                                      cost_records=_cost_records(),
+                                      metrics=trace.get_current().metrics_dict())
+        assert sec["enabled"] and sec["modeled"]
+        assert sec["decisions"] >= 2
+        assert sec["levers"].get("admission") == 1
+        assert "classify|nort|fused|xla-int8" in sec["modes"]
+        assert sec["predicted_vs_measured"]["records"] == 3
+        md = planner.render_planner_md(sec)
+        text = "\n".join(md)
+        assert "## Cost-aware planner" in text
+        # mode keys embed literal pipes — they must arrive escaped so
+        # the markdown table keeps its column count
+        assert "classify\\|nort\\|fused\\|xla-int8" in text
+
+    def test_cold_section_renders(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_PLANNER", "1")
+        planner.get().admission_cost(64)
+        sec = planner.planner_section(None,
+                                      metrics=trace.get_current().metrics_dict())
+        assert sec["modeled"] is False
+        text = "\n".join(planner.render_planner_md(sec))
+        assert "cold start" in text
+
+
+# ---------------------------------------------------------------------------
+# Costdb cold-start ergonomics
+# ---------------------------------------------------------------------------
+
+class TestCostTable:
+    def test_missing_file_yields_typed_empty_table(self, tmp_path):
+        t = jstore.load_costdb(tmp_path / "absent.jsonl")
+        assert isinstance(t, list) and list(t) == []
+        assert t.exists is False and t.empty is True
+
+    def test_present_table_reports_itself(self, tmp_path):
+        p = tmp_path / "costdb.jsonl"
+        recs = _cost_records((128,))
+        p.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+        t = jstore.load_costdb(p)
+        assert t.exists is True and t.empty is False
+        assert len(t) == 1
+
+    def test_merge_tolerates_absent_shards(self, tmp_path):
+        from jepsen_tpu import mesh
+        base = tmp_path
+        shard0 = jstore.costdb_path(base, shard=0)
+        shard0.parent.mkdir(parents=True, exist_ok=True)
+        recs = _cost_records((128, 256))
+        shard0.write_text(
+            "\n".join(json.dumps(r) for r in recs) + "\n")
+        # shard 1 never wrote a file — merging the partial fleet works
+        merged = mesh.merge_costdbs(base, 2)
+        assert len(merged) == 2
+        assert jstore.costdb_path(base).is_file()
