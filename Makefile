@@ -9,7 +9,8 @@ TSAN_RT := $(shell gcc -print-file-name=libtsan.so)
 
 .PHONY: lint lint-json lint-changed env-table rule-table dur-table \
 	crash-smoke test native native-sanitize bench bench-report \
-	bench-warm obs-smoke serve-smoke trace-report cost-report \
+	bench-warm obs-smoke serve-smoke fleet-smoke trace-report \
+	cost-report \
 	search-report planner-report
 
 # Self-hosted static analysis: gate registry, JAX hazards, concurrency
@@ -138,6 +139,15 @@ obs-smoke:
 # streamed-vs-`analyze-store` byte-identical verdict parity. Exit 0/1.
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m jepsen_tpu.serve.smoke
+
+# Serve-fleet smoke: a REAL 3-daemon fleet behind the router, three
+# tenants streaming through `fleet.sock` while a self-nemesis schedule
+# (socket partition, SIGKILL mid-load, SIGSTOP hammer, clock-skewed
+# member via the faketime shim) breaks members underneath them. Every
+# tenant must land every verdict with zero lost/duplicated journal
+# lines, byte-identical to a post-hoc `analyze-store` sweep. Exit 0/1.
+fleet-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m jepsen_tpu.serve.fleet_smoke
 
 # Convenience: re-sweep an existing store (STORE ?= store) and emit
 # the merged trace + critical-path attribution report

@@ -20,7 +20,9 @@ numbers ride along under "knossos" with their own speedup-vs-CPU.
 Scale via env vars: BENCH_B/BENCH_T/BENCH_K (elle), BENCH_KN_B/
 BENCH_KN_OPS/BENCH_KN_CONC (knossos), BENCH_REG_RUNS/BENCH_REG_OPS/
 BENCH_REG_KEYS (register sweep), BENCH_NS_* (north star), BENCH_DP_*
-(dp scaling; BENCH_DP_CHILD=0 skips its CPU child), BENCH_REPS.
+(dp scaling; BENCH_DP_CHILD=0 skips its CPU child), BENCH_FLEET_*
+(serve fleet; BENCH_FLEET=0 skips the block — it spawns daemon
+subprocesses, so in-process harnesses opt out), BENCH_REPS.
 """
 
 from __future__ import annotations
@@ -1598,6 +1600,192 @@ def bench_serve(n_dev: int, devices) -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_fleet(n_dev: int, devices) -> dict:
+    """The serve fleet's scale-out and recovery numbers: burst the
+    same synthetic load through a 1-daemon fleet and a
+    BENCH_FLEET_DAEMONS (default 3) fleet — sustained verdict rate and
+    client-observed p99 vs daemon count, with dp_scaling's shared-core
+    convention for the efficiency (ideal = min(daemons, cores)) — then
+    SIGKILL one member mid-load on the N-daemon fleet and pin the
+    post-SIGKILL recovery latency (kill -> the victim tenant's next
+    verdict, client-observed): the bounded-failover contract as a
+    trended number, not just a smoke pass. The spill gate is pinned
+    low for the round so the burst actually spreads across members
+    instead of queueing on each tenant's affine daemon."""
+    if os.environ.get("BENCH_FLEET", "1") == "0":
+        return {"skipped": "fleet block disabled (BENCH_FLEET=0)"}
+
+    import shutil
+    import signal as _signal
+    import tempfile
+    import threading
+
+    from jepsen_tpu import trace as jtrace
+    from jepsen_tpu.checker.elle.synth import write_synth_store
+    from jepsen_tpu.serve.client import ServeClient
+    from jepsen_tpu.serve.fleet import FleetRouter
+    from jepsen_tpu.store import Store
+
+    accel = _accel(devices)
+    B = int(os.environ.get("BENCH_FLEET_B", 48 if accel else 18))
+    T = int(os.environ.get("BENCH_FLEET_T", 256))
+    K = int(os.environ.get("BENCH_FLEET_K", 16))
+    N = int(os.environ.get("BENCH_FLEET_DAEMONS", 3))
+    TEN = 3
+    root = Path(tempfile.mkdtemp(prefix="bench-fleet-"))
+    tr_prev = jtrace.get_current()
+    spill_prev = os.environ.get("JEPSEN_TPU_FLEET_SPILL_DEPTH")
+    os.environ["JEPSEN_TPU_FLEET_SPILL_DEPTH"] = "2"
+    router = None
+
+    def burst(sock, shares, prefix):
+        """Closed-loop burst: every tenant submits its whole share at
+        once, then collects. Returns (span_secs, sorted lat_ms,
+        clients)."""
+        clients: list = [None] * len(shares)
+        errs: list = []
+
+        def run(i: int) -> None:
+            try:
+                c = ServeClient(socket_path=sock, tenant=f"fleet{i}",
+                                timeout=1200)
+                c.connect(retry=True)
+                clients[i] = c
+                for j, d in enumerate(shares[i]):
+                    c.check_dir(d, rid=f"{prefix}:{i}:{j}")
+                c.collect(timeout=1200, reconnect=True)
+            except Exception as e:
+                errs.append(repr(e)[:200])
+
+        ths = [threading.Thread(target=run, args=(i,))
+               for i in range(len(shares))]
+        t0 = time.monotonic()
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join(timeout=1800)
+        if errs:
+            raise RuntimeError(f"fleet load generator failed: {errs}")
+        last = max(max(c.done_at.values()) for c in clients
+                   if c is not None and c.done_at)
+        lat = sorted((c.done_at[r] - c.sent_at[r]) * 1000.0
+                     for c in clients if c is not None
+                     for r in c.done_at if r in c.sent_at)
+        return max(last - t0, 1e-6), lat, clients
+
+    def pct(lat: list, p: float) -> float:
+        if not lat:
+            return 0.0
+        k = min(len(lat) - 1, int(p * (len(lat) - 1) + 0.5))
+        return round(lat[k], 1)
+
+    try:
+        phases = {}
+        for name, daemons in (("d1", 1), ("dn", N)):
+            store = root / f"store-{name}"
+            (store / "synth").mkdir(parents=True)
+            write_synth_store(store / "synth", B, T, K, 8)
+            dirs = sorted(Store(store).iter_run_dirs())
+            shares = [dirs[i::TEN] for i in range(TEN)]
+            router = FleetRouter(Store(store),
+                                 daemons=daemons).start()
+            sock = router.ready_info()["fleet"]["socket"]
+            span, lat, clients = burst(sock, shares, name)
+            for c in clients:
+                if c is not None:
+                    c.close()
+            phases[name] = {"span": span, "lat": lat}
+            if name == "d1":
+                router.stop()
+                router = None
+            else:
+                # recovery round on the still-warm N-daemon fleet:
+                # resubmit under fresh ids, kill the victim tenant's
+                # affine member the instant the load is in flight
+                recovery_ms = None
+                rc_clients: list = [None] * TEN
+                rerrs: list = []
+
+                def rerun(i: int) -> None:
+                    try:
+                        c = ServeClient(socket_path=sock,
+                                        tenant=f"fleet{i}",
+                                        timeout=1200)
+                        c.connect(retry=True)
+                        rc_clients[i] = c
+                        for j, d in enumerate(shares[i]):
+                            c.check_dir(d, rid=f"r2:{i}:{j}")
+                        c.collect(timeout=1200, reconnect=True)
+                    except Exception as e:
+                        rerrs.append(repr(e)[:200])
+
+                ths = [threading.Thread(target=rerun, args=(i,))
+                       for i in range(TEN)]
+                for th in ths:
+                    th.start()
+                victim = router._affine("fleet0",
+                                        router._live_members())
+                t_kill = time.monotonic()
+                try:
+                    os.kill(victim.current_pid(), _signal.SIGKILL)
+                except OSError:
+                    pass
+                for th in ths:
+                    th.join(timeout=1800)
+                if rerrs:
+                    raise RuntimeError(
+                        f"fleet recovery round failed: {rerrs}")
+                c0 = rc_clients[0]
+                after = [t for t in c0.done_at.values()
+                         if t > t_kill] if c0 is not None else []
+                if after:
+                    recovery_ms = round(
+                        (min(after) - t_kill) * 1000.0, 1)
+                for c in rc_clients:
+                    if c is not None:
+                        c.close()
+        tr = jtrace.get_current()   # the N-daemon router's tracer
+        md = tr.metrics_dict() if getattr(tr, "enabled", False) else {}
+        c_ = md.get("counters", {})
+        rc = router.stop()
+        router = None
+        rate1 = round(B / phases["d1"]["span"], 2)
+        rate_n = round(B / phases["dn"]["span"], 2)
+        ideal = min(N, os.cpu_count() or 1)
+        return {
+            "metric": f"fleet verdicts/sec ({B}x{T}-txn, {N} daemons, "
+                      f"{TEN} tenants, burst)",
+            "value": rate_n,
+            "unit": "histories/sec",
+            "daemons": N,
+            "rate_1": rate1,
+            "rate_n": rate_n,
+            "speedup": round(rate_n / max(rate1, 1e-6), 3),
+            "ideal": ideal,
+            "scaling_efficiency": round(
+                rate_n / max(rate1, 1e-6) / ideal, 3),
+            "p99_ms_1": pct(phases["d1"]["lat"], 0.99),
+            "p99_ms_n": pct(phases["dn"]["lat"], 0.99),
+            "recovery_ms": recovery_ms,
+            "failovers": c_.get("fleet_failovers", 0),
+            "replayed_verdicts": c_.get("fleet_replayed_verdicts", 0),
+            "spills": c_.get("fleet_spills", 0),
+            "drain_rc": rc,
+        }
+    finally:
+        if router is not None:
+            try:
+                router.stop()
+            except Exception:
+                pass
+        if spill_prev is None:
+            os.environ.pop("JEPSEN_TPU_FLEET_SPILL_DEPTH", None)
+        else:
+            os.environ["JEPSEN_TPU_FLEET_SPILL_DEPTH"] = spill_prev
+        jtrace.set_current(tr_prev)
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def run_benches() -> int:
     """The child-process body: probe-guarded device init, then every
     bench phase, one JSON line out. Any failure still reports."""
@@ -1651,6 +1839,7 @@ def run_benches() -> int:
             ("dp_scaling", bench_dp_scaling, (n_dev, devices)),
             ("mesh", bench_mesh, (n_dev, devices)),
             ("serve", bench_serve, (n_dev, devices)),
+            ("fleet", bench_fleet, (n_dev, devices)),
             ("search", bench_search, (n_dev, devices)),
             ("planner", bench_planner, (n_dev, devices)),
             ("generator", bench_generator, (reps,))):
@@ -1726,8 +1915,8 @@ def main() -> int:
                       + " | ".join(tail))[:400]
 
     blocks = ("knossos", "long_history", "end_to_end", "register_sweep",
-              "north_star", "dp_scaling", "mesh", "serve", "search",
-              "planner", "generator")
+              "north_star", "dp_scaling", "mesh", "serve", "fleet",
+              "search", "planner", "generator")
     cpu_env = {"JEPSEN_TPU_PLATFORM": "cpu", "JAX_PLATFORMS": "cpu",
                "BENCH_ATTEMPT": "cpu-retry"}
 
@@ -1770,7 +1959,9 @@ def main() -> int:
                 if isinstance(blk, dict) and not blk.get("error"):
                     out[b] = {**blk, "backend": "cpu",
                               "tpu_error": tpu_err}
-    out["lint"] = _lint_block()
+    out["lint"] = _lint_block() \
+        if os.environ.get("BENCH_LINT", "1") != "0" \
+        else {"skipped": "lint block disabled (BENCH_LINT=0)"}
     print(json.dumps(out))
     return 0
 

@@ -239,7 +239,37 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
     p_serve.add_argument("--web", action="store_true",
                          help="serve the legacy HTTP store browser "
                               "instead of the verdict daemon")
+    p_serve.add_argument("--fleet-instance", type=int, default=None,
+                         help="run as member <k> of a serve fleet "
+                              "(the `fleet` subcommand spawns these): "
+                              "bind fleet-d<k>.sock, heartbeat the "
+                              "fleet-d<k>.json beacon, honor the "
+                              "epoch fence")
+    p_serve.add_argument("--fleet-epoch", type=int, default=None,
+                         help="the membership epoch this member was "
+                              "started under (the fleet router sets "
+                              "it)")
     add_trace_opts(p_serve)
+
+    p_fleet = sub.add_parser(
+        "fleet",
+        help="run N verdict daemons behind a fault-tolerant router: "
+             "tenants connect to one fleet socket; the router "
+             "hash-affines them to daemons, spills on backpressure, "
+             "and on a daemon death replays its tenants' journals on "
+             "a successor (zero lost or duplicated verdicts)")
+    p_fleet.add_argument("--store", default="store")
+    p_fleet.add_argument("--daemons", type=int, default=3,
+                         help="fleet size (default 3)")
+    p_fleet.add_argument("--socket", default=None,
+                         help="router socket path (default "
+                              "<store>/fleet.sock)")
+    p_fleet.add_argument("--no-stonith", action="store_true",
+                         help="skip the router's best-effort SIGKILL "
+                              "of a daemon it declares dead (nemesis "
+                              "harnesses that manage the process "
+                              "themselves set this)")
+    add_trace_opts(p_fleet)
 
     from . import lint as _lint   # stdlib-only, import-cheap
     p_lint = sub.add_parser(
@@ -370,7 +400,14 @@ def run_cli(test_fn: Callable[[dict, argparse.Namespace], dict],
             return run_daemon(Store(args.store),
                               socket_path=args.socket, port=args.port,
                               host=args.host or "127.0.0.1",
-                              drain_s=args.drain_timeout)
+                              drain_s=args.drain_timeout,
+                              fleet_instance=args.fleet_instance,
+                              fleet_epoch=args.fleet_epoch)
+        if args.command == "fleet":
+            from .serve.fleet import run_fleet
+            return run_fleet(Store(args.store), daemons=args.daemons,
+                             socket_path=args.socket,
+                             stonith=not args.no_stonith)
         return 254
     except KeyboardInterrupt:
         return 255

@@ -394,6 +394,59 @@ STORE_ARTIFACTS: tuple[StoreArtifact, ...] = (
         doc="the daemon's pid + listen address, published atomically "
             "(temp+`os.replace`), removed at drain"),
     StoreArtifact(
+        "fleet member beacon", ("fleet-d*.json",), "snapshot",
+        writers=("jepsen_tpu/serve/daemon.py:"
+                 "VerdictDaemon._write_beacon",),
+        readers=("jepsen_tpu/serve/fleet.py:"
+                 "FleetRouter._wait_member_live",
+                 "jepsen_tpu/serve/fleet.py:FleetRouter._scan"),
+        retention="replaced",
+        helpers=("fleet_member_path",),
+        doc="one fleet daemon's heartbeat (pid/epoch/load), "
+            "atomically replaced every JEPSEN_TPU_FLEET_HEARTBEAT_S; "
+            "the router reads liveness off the kernel mtime (clock-"
+            "skew immune) and load off the payload; retired at clean "
+            "drain, left to go stale by a crash"),
+    StoreArtifact(
+        "fleet epoch marker", ("fleet-epoch.json",), "snapshot",
+        writers=("jepsen_tpu/serve/fleet.py:FleetRouter._write_epoch",),
+        readers=("jepsen_tpu/serve/daemon.py:VerdictDaemon._fenced",),
+        retention="replaced",
+        helpers=("fleet_epoch_path",),
+        doc="the fleet membership epoch (atomic replace), bumped "
+            "BEFORE any tenant reassignment — the fence a resurrected "
+            "zombie daemon checks between a fold's compute and its "
+            "journal writes, so it can never double-serve a "
+            "reassigned tenant"),
+    StoreArtifact(
+        "fleet reassignment journal", ("fleet-reassign.jsonl",),
+        "journal",
+        writers=("jepsen_tpu/serve/fleet.py:"
+                 "FleetRouter._append_reassign",),
+        readers=("jepsen_tpu/serve/fleet.py:load_reassignments",),
+        retention="per-sweep",
+        helpers=("fleet_reassign_path",),
+        doc="one line per failover move (epoch, dead member, tenant, "
+            "successor, in-flight count) — the router's reassignment "
+            "evidence for post-mortems; cleared at router start"),
+    StoreArtifact(
+        "fleet router socket", ("fleet.sock",), "marker",
+        writers=("jepsen_tpu/serve/fleet.py:FleetRouter._bind",),
+        readers=(),
+        retention="per-sweep",
+        helpers=("fleet_socket_path",),
+        doc="the router's tenant-facing unix listen socket; a stale "
+            "one is probe-reclaimed at bind, removed at stop"),
+    StoreArtifact(
+        "fleet daemon socket", ("fleet-d*.sock",), "marker",
+        writers=("jepsen_tpu/serve/daemon.py:VerdictDaemon._bind",),
+        readers=(),
+        retention="per-sweep",
+        helpers=("fleet_daemon_socket_path",),
+        doc="fleet daemon <k>'s own listen socket (the router proxies "
+            "tenant frames to it here); same probe-reclaim rule as "
+            "serve.sock"),
+    StoreArtifact(
         "dispatch plan", ("plan.json",), "snapshot",
         writers=("jepsen_tpu/planner.py:save_plan",),
         readers=("jepsen_tpu/planner.py:load_plan",),

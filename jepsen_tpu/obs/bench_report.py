@@ -127,6 +127,16 @@ METRICS: tuple[MetricSpec, ...] = (
                ("serve", "value"), True, 0.30),
     MetricSpec("serve_p99_ms", "serve p99 verdict latency (ms)",
                ("serve", "p99_ms"), False, 0.50, ceiling=30_000.0),
+    # the serve fleet: N-daemon burst throughput (rate vs daemon
+    # count; the scale-OUT counterpart of serve_rate) and the
+    # post-SIGKILL recovery latency — the bounded-failover contract
+    # trended per round. The 30 s ceiling is the declared bound: a
+    # failover that stalls a tenant past it broke the contract no
+    # matter what the predecessor round did.
+    MetricSpec("fleet_rate", "fleet N-daemon verdicts/sec",
+               ("fleet", "value"), True, 0.30),
+    MetricSpec("fleet_recovery_ms", "fleet post-SIGKILL recovery (ms)",
+               ("fleet", "recovery_ms"), False, 1.0, ceiling=30_000.0),
     # the device cost observatory's roofline number: XLA-modeled bytes
     # accessed over measured device seconds, as a share of the
     # peak-table HBM bandwidth. Estimated-provenance rounds (CPU-only

@@ -73,6 +73,20 @@ EVENT_KINDS = frozenset({
     "serve_drain",        # pending, reason (SIGTERM/stop: admission
     #                       closed, queued work finishing)
     "serve_stop",         # verdicts, drained (daemon exit)
+    # -- the fleet_* group: the serve-fleet router's lifecycle -----------
+    "fleet_start",        # daemons, socket, epoch (router accepting)
+    "fleet_daemon_up",    # instance, pid (beacon observed live)
+    "fleet_daemon_dead",  # instance, cause (beacon stale / conn
+    #                       refused / process exit — fenced next)
+    "fleet_failover",     # instance, successor, tenants, epoch (dead
+    #                       daemon's tenants reassigned; journals
+    #                       replay on the successor)
+    "fleet_spill",        # tenant, affine, chosen, depth (backpressure
+    #                       routed a check off its affine daemon)
+    "fleet_fence",        # instance, epoch (a fenced daemon observed
+    #                       its own death mark and dropped a fold
+    #                       instead of double-serving — zombie fence)
+    "fleet_stop",         # verdicts, daemons (router exit)
 })
 
 _lock = threading.Lock()

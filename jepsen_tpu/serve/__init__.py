@@ -30,7 +30,17 @@ the complexity bounds of arxiv 1908.04509. Four pieces:
     section in health.json + `serve_*` flight-recorder events.
   * `client` — the tenant-side library the tests, the bench's open-
     loop load generator and `make serve-smoke` drive the real socket
-    with.
+    with. Retries are BOUNDED: exponential backoff with jitter, and a
+    terminal `ServeUnavailable` once JEPSEN_TPU_SERVE_RETRY_S passes
+    without progress — the client half of the failover contract.
+  * `fleet` — `jepsen-tpu fleet`: N daemons (each `--fleet-instance
+    k`, own socket + beacon) behind a thin frame-proxy router that
+    hash-affines tenants via `store.shard_of`, spills to the least-
+    loaded member on backpressure, declares a member dead on beacon
+    staleness + connection failure, fences it out of the membership
+    epoch, and replays its tenants' journals on a successor — zero
+    lost or duplicated verdicts across a SIGKILL (`make fleet-smoke`
+    proves it under a self-nemesis schedule).
 
 `analyze-store` remains the batch path; the daemon is the streaming
 one — both render verdicts through the same kernels and the same

@@ -6,22 +6,46 @@ explicit flow control for the caller — `retry-after` frames are
 honored by re-sending after the daemon's delay hint (bounded), so
 `collect` returns exactly one verdict per submitted id or raises.
 
-The bench's open-loop load generator, `make serve-smoke` and the
-crash/restart tests all drive the REAL socket through this class —
-there is no in-process shortcut to accidentally test instead.
+Retries are BOUNDED (the client half of the fleet failover contract):
+backpressure resends and reconnects back off exponentially with
+jitter, and once JEPSEN_TPU_SERVE_RETRY_S passes without progress (a
+verdict landing, a connection succeeding) the client raises the
+terminal `ServeUnavailable` instead of spinning forever against a
+permanently dead endpoint. A router failover therefore shows up to a
+tenant as at most a bounded stall, and a real outage as a clean error.
+
+The bench's open-loop load generator, `make serve-smoke`/`fleet-smoke`
+and the crash/restart tests all drive the REAL socket through this
+class — there is no in-process shortcut to accidentally test instead.
 """
 
 from __future__ import annotations
 
+import random
 import socket
 import threading
 import time
 
+from .. import gates
 from . import protocol
 
 
 class ServeError(RuntimeError):
     pass
+
+
+class ServeUnavailable(ServeError):
+    """Terminal: the endpoint stayed unreachable or backpressured past
+    JEPSEN_TPU_SERVE_RETRY_S without any progress. The caller's move
+    is a fresh connection (possibly to a different endpoint), not
+    another resend on this one."""
+
+
+def retry_budget_s() -> float:
+    """The JEPSEN_TPU_SERVE_RETRY_S no-progress budget (seconds; `0`
+    fails on the first retryable condition)."""
+    v = gates.get("JEPSEN_TPU_SERVE_RETRY_S")
+    return max(0.0, float(v)) if v is not None else 60.0
 
 
 class ServeClient:
@@ -52,7 +76,7 @@ class ServeClient:
 
     # -- connection --------------------------------------------------------
 
-    def connect(self) -> dict:
+    def _connect_once(self) -> dict:
         if self.port is not None:
             s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             s.settimeout(self.timeout)
@@ -71,6 +95,27 @@ class ServeClient:
             raise ServeError(f"expected welcome, got {w!r}")
         self.welcome = w
         return w
+
+    def connect(self, retry: bool = False) -> dict:
+        """Connect + hello. With `retry`, a refused/failed connect
+        backs off exponentially (with jitter) and keeps trying until
+        JEPSEN_TPU_SERVE_RETRY_S passes without success — then the
+        terminal ServeUnavailable."""
+        if not retry:
+            return self._connect_once()
+        budget = retry_budget_s()
+        t0 = time.monotonic()
+        attempt = 0
+        while True:
+            try:
+                return self._connect_once()
+            except (OSError, ServeError):
+                if time.monotonic() - t0 > budget:
+                    raise ServeUnavailable(
+                        f"endpoint unreachable for {budget:.1f}s "
+                        "(JEPSEN_TPU_SERVE_RETRY_S)") from None
+                self._backoff_sleep(attempt)
+                attempt += 1
 
     def close(self) -> None:
         if self.sock is not None:
@@ -137,29 +182,91 @@ class ServeClient:
     def recv(self) -> dict | None:
         return protocol.recv_frame(self.sock)
 
+    def _backoff_sleep(self, attempt: int, hint: float | None = None,
+                       deadline: float | None = None) -> None:
+        """Exponential backoff with jitter: the daemon's delay hint is
+        the floor, doubling per attempt since last progress, capped —
+        a thundering herd of retrying tenants decorrelates instead of
+        hammering a recovering daemon in lockstep."""
+        delay = min(5.0, max(float(hint or 0.0),
+                             0.05 * (2 ** min(attempt, 7))))
+        delay *= random.uniform(0.5, 1.0)
+        if deadline is not None:
+            delay = min(delay, max(0.0, deadline - time.monotonic()))
+        time.sleep(delay)
+
+    def _reconnect(self, last_progress: float, budget: float,
+                   deadline: float | None) -> None:
+        """Bounded reconnect for `collect(reconnect=True)`: back off
+        until the endpoint answers (a restarted daemon, a router past
+        its failover), then re-send every outstanding id — journaled
+        verdicts replay, the rest re-check."""
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        attempt = 0
+        while True:
+            if time.monotonic() - last_progress > budget:
+                raise ServeUnavailable(
+                    f"endpoint unreachable for {budget:.1f}s "
+                    "(JEPSEN_TPU_SERVE_RETRY_S) with "
+                    f"{len(self._inflight)} outstanding")
+            if deadline is not None and time.monotonic() > deadline:
+                raise ServeError(
+                    f"collect timed out with {len(self._inflight)} "
+                    "verdict(s) outstanding")
+            self._backoff_sleep(attempt, deadline=deadline)
+            attempt += 1
+            try:
+                self._connect_once()
+                break
+            except (OSError, ServeError):
+                continue
+        for pend in list(self._inflight.values()):
+            with self._slock:
+                protocol.send_frame(self.sock, pend)
+
     def collect(self, timeout: float | None = None,
                 max_retries: int = 100,
-                expect: int | None = None) -> dict[str, dict]:
+                expect: int | None = None,
+                reconnect: bool = False) -> dict[str, dict]:
         """Drain the socket until every submitted id has a verdict.
-        `retry-after` frames re-submit after the daemon's delay hint
-        (up to `max_retries` total); a `draining` retry-after keeps
-        retrying too — after a restart the new daemon replays from the
-        journal. With `expect`, keep collecting until that many TOTAL
-        verdicts have landed — the open-loop generator's collector
-        thread starts before the first submission, when the in-flight
-        set is still empty. Returns {id: result}."""
+        `retry-after` frames re-submit after a jittered exponential
+        backoff floored at the daemon's delay hint (up to
+        `max_retries` total); a `draining` retry-after keeps retrying
+        too — after a restart the new daemon replays from the journal.
+        With `reconnect`, a closed connection is retried the same way
+        (outstanding ids are re-sent after the new welcome) instead of
+        raising. Either way, JEPSEN_TPU_SERVE_RETRY_S without progress
+        is terminal: ServeUnavailable. With `expect`, keep collecting
+        until that many TOTAL verdicts have landed — the open-loop
+        generator's collector thread starts before the first
+        submission, when the in-flight set is still empty. Returns
+        {id: result}."""
         deadline = None if timeout is None \
             else time.monotonic() + timeout
+        budget = retry_budget_s()
+        last_progress = time.monotonic()
+        attempts = 0     # retryable conditions since last progress
         while self._inflight or (expect is not None
                                  and len(self.verdicts) < expect):
             if deadline is not None and time.monotonic() > deadline:
                 raise ServeError(
                     f"collect timed out with {len(self._inflight)} "
                     f"verdict(s) outstanding")
-            frame = self.recv()
+            try:
+                frame = self.recv()
+            except OSError:
+                frame = None
             if frame is None:
-                raise ServeError("daemon closed the connection with "
-                                 f"{len(self._inflight)} outstanding")
+                if not reconnect:
+                    raise ServeError(
+                        "daemon closed the connection with "
+                        f"{len(self._inflight)} outstanding")
+                self._reconnect(last_progress, budget, deadline)
+                attempts += 1
+                continue
             op = frame.get("op")
             if op == "verdict":
                 rid = frame.get("id")
@@ -168,6 +275,8 @@ class ServeClient:
                 self.done_at[rid] = time.monotonic()
                 if frame.get("replay"):
                     self.replays += 1
+                last_progress = time.monotonic()
+                attempts = 0
             elif op == "retry-after":
                 rid = frame.get("id")
                 pend = self._inflight.get(rid)
@@ -175,9 +284,16 @@ class ServeClient:
                     continue
                 if self.retries >= max_retries:
                     raise ServeError("retry budget exhausted")
+                if time.monotonic() - last_progress > budget:
+                    raise ServeUnavailable(
+                        f"no progress in {budget:.1f}s "
+                        "(JEPSEN_TPU_SERVE_RETRY_S) with "
+                        f"{len(self._inflight)} outstanding")
                 self.retries += 1
-                time.sleep(min(float(frame.get("delay_s") or 0.2),
-                               2.0))
+                self._backoff_sleep(
+                    attempts, hint=float(frame.get("delay_s") or 0.2),
+                    deadline=deadline)
+                attempts += 1
                 with self._slock:
                     protocol.send_frame(self.sock, pend)
             elif op == "error":

@@ -34,6 +34,15 @@ per-tenant `serve.<tenant>.*` series, a `serve` section in
 JEPSEN_TPU_HEALTH_INTERVAL_S overrides), `serve_*` flight-recorder
 events, and a `serve_request` span per verdict on the trace fabric's
 `serve` track.
+
+Fleet mode (`fleet_instance` set — see `serve.fleet`): the daemon is
+one member of a `jepsen-tpu fleet`. It binds `fleet-d<k>.sock`,
+heartbeats an atomic `fleet-d<k>.json` beacon instead of `serve.pid`,
+honors the router's `adopt` frames (reload a reassigned tenant's
+journal index from disk), and checks the `fleet-epoch.json` fence
+between a fold's compute and its journal writes — a zombie member
+resurrected after the router fenced it drops the fold unjournaled
+rather than double-serving a reassigned tenant.
 """
 
 from __future__ import annotations
@@ -167,7 +176,9 @@ class VerdictDaemon:
                  max_fold: int = scheduler.DEFAULT_MAX_FOLD,
                  weights: dict | None = None,
                  max_queue: int | None = None,
-                 drain_s: float | None = None):
+                 drain_s: float | None = None,
+                 fleet_instance: int | None = None,
+                 fleet_epoch: int | None = None):
         self.store = store
         self.socket_path = socket_path
         self.port = port
@@ -175,6 +186,13 @@ class VerdictDaemon:
         self.budget_cells = budget_cells
         self.max_fold = max_fold
         self.drain_s = drain_s
+        #: fleet membership: set => this daemon is one member of a
+        #: `jepsen-tpu fleet` (beacon heartbeats, epoch fence, adopt);
+        #: None => the standalone PR-14 daemon, byte-for-byte unchanged
+        self.fleet_instance = fleet_instance
+        self.fleet_epoch = fleet_epoch if fleet_epoch is not None else 0
+        self._fence_stat: tuple | None = None
+        self._fence_data: dict = {}
         self.admission = scheduler.Admission(weights=weights,
                                              max_queue=max_queue)
         self._tenants: dict[str, dict] = {}
@@ -215,19 +233,30 @@ class VerdictDaemon:
         # the T_pad² proxy; gate off (or no plan.json yet) is a no-op
         from .. import planner as planner_mod
         planner_mod.activate(base)
-        self._spool = RequestSpool(base)
+        if self.fleet_instance is None:
+            self._spool = RequestSpool(base)
+        else:
+            # fleet members share ONE store: a member starting must
+            # not truncate the spool its peers are appending to. The
+            # spool is crash triage, not replay — fleet triage reads
+            # the router's reassignment journal instead.
+            self._spool = None
         self._bind()
-        trace.atomic_write_text(
-            store_mod.serve_pid_path(base),
-            json.dumps({"pid": os.getpid(),
-                        "listen": self._listen_desc}))
+        if self.fleet_instance is None:
+            trace.atomic_write_text(
+                store_mod.serve_pid_path(base),
+                json.dumps({"pid": os.getpid(),
+                            "listen": self._listen_desc}))
         # the daemon is a service: health sampling defaults ON (5 s)
         # — an unset gate means "daemon default", an explicit <=0
-        # disables, any other value overrides the interval
+        # disables, any other value overrides the interval. A FLEET
+        # member defaults OFF: N daemons share one store, and the
+        # router owns the single health.json writer (its `fleet`
+        # section subsumes the per-daemon serve sections).
         interval = obs_health.health_interval_s()
         if interval is None \
                 and not gates.is_set("JEPSEN_TPU_HEALTH_INTERVAL_S"):
-            interval = 5.0
+            interval = 5.0 if self.fleet_instance is None else None
         if interval:
             self._sampler = obs_health.HealthSampler(
                 base, interval, extra_fn=self._serve_section).start()
@@ -236,6 +265,15 @@ class VerdictDaemon:
                        if self._sampler is not None else None))
         obs_events.emit("serve_start", listen=self._listen_desc,
                         store=str(base))
+        if self.fleet_instance is not None:
+            # first beacon synchronously (the router's spawn wait sees
+            # the member the moment the ready line prints), then the
+            # heartbeat thread keeps the kernel mtime fresh
+            self._write_beacon(trace.get_current())
+            bt = threading.Thread(target=self._beacon_loop,
+                                  name="fleet-beacon", daemon=True)
+            bt.start()
+            self._threads.append(bt)
         acc = threading.Thread(target=self._accept_loop,
                                name="serve-accept", daemon=True)
         acc.start()
@@ -249,7 +287,7 @@ class VerdictDaemon:
 
     def ready_info(self) -> dict:
         """The machine-readable ready line (`run_daemon` prints it)."""
-        return {"serve": {
+        info = {
             "listen": self._listen_desc,
             "socket": (str(self._resolved_socket())
                        if self.port is None else None),
@@ -257,7 +295,11 @@ class VerdictDaemon:
             "pid": os.getpid(),
             "metrics_port": (self._metrics.port
                              if self._metrics is not None else None),
-            "store": str(self.store.base)}}
+            "store": str(self.store.base)}
+        if self.fleet_instance is not None:
+            info["fleet_instance"] = self.fleet_instance
+            info["fleet_epoch"] = self.fleet_epoch
+        return {"serve": info}
 
     def request_drain(self, reason: str = "stop") -> None:
         """Close admission and let queued work finish (bounded by
@@ -328,7 +370,14 @@ class VerdictDaemon:
         from .. import obs
         obs.reset_events()
         base = Path(self.store.base)
-        for p in (store_mod.serve_pid_path(base),):
+        if self.fleet_instance is None:
+            markers = (store_mod.serve_pid_path(base),)
+        else:
+            # a cleanly-exiting member retires its beacon; a SIGKILLed
+            # one leaves it to go stale — the router's death evidence
+            markers = (store_mod.fleet_member_path(
+                base, self.fleet_instance),)
+        for p in markers:
             try:
                 p.unlink(missing_ok=True)
             except OSError:
@@ -343,8 +392,12 @@ class VerdictDaemon:
 
     def _resolved_socket(self) -> Path:
         p = self.socket_path or gates.get("JEPSEN_TPU_SERVE_SOCKET")
-        return Path(p) if p else store_mod.serve_socket_path(
-            self.store.base)
+        if p:
+            return Path(p)
+        if self.fleet_instance is not None:
+            return store_mod.fleet_daemon_socket_path(
+                self.store.base, self.fleet_instance)
+        return store_mod.serve_socket_path(self.store.base)
 
     def _bind(self) -> None:
         if self.port is None:
@@ -420,6 +473,8 @@ class VerdictDaemon:
                     self._on_hello(conn, frame)
                 elif op == "check":
                     self._on_check(conn, frame)
+                elif op == "adopt":
+                    self._on_adopt(conn, frame)
                 elif op == "bye":
                     return
                 else:
@@ -445,6 +500,33 @@ class VerdictDaemon:
                        "verdicts": 0}
                 self._tenants[tenant] = ent
             return ent
+
+    def _on_adopt(self, conn: _Conn, frame: dict) -> None:
+        """Fleet failover: the router hands this daemon a dead peer's
+        tenant. Reload the tenant's journal index FROM DISK — the dead
+        peer appended verdicts after this daemon (maybe) first loaded
+        it, and those must replay byte-identically, not re-check.
+        In-order frame processing on this stream is the ordering
+        guarantee: the router pipelines the resent checks right behind
+        this frame, so no reply is needed."""
+        tenant = str(frame.get("tenant") or "")
+        if not tenant:
+            conn.send({"op": "error", "error": "adopt names no tenant"})
+            return
+        p = store_mod.tenant_journal_path(self.store.base, tenant)
+        idx = store_mod.VerdictJournal.load(p)
+        with self._jlock:
+            ent = self._tenants.get(tenant)
+            if ent is None:
+                self._tenants[tenant] = {
+                    "journal": store_mod.VerdictJournal(p),
+                    "index": idx, "verdicts": 0}
+            else:
+                # keep verdicts this daemon journaled itself that the
+                # on-disk read may have raced past
+                merged = dict(idx)
+                merged.update(ent["index"])
+                ent["index"] = merged
 
     def _on_hello(self, conn: _Conn, frame: dict) -> None:
         tenant = str(frame.get("tenant") or "") or "default"
@@ -531,7 +613,8 @@ class VerdictDaemon:
                 return
             self._send_backpressure(conn, rid, tr)
             return
-        self._spool.append(conn.tenant, rid, checker)
+        if self._spool is not None:
+            self._spool.append(conn.tenant, rid, checker)
         slug = store_mod.safe_tenant(conn.tenant)
         tr.gauge(f"serve.{slug}.queue_depth").set(
             self.admission.depth(conn.tenant))
@@ -635,6 +718,22 @@ class VerdictDaemon:
                     [r.enc for r in picked], checker)
         tr.counter("serve_folds").inc()
         tr.histogram("serve_fold_histories").observe(len(picked))
+        if self._fenced():
+            # the zombie fence: this member was declared dead and its
+            # tenants reassigned while the fold ran (SIGSTOP-resume,
+            # partition heal). Journaling now would DUPLICATE lines the
+            # successor is already writing for the same ids — drop the
+            # whole fold unjournaled and unacked (the router already
+            # replayed/re-checked these on the successor) and drain.
+            tr.counter("fleet_fences").inc()
+            obs_events.emit("fleet_fence", instance=self.fleet_instance,
+                            epoch=self._fence_data.get("epoch"),
+                            histories=len(picked))
+            log.warning("fenced at epoch %s: dropping a %d-history "
+                        "fold unjournaled and draining",
+                        self._fence_data.get("epoch"), len(picked))
+            self.request_drain("fenced")
+            return
         for k, (r, res) in enumerate(zip(picked, results)):
             stats = souts[k] if souts is not None \
                 and k < len(souts) else None
@@ -684,6 +783,72 @@ class VerdictDaemon:
                 self.admission.depth(t))
         tr.gauge("serve_pending").set(self.admission.pending())
 
+    # -- fleet membership --------------------------------------------------
+
+    def _fenced(self) -> bool:
+        """Is this member marked dead in the epoch marker? Checked
+        between a fold's compute and its journal writes — the last
+        possible moment a resurrected zombie can be stopped before it
+        double-serves a reassigned tenant. The marker re-parses only
+        on an mtime/size change (one stat per fold otherwise)."""
+        if self.fleet_instance is None:
+            return False
+        p = store_mod.fleet_epoch_path(self.store.base)
+        try:
+            st = p.stat()
+        except OSError:
+            return False
+        key = (st.st_mtime_ns, st.st_size)
+        if key != self._fence_stat:
+            try:
+                data = json.loads(p.read_text())
+            except (OSError, json.JSONDecodeError):
+                # a marker mid-replace reads clean or not at all
+                # (atomic_write_text), but stay conservative
+                return False
+            self._fence_stat = key
+            self._fence_data = data if isinstance(data, dict) else {}
+        m = self._fence_data.get("members", {})
+        ent = m.get(str(self.fleet_instance))
+        return bool(ent and ent.get("status") == "dead")
+
+    def _write_beacon(self, tr, seq: int = 0) -> None:
+        """One atomic beacon rewrite. The router reads LIVENESS off
+        the file's kernel-set mtime (a faketime-skewed member cannot
+        lie about its own freshness) and LOAD off the payload."""
+        try:
+            hbm = int(getattr(tr.gauge("hbm_modeled_bytes"), "value",
+                              0) or 0)
+        except Exception:
+            hbm = 0
+        beacon = {"instance": self.fleet_instance,
+                  "pid": os.getpid(),
+                  "epoch": self.fleet_epoch,
+                  "listen": self._listen_desc,
+                  "seq": seq,
+                  "queue_depth": self.admission.pending(),
+                  "hbm_modeled_bytes": hbm,
+                  "draining": self._draining.is_set(),
+                  "t_wall": round(time.time(), 6)}
+        try:
+            trace.atomic_write_text(
+                store_mod.fleet_member_path(self.store.base,
+                                            self.fleet_instance),
+                json.dumps(beacon))
+        except OSError:
+            log.debug("beacon write failed", exc_info=True)
+
+    def _beacon_loop(self) -> None:
+        tr = trace.get_current()
+        seq = 1
+        while not self._closing.is_set():
+            interval = gates.get("JEPSEN_TPU_FLEET_HEARTBEAT_S")
+            self._closing.wait(max(0.05, float(interval or 1.0)))
+            if self._closing.is_set():
+                return
+            self._write_beacon(tr, seq)
+            seq += 1
+
     # -- observability -----------------------------------------------------
 
     def _serve_section(self) -> dict:
@@ -709,14 +874,18 @@ class VerdictDaemon:
 
 def run_daemon(store, socket_path=None, port: int | None = None,
                host: str = "127.0.0.1",
-               drain_s: float | None = None) -> int:
+               drain_s: float | None = None,
+               fleet_instance: int | None = None,
+               fleet_epoch: int | None = None) -> int:
     """The CLI body: start the daemon, print the machine-readable
     ready line, drain on SIGTERM/SIGINT, exit 0 on a clean drain."""
     import signal
     import sys
 
     d = VerdictDaemon(store, socket_path=socket_path, port=port,
-                      host=host, drain_s=drain_s)
+                      host=host, drain_s=drain_s,
+                      fleet_instance=fleet_instance,
+                      fleet_epoch=fleet_epoch)
     d.start()
 
     def _on_signal(signum, _frame):

@@ -18,6 +18,15 @@ Frame ops (the `"op"` key):
   client -> daemon
     hello       {tenant, weight?}           must be first
     check       {id, checker, dir|shm|history}
+    adopt       {tenant}                    fleet failover: the router
+                tells a successor daemon it now owns `tenant` — the
+                daemon reloads that tenant's journal index from disk
+                (another daemon may have appended since this one
+                started) before any resent check lands, so journaled
+                verdicts replay byte-identically instead of
+                re-checking. In-order frame processing on the stream
+                means the router can pipeline the resends right
+                behind it; no reply frame.
     bye         {}                          polite close (EOF works too)
 
   daemon -> client
@@ -26,6 +35,11 @@ Frame ops (the `"op"` key):
     retry-after {id, delay_s, queue_depth, draining?}   backpressure —
                 explicit, never a silent drop; resend after delay_s
     error       {error, id?}                protocol misuse
+
+The fleet router speaks this same protocol on both sides: tenants
+connect to it exactly as to a daemon, and it opens one upstream
+connection per (tenant connection, daemon) replaying the hello. The
+only router-era addition is `adopt` above.
 
 A `check` names its history one of three ways:
 

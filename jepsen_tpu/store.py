@@ -720,6 +720,54 @@ def request_spool_path(store_base) -> Path:
 
 
 # ---------------------------------------------------------------------------
+# Fleet artifacts: the `jepsen-tpu fleet` router's on-disk surface,
+# also flat at the store root. All N daemons share ONE store (so a
+# successor can replay a dead peer's per-tenant journals directly):
+#
+#   fleet.sock            the router's tenant-facing socket
+#   fleet-d<k>.sock       daemon k's upstream socket (router-facing)
+#   fleet-d<k>.json       daemon k's beacon — pid/epoch/load, atomically
+#                         replaced every heartbeat; the router reads
+#                         LIVENESS off the kernel mtime (clock-skew
+#                         immune) and LOAD off the payload
+#   fleet-epoch.json      the membership epoch marker (the fence): the
+#                         router bumps it before reassigning a dead
+#                         daemon's tenants; a zombie checks it before
+#                         journaling and drops fenced folds
+#   fleet-reassign.jsonl  the reassignment journal — one line per
+#                         (epoch, dead daemon, tenant, successor)
+#
+# Declared in lint/contracts.py STORE_ARTIFACTS like the rest.
+# ---------------------------------------------------------------------------
+
+def fleet_socket_path(store_base) -> Path:
+    """The fleet router's tenant-facing unix socket."""
+    return Path(store_base) / "fleet.sock"
+
+
+def fleet_daemon_socket_path(store_base, instance: int) -> Path:
+    """Fleet daemon `instance`'s own listening socket (the router
+    proxies tenant frames to it here)."""
+    return Path(store_base) / f"fleet-d{int(instance)}.sock"
+
+
+def fleet_member_path(store_base, instance: int) -> Path:
+    """Fleet daemon `instance`'s beacon file (atomically replaced
+    every JEPSEN_TPU_FLEET_HEARTBEAT_S)."""
+    return Path(store_base) / f"fleet-d{int(instance)}.json"
+
+
+def fleet_epoch_path(store_base) -> Path:
+    """The fleet membership epoch marker — the zombie fence."""
+    return Path(store_base) / "fleet-epoch.json"
+
+
+def fleet_reassign_path(store_base) -> Path:
+    """The router's tenant-reassignment journal (failover evidence)."""
+    return Path(store_base) / "fleet-reassign.jsonl"
+
+
+# ---------------------------------------------------------------------------
 # Persistent encoded cache: encoded.v1.bin / encoded.v2.bin sidecars.
 #
 # Re-analysis sweeps (analyze-store --resume, repeated benches, CI) pay

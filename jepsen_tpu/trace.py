@@ -64,7 +64,8 @@ DECLARED_METRICS: dict[str, frozenset] = {
         "bucket_splits", "buckets_dispatched", "buckets_resolved",
         "buffers_donated", "cache_hits", "cache_misses",
         "compile_cache_hits", "compile_cache_misses", "cost_records",
-        "donated_bytes", "h2d_bytes",
+        "donated_bytes", "fleet_failovers", "fleet_fences",
+        "fleet_replayed_verdicts", "fleet_spills", "h2d_bytes",
         "kernel.cyclic_histories", "kernel.stats_records",
         "native_fallback", "oom_retries", "pad_waste_cells",
         "planner.cold_starts", "planner.decisions",
@@ -76,13 +77,15 @@ DECLARED_METRICS: dict[str, frozenset] = {
         "split.python", "warm_copy_bytes", "watchdog_timeouts",
         "worker_spans",
     }),
-    "gauges": frozenset({"donate_slots_inflight", "hbm_device_bytes",
+    "gauges": frozenset({"donate_slots_inflight", "fleet_daemons_live",
+                         "fleet_epoch", "hbm_device_bytes",
                          "hbm_modeled_bytes", "inflight_depth",
                          "planner.pred_err_permille",
                          "reorder_depth", "resident_executables",
                          "runs_total", "serve_pending",
                          "serve_tenants"}),
     "histograms": frozenset({"bucket_cells",
+                             "fleet_failover_ms",
                              "kernel.backtracks",
                              "kernel.closure_rounds", "kernel.edges",
                              "kernel.margin", "kernel.scc_max",
@@ -96,7 +99,7 @@ DECLARED_METRICS: dict[str, frozenset] = {
 #: stage-seconds digests ingest relays from pool workers;
 #: `planner.<lever>` — per-lever modeled-decision counters).
 METRIC_PREFIXES = ("phase.", "device.", "native_fallback.", "worker.",
-                   "serve.", "planner.")
+                   "serve.", "planner.", "fleet.")
 
 #: Synthetic tid for the device track (real thread idents are pthread
 #: addresses, nowhere near this; named tracks count down from here).

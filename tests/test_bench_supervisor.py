@@ -22,10 +22,21 @@ TINY = {
     "BENCH_E2E_B": "3", "BENCH_E2E_T": "128",
     "BENCH_NS_B": "3", "BENCH_NS_T": "128", "BENCH_NS_K": "8",
     "BENCH_GEN_OPS": "2000",
+    "BENCH_SERVE_B": "6", "BENCH_SERVE_T": "128", "BENCH_SERVE_K": "8",
+    "BENCH_REG_RUNS": "4", "BENCH_REG_OPS": "200", "BENCH_REG_KEYS": "10",
+    "BENCH_PLANNER_B": "4", "BENCH_PLANNER_REPS": "1",
     # dp-scaling would spawn its own 8-virtual-device child here; skip
     # it in the supervisor tests (tests/test_dp_scaling.py covers the
     # measurement itself on the in-process virtual mesh)
     "BENCH_DP_CHILD": "0",
+    # the fleet block spawns N daemon subprocesses per bench run —
+    # far too heavy for the ~6 bench children these tests launch.
+    # tests/test_fleet.py and `make fleet-smoke` cover the fleet
+    # itself; the supervisor only pins the skipped-block shape.
+    "BENCH_FLEET": "0",
+    # ~13s of repo-wide static analysis per supervisor run adds
+    # nothing here — tests/test_lint.py owns the linter
+    "BENCH_LINT": "0",
 }
 
 
@@ -46,7 +57,7 @@ def test_supervisor_happy_path():
     assert out["value"] > 0
     assert out["backend"] == "cpu"
     for block in ("knossos", "long_history", "end_to_end",
-                  "north_star", "dp_scaling", "generator"):
+                  "north_star", "dp_scaling", "fleet", "generator"):
         assert block in out, block
         assert "error" not in out[block], out[block]
     ns = out["north_star"]
@@ -132,7 +143,7 @@ def test_supervisor_structured_error_child_still_retries_cpu():
     assert out["backend"] == "cpu"
     assert out.get("tpu_error")
     for block in ("knossos", "long_history", "end_to_end",
-                  "north_star", "dp_scaling", "generator"):
+                  "north_star", "dp_scaling", "fleet", "generator"):
         assert block in out, block
         assert "error" not in out[block], out[block]
 

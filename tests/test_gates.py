@@ -64,6 +64,10 @@ ALL_GATES = [
     "JEPSEN_TPU_SERVE_MAX_QUEUE",
     "JEPSEN_TPU_SERVE_WEIGHTS",
     "JEPSEN_TPU_SERVE_DRAIN_S",
+    "JEPSEN_TPU_SERVE_RETRY_S",
+    "JEPSEN_TPU_FLEET_HEARTBEAT_S",
+    "JEPSEN_TPU_FLEET_FAILOVER_S",
+    "JEPSEN_TPU_FLEET_SPILL_DEPTH",
     "JEPSEN_TPU_PLANNER",
     "JEPSEN_TPU_PLANNER_PATH",
     "JEPSEN_TPU_STRICT",
@@ -272,6 +276,39 @@ def test_serve_gates(monkeypatch):
     assert scheduler.parse_weights() == {}
     monkeypatch.delenv("JEPSEN_TPU_SERVE_DRAIN_S", raising=False)
     assert gates.get("JEPSEN_TPU_SERVE_DRAIN_S") == 30.0
+
+
+def test_serve_retry_gate(monkeypatch):
+    # the client's no-progress budget: default 60 s, floored at 0
+    # (`0` = fail on the first retryable condition, never negative)
+    from jepsen_tpu.serve import client
+    monkeypatch.delenv("JEPSEN_TPU_SERVE_RETRY_S", raising=False)
+    assert client.retry_budget_s() == 60.0
+    monkeypatch.setenv("JEPSEN_TPU_SERVE_RETRY_S", "2.5")
+    assert client.retry_budget_s() == 2.5
+    monkeypatch.setenv("JEPSEN_TPU_SERVE_RETRY_S", "-3")
+    assert client.retry_budget_s() == 0.0
+
+
+def test_fleet_gates(monkeypatch):
+    # the fleet's knobs, each floored so a zero/negative setting can't
+    # turn the heartbeat into a busy-loop or disable failover outright
+    from jepsen_tpu.serve import fleet
+    for var in ("JEPSEN_TPU_FLEET_HEARTBEAT_S",
+                "JEPSEN_TPU_FLEET_FAILOVER_S",
+                "JEPSEN_TPU_FLEET_SPILL_DEPTH"):
+        monkeypatch.delenv(var, raising=False)
+    assert fleet.heartbeat_s() == 1.0
+    assert fleet.failover_s() == 5.0
+    assert fleet.spill_depth() == 32
+    monkeypatch.setenv("JEPSEN_TPU_FLEET_HEARTBEAT_S", "0.001")
+    assert fleet.heartbeat_s() == 0.05
+    monkeypatch.setenv("JEPSEN_TPU_FLEET_FAILOVER_S", "0")
+    assert fleet.failover_s() == 0.1
+    monkeypatch.setenv("JEPSEN_TPU_FLEET_SPILL_DEPTH", "0")
+    assert fleet.spill_depth() == 1
+    monkeypatch.setenv("JEPSEN_TPU_FLEET_SPILL_DEPTH", "7")
+    assert fleet.spill_depth() == 7
 
 
 def test_encode_cache_write_gate(monkeypatch):
