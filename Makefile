@@ -8,6 +8,7 @@ ASAN_RT := $(shell gcc -print-file-name=libasan.so)
 TSAN_RT := $(shell gcc -print-file-name=libtsan.so)
 
 .PHONY: lint lint-json lint-changed env-table rule-table dur-table \
+	wire-table order-smoke \
 	crash-smoke test native native-sanitize bench bench-report \
 	bench-warm obs-smoke serve-smoke fleet-smoke trace-report \
 	cost-report \
@@ -16,8 +17,11 @@ TSAN_RT := $(shell gcc -print-file-name=libtsan.so)
 # Self-hosted static analysis: gate registry, JAX hazards, concurrency
 # discipline, shm lifecycle, tracer discipline, plus the cross-boundary
 # analyses — ABI/layout prover, tensor-contract dataflow, lockset
-# analysis (jepsen_tpu/lint/).
-lint:
+# analysis, happens-before prover, frame-protocol drift
+# (jepsen_tpu/lint/). order-smoke runs the two protocol families
+# standalone first so their findings surface even if the full pass
+# dies earlier.
+lint: order-smoke
 	$(PY) -m jepsen_tpu.cli lint
 
 lint-json:
@@ -60,6 +64,36 @@ dur-table:
 	e = t.index(c.DUR_END) + len(c.DUR_END); \
 	p.write_text(t[:s] + c.render_dur_block() + t[e:]); \
 	print('README.md store-durability table regenerated')"
+
+# Regenerate the README wire-frame table from serve/protocol.py's
+# FRAME_OPS registry (lint rule JT-WIRE-003 fails the build when the
+# committed table drifts).
+wire-table:
+	$(PY) -c "from pathlib import Path; \
+	from jepsen_tpu.lint import wireflow as w; \
+	reg = w.live_registry(Path('.')); \
+	p = Path('README.md'); t = p.read_text(); \
+	s = t.index(w.WIRE_BEGIN); \
+	e = t.index(w.WIRE_END) + len(w.WIRE_END); \
+	p.write_text(t[:s] + w.render_wire_block(reg) + t[e:]); \
+	print('README.md wire-frame table regenerated')"
+
+# The two protocol families standalone against the live tree: JT-ORD
+# module rules over the contracted modules, JT-WIRE project rules over
+# the serve trio. Exit 1 on any finding.
+order-smoke:
+	$(PY) -c "import sys; from pathlib import Path; \
+	from jepsen_tpu import lint; \
+	from jepsen_tpu.lint import contracts, order, wireflow; \
+	root = lint.default_root(); \
+	files = sorted({root / c.file for c in contracts.ORDER_CONTRACTS}); \
+	out = list(lint.lint_paths(files, root, rules=order.RULES)); \
+	ctx = lint.ProjectCtx(root, []); \
+	out += [f for r in wireflow.RULES for f in r.check_project(ctx)]; \
+	[print(f.render()) for f in out]; \
+	print(f'order-smoke: {len(out)} findings ' \
+	      f'({len(contracts.ORDER_CONTRACTS)} contracts proved)'); \
+	sys.exit(1 if out else 0)"
 
 # Crash-consistency smoke: the kill-mid-write / short-write /
 # torn-tail / rotation tests over the journal-class artifacts

@@ -137,6 +137,27 @@ class ProjectCtx:
     def __init__(self, root: Path, modules: list[ModuleCtx]):
         self.root = root
         self.modules = modules
+        self._by_rel: dict[str, ModuleCtx | None] | None = None
+
+    def module(self, rel: str) -> ModuleCtx | None:
+        """The parsed ModuleCtx for a repo-relative path — ONE parse
+        per file per run, shared by every rule family (rules_abi and
+        wireflow used to each re-parse their targets). Falls back to
+        a disk parse when the path wasn't in the module-rule walk
+        (`--changed` mode's empty-modules ProjectCtx, fixture trees);
+        missing or unparseable files degrade to None, never raise."""
+        if self._by_rel is None:
+            self._by_rel = {m.rel: m for m in self.modules}
+        if rel not in self._by_rel:
+            p = self.root / rel
+            m: ModuleCtx | None = None
+            if p.is_file():
+                try:
+                    m = _load_ctx(p, self.root)
+                except LintParseError:
+                    m = None
+            self._by_rel[rel] = m
+        return self._by_rel[rel]
 
 
 class ModuleRule:
@@ -207,14 +228,14 @@ def walk_functions(tree: ast.AST) -> Iterator[ast.FunctionDef]:
 
 def all_rules() -> tuple[list[ModuleRule], list[ProjectRule]]:
     """Every registered rule instance (module rules, project rules)."""
-    from . import (rules_abi, rules_concurrency, rules_dur,
+    from . import (order, rules_abi, rules_concurrency, rules_dur,
                    rules_gates, rules_jax, rules_lock, rules_meta,
-                   rules_shm, rules_tensor, rules_trace)
+                   rules_shm, rules_tensor, rules_trace, wireflow)
     mod: list[ModuleRule] = []
     proj: list[ProjectRule] = []
     for m in (rules_gates, rules_jax, rules_concurrency, rules_shm,
               rules_trace, rules_abi, rules_tensor, rules_lock,
-              rules_dur, rules_meta):
+              rules_dur, order, wireflow, rules_meta):
         for r in m.RULES:
             (proj if isinstance(r, ProjectRule) else mod).append(r)
     return mod, proj

@@ -26,6 +26,16 @@ functions and `self.method` calls — enough to see `f` holding lock A
 call `g` that takes lock B two files of indirection away would need
 whole-program resolution, but every inversion this repo has actually
 shipped lived inside one module.
+
+Beyond locksets, the graphs carry what a path-sensitive ordering
+prover (JT-ORD) needs: `CFG.branches` records each lowered `if`'s
+branch polarity (cond block → (then-start, else-start)) so a search
+can prune one arm of a known guard, `return`/`raise`/`break`/
+`continue` are routed THROUGH every enclosing `finally` body (lowered
+as copies on the abnormal edge — `compute_locksets` intersects over
+duplicate statement occurrences, so the must-sets stay sound), and
+`dominators`/`post_dominators` solve the classic block-level dataflow
+for "A on every path to B" / "B on every path from A" questions.
 """
 
 from __future__ import annotations
@@ -36,6 +46,7 @@ from typing import Callable, Iterator
 
 __all__ = [
     "Block", "CFG", "build_cfg", "compute_locksets",
+    "dominators", "post_dominators",
     "iter_defs", "call_graph", "resolve_call",
 ]
 
@@ -53,6 +64,10 @@ class Block:
 class CFG:
     def __init__(self) -> None:
         self.blocks: dict[int, Block] = {}
+        #: cond-block id → (then-start id, else-start id) for every
+        #: lowered `if` (each `if` ends its block, so the key is
+        #: unambiguous) — the branch polarity guard-aware searches need
+        self.branches: dict[int, tuple[int, int]] = {}
         self.entry = self._new().id
         self.exit = self._new().id
 
@@ -72,6 +87,8 @@ class _Builder:
         self.cur = self.cfg._new()
         self.cfg.edge(self.cfg.entry, self.cur.id)
         self.loops: list[tuple[int, int]] = []   # (head, after)
+        #: pending finally bodies: (finalbody, len(self.loops) at push)
+        self.finallies: list[tuple[list, int]] = []
 
     def _start(self, *preds: int) -> Block:
         b = self.cfg._new()
@@ -81,6 +98,34 @@ class _Builder:
 
     def _terminated(self) -> bool:
         return self.cur is None
+
+    def _unwind(self, stop: int) -> None:
+        """An abnormal exit (`return`/`raise`/`break`/`continue`) runs
+        every enclosing `finally` body down to stack index `stop`
+        before leaving — lower COPIES of them (innermost first) into
+        the current chain. Each copy is lowered with the stack
+        truncated below itself, so a `return` INSIDE a finally body
+        unwinds only the finallies outer to it."""
+        saved = self.finallies
+        try:
+            for i in range(len(saved) - 1, stop - 1, -1):
+                self.finallies = saved[:i]
+                self.stmts(saved[i][0])
+                if self._terminated():
+                    # the finally body itself returned/raised/broke:
+                    # it replaced this exit and already unwound the rest
+                    return
+        finally:
+            self.finallies = saved
+
+    def _loop_finallies(self) -> int:
+        """The unwind stop for `break`/`continue`: only finallies
+        pushed INSIDE the current loop (push depth >= current loop
+        depth) run before the jump; outer ones stay pending."""
+        stop = len(self.finallies)
+        while stop and self.finallies[stop - 1][1] >= len(self.loops):
+            stop -= 1
+        return stop
 
     def stmts(self, body: list[ast.stmt]) -> None:
         for s in body:
@@ -95,9 +140,11 @@ class _Builder:
             self.cur.instrs.append(("stmt", s))
             cond = self.cur
             self.cur = self._start(cond.id)
+            then_start = self.cur.id
             self.stmts(s.body)
             then_end = self.cur
             self.cur = self._start(cond.id)
+            self.cfg.branches[cond.id] = (then_start, self.cur.id)
             self.stmts(s.orelse)
             else_end = self.cur
             join = self.cfg._new()
@@ -138,6 +185,8 @@ class _Builder:
                     self.cur.instrs.append(("exit", lid, s))
         elif isinstance(s, ast.Try):
             self.cur.instrs.append(("stmt", s))
+            if s.finalbody:
+                self.finallies.append((s.finalbody, len(self.loops)))
             entry = self.cur
             self.cur = self._start(entry.id)
             self.stmts(s.body)
@@ -163,19 +212,24 @@ class _Builder:
                 self.cfg.edge(e.id, join.id)
             self.cur = join
             if s.finalbody:
+                self.finallies.pop()
                 self.stmts(s.finalbody)
         elif isinstance(s, (ast.Return, ast.Raise)):
             self.cur.instrs.append(("stmt", s))
-            self.cfg.edge(self.cur.id, self.cfg.exit)
+            self._unwind(0)
+            if self.cur is not None:
+                self.cfg.edge(self.cur.id, self.cfg.exit)
             self.cur = None
         elif isinstance(s, ast.Break):
             self.cur.instrs.append(("stmt", s))
-            if self.loops:
+            self._unwind(self._loop_finallies())
+            if self.cur is not None and self.loops:
                 self.cfg.edge(self.cur.id, self.loops[-1][1])
             self.cur = None
         elif isinstance(s, ast.Continue):
             self.cur.instrs.append(("stmt", s))
-            if self.loops:
+            self._unwind(self._loop_finallies())
+            if self.cur is not None and self.loops:
                 self.cfg.edge(self.cur.id, self.loops[-1][0])
             self.cur = None
         else:
@@ -247,6 +301,44 @@ def compute_locksets(cfg: CFG) -> dict[int, frozenset[str]]:
                 result[id(node)] = result.get(id(node),
                                               frozenset()) | {kind[1]}
     return result
+
+
+def _dom_solve(ids: set, start: int,
+               preds: dict) -> dict[int, frozenset[int]]:
+    dom = {i: frozenset(ids) for i in ids}
+    dom[start] = frozenset({start})
+    changed = True
+    while changed:
+        changed = False
+        for i in ids:
+            if i == start:
+                continue
+            ins = [dom[p] for p in preds[i]]
+            new = (frozenset.intersection(*ins)
+                   if ins else frozenset(ids)) | {i}
+            if new != dom[i]:
+                dom[i] = new
+                changed = True
+    return dom
+
+
+def dominators(cfg: CFG) -> dict[int, frozenset[int]]:
+    """block id → blocks on EVERY entry→block path (reflexive).
+    Blocks unreachable from entry report the full set — vacuously
+    dominated, which is what path queries want."""
+    preds: dict[int, list[int]] = {i: [] for i in cfg.blocks}
+    for b in cfg.blocks.values():
+        for s in b.succs:
+            preds[s].append(b.id)
+    return _dom_solve(set(cfg.blocks), cfg.entry, preds)
+
+
+def post_dominators(cfg: CFG) -> dict[int, frozenset[int]]:
+    """block id → blocks on EVERY block→exit path (reflexive): the
+    dominance solve on the reversed graph, anchored at cfg.exit."""
+    # reversed graph: the predecessors of i are i's forward successors
+    preds = {i: list(b.succs) for i, b in cfg.blocks.items()}
+    return _dom_solve(set(cfg.blocks), cfg.exit, preds)
 
 
 # ---------------------------------------------------------------------------

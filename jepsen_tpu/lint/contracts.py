@@ -5,18 +5,22 @@ against.
 typed table, is an invariant the linter can enforce everywhere it is
 consumed. This module does the same for the encode→pack→dispatch
 tensor contracts (JT-TENSOR), the lock/shared-state discipline of the
-sweep's thread graph (JT-LOCK), the hot-path scoping both share, and
-the store-artifact durability protocols (JT-DUR) — every on-disk
+sweep's thread graph (JT-LOCK), the hot-path scoping both share, the
+store-artifact durability protocols (JT-DUR) — every on-disk
 format a sweep persists, declared once with its crash-consistency
-protocol, sanctioned writer/reader helpers and retention class.
+protocol, sanctioned writer/reader helpers and retention class —
+and the serve fleet's happens-before protocol (JT-ORD): the
+journal-then-reply, fence-between-dispatch-and-journal and
+failover-ordering contracts, declared once and proved
+path-sensitively against the cfg.py graphs.
 The ABI/layout contracts (JT-ABI) are NOT declared here — their source
 of truth is `native/hist_encode.cc` itself, parsed by `cparse.py` and
 cross-checked against `native_lib.py`/`store.py`; duplicating them in
 a third place would just add one more thing to drift.
 
 Every table is consumed by a rule in `rules_tensor.py` /
-`rules_lock.py` / `rules_dur.py`; tests/test_lint.py pins the
-registry's shape so an entry can't silently vanish.
+`rules_lock.py` / `rules_dur.py` / `order.py`; tests/test_lint.py
+pins the registry's shape so an entry can't silently vanish.
 """
 
 from __future__ import annotations
@@ -531,3 +535,150 @@ def render_dur_table() -> str:
 
 def render_dur_block() -> str:
     return f"{DUR_BEGIN}\n{render_dur_table()}\n{DUR_END}"
+
+
+# ---------------------------------------------------------------------------
+# JT-ORD — happens-before contracts of the serve/fleet protocol
+# ---------------------------------------------------------------------------
+
+#: Marker syntax (matched per CFG pseudo-instruction, headers only for
+#: compound statements):
+#:
+#:   ``call:<glob>``          a statement containing a call whose
+#:                            loosely-dotted callee (subscript links
+#:                            render as ``[]``: ``ent[].record``)
+#:                            fnmatches the glob;
+#:   ``call:<glob>{op=<v>}``  additionally requires a positional arg
+#:                            that is a dict LITERAL with "op" == v
+#:                            (frames built elsewhere stay unmatched
+#:                            on purpose — the marker names a specific
+#:                            emission, not a variable);
+#:   ``set:<name>``           an assignment/augassign/annassign whose
+#:                            target is the bare name or attribute
+#:                            ``<name>``.
+#:
+#: Kinds — all proved path-sensitively on cfg.py graphs (finally
+#: bodies routed, branch polarity recorded):
+#:
+#:   ``dominates``      first lies on EVERY entry→second path;
+#:   ``postdominates``  second lies on EVERY first→exit path
+#:                      (exception edges included);
+#:   ``between``        mid lies on EVERY first→second path;
+#:   ``never-after``    no path from first ever reaches second;
+#:   ``under-lock``     first executes with ``lock`` MUST-held.
+#:
+#: ``guard`` names a bare local flag assigned exactly once: paths
+#: taking the false arm of an ``if <guard>:`` are pruned, so a
+#: release guarded by the same flag as its acquire is not a false
+#: leak. Pruning is skipped (conservative) if the flag is ever
+#: reassigned.
+
+@dataclass(frozen=True)
+class OrderContract:
+    rule: str       #: JT-ORD rule id that proves this entry
+    file: str       #: repo-relative module the contract lives in
+    func: str       #: qualname within the module (iter_defs form)
+    kind: str       #: dominates|postdominates|between|never-after|under-lock
+    first: str      #: marker (see syntax above)
+    second: str = ""
+    mid: str = ""
+    guard: str = ""
+    lock: str = ""
+    doc: str = ""
+
+
+ORDER_CONTRACTS: tuple[OrderContract, ...] = (
+    OrderContract(
+        rule="JT-ORD-001",
+        file="jepsen_tpu/serve/daemon.py",
+        func="VerdictDaemon._run_fold",
+        kind="dominates",
+        first="call:*.record",
+        second="call:*.send",
+        doc="journal-then-reply: the journal append dominates every "
+            "reply-frame send, so an ack can only name a verdict the "
+            "journal already holds (or explicitly flags journaled: "
+            "false)"),
+    OrderContract(
+        rule="JT-ORD-002",
+        file="jepsen_tpu/serve/daemon.py",
+        func="VerdictDaemon._run_fold",
+        kind="between",
+        first="call:*.verdicts",
+        mid="call:*._fenced",
+        second="call:*.record",
+        doc="the zombie fence: the epoch-fence read lies on every "
+            "path between a fold's dispatch and its journal write"),
+    OrderContract(
+        rule="JT-ORD-002",
+        file="jepsen_tpu/serve/daemon.py",
+        func="VerdictDaemon._run_fold",
+        kind="never-after",
+        first="call:*.request_drain",
+        second="call:*.record",
+        doc="a fenced fold drains and drops: once the fold entered "
+            "the fenced path no journal write may follow — the "
+            "successor is already journaling these ids"),
+    OrderContract(
+        rule="JT-ORD-003",
+        file="jepsen_tpu/serve/fleet.py",
+        func="FleetRouter._fail_over",
+        kind="dominates",
+        first="call:*._write_epoch",
+        second="call:os.kill",
+        doc="fence before STONITH: the epoch bump is durably "
+            "published (temp+os.replace) before the dead member's "
+            "process is signalled"),
+    OrderContract(
+        rule="JT-ORD-003",
+        file="jepsen_tpu/serve/fleet.py",
+        func="FleetRouter._fail_over",
+        kind="dominates",
+        first="call:*._write_epoch",
+        second="call:*.send{op=adopt}",
+        doc="fence before adoption: a successor only learns it owns "
+            "a tenant after the epoch fence that stops the old "
+            "owner is on disk"),
+    OrderContract(
+        rule="JT-ORD-003",
+        file="jepsen_tpu/serve/fleet.py",
+        func="FleetRouter._fail_over",
+        kind="never-after",
+        first="call:*.send{op=adopt}",
+        second="call:os.kill",
+        doc="STONITH precedes adoption and never follows it: "
+            "signalling the old owner after a successor adopted "
+            "would be fencing out of order"),
+    OrderContract(
+        rule="JT-ORD-004",
+        file="jepsen_tpu/parallel/__init__.py",
+        func="_sync_check",
+        kind="postdominates",
+        first="call:_note_donation",
+        second="call:*.release",
+        guard="donate",
+        doc="no leaked device slot: the DeviceSlots release "
+            "post-dominates the donation acquire on every exit path, "
+            "exception edges included"),
+    OrderContract(
+        rule="JT-ORD-005",
+        file="jepsen_tpu/serve/scheduler.py",
+        func="Admission.close",
+        kind="under-lock",
+        first="set:_closed",
+        lock="self._cv",
+        doc="admission close happens under its condition variable: "
+            "a waiter never misses the wakeup that tells it the "
+            "queue closed"),
+    OrderContract(
+        rule="JT-ORD-005",
+        file="jepsen_tpu/serve/daemon.py",
+        func="VerdictDaemon.request_drain",
+        kind="dominates",
+        first="call:*.close",
+        second="call:*._draining.set",
+        doc="close-before-drain-visible: admission is closed before "
+            "the draining flag becomes observable, so the scheduler "
+            "can never see draining ∧ pending==0 while a reader can "
+            "still admit a request nobody will serve"),
+)

@@ -258,24 +258,20 @@ def _is_subsequence(sub: tuple[str, ...],
 # The shared project-context cache and the four rules
 # ---------------------------------------------------------------------------
 
-def _parse_py(root: Path, rel: str) -> ast.Module | None:
-    """Parse one Python input of the prover, or None when missing or
-    unparseable. The prover must DEGRADE on a broken file, never
-    crash the run: the module pass already reports the syntax error
-    as a JT-PARSE finding, and a half-parsed ABI would only add false
-    drift on top of it."""
-    p = root / rel
-    if not p.is_file():
-        return None
-    try:
-        return ast.parse(p.read_text(encoding="utf-8",
-                                     errors="replace"))
-    except (OSError, SyntaxError, ValueError):
-        return None
+def _tree(ctx: ProjectCtx, rel: str) -> ast.Module | None:
+    """One Python input of the prover, through the run's SHARED parse
+    (ProjectCtx.module — the module-rule pass already parsed these
+    files). None when missing or unparseable: the prover must
+    DEGRADE on a broken file, never crash the run — the module pass
+    already reports the syntax error as a JT-PARSE finding, and a
+    half-parsed ABI would only add false drift on top of it."""
+    m = ctx.module(rel)
+    return None if m is None else m.tree
 
 
 class _AbiState:
-    def __init__(self, root: Path):
+    def __init__(self, ctx: ProjectCtx):
+        root = Path(ctx.root)
         self.native: dict[str, cparse.NativeABI] = {}
         for rel in _NATIVE_SOURCES:
             p = root / rel
@@ -288,16 +284,16 @@ class _AbiState:
                     pass
         self.protos: dict[str, Proto] = {}
         self.checks: dict[str, tuple[int, int]] = {}
-        lib_tree = _parse_py(root, _NATIVE_LIB)
+        lib_tree = _tree(ctx, _NATIVE_LIB)
         self.lib_present = lib_tree is not None
         if lib_tree is not None:
             self.protos, self.checks = extract_ctypes(lib_tree)
-        store_tree = _parse_py(root, _STORE)
+        store_tree = _tree(ctx, _STORE)
         self.store: StoreLayout | None = \
             extract_store_layout(store_tree) \
             if store_tree is not None else None
         self.never_completed: int | None = None
-        etree = _parse_py(root, _ENCODE)
+        etree = _tree(ctx, _ENCODE)
         if etree is not None:
             for n in etree.body:
                 if isinstance(n, ast.Assign) \
@@ -316,7 +312,7 @@ class _AbiState:
 def _state(ctx: ProjectCtx) -> _AbiState:
     st = getattr(ctx, "_abi_state", None)
     if st is None:
-        st = _AbiState(Path(ctx.root))
+        st = _AbiState(ctx)
         ctx._abi_state = st
     return st
 

@@ -33,6 +33,16 @@ def _is_environ(node: ast.AST) -> bool:
     return d is not None and (d == "environ" or d.endswith(".environ"))
 
 
+def _environs(ctx) -> list:
+    """The module's environ accesses, memoized on the ModuleCtx —
+    both gate rules share one walk per file."""
+    cached = getattr(ctx, "_gate_environs", None)
+    if cached is None:
+        cached = list(_environ_accesses(ctx.tree))
+        ctx._gate_environs = cached
+    return cached
+
+
 def _environ_accesses(tree: ast.AST) -> Iterator[tuple[ast.AST, str | None]]:
     """(node, gate-name literal) for every environ read/write/del whose
     key is a string constant (dynamic keys can't be resolved
@@ -64,7 +74,7 @@ class RawGateAccess(ModuleRule):
     def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
         if ctx.rel.endswith(_GATES_FILE):
             return
-        for node, name in _environ_accesses(ctx.tree):
+        for node, name in _environs(ctx):
             if name and name.startswith("JEPSEN_TPU_"):
                 yield self.finding(
                     ctx, node,
@@ -89,7 +99,7 @@ class UnregisteredGate(ModuleRule):
                 yield self.finding(ctx, node,
                                    f"unregistered gate {name!r}")
 
-        for node, name in _environ_accesses(ctx.tree):
+        for node, name in _environs(ctx):
             if name and name.startswith("JEPSEN_TPU_") \
                     and name not in reg:
                 yield from emit(node, name)

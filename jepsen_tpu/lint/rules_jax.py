@@ -93,6 +93,16 @@ def _jit_functions(tree: ast.AST) -> Iterator[tuple[ast.FunctionDef,
                 break
 
 
+def _jits(ctx) -> list:
+    """The module's jitted functions, memoized on the ModuleCtx —
+    all three JT-JAX rules share one decorator walk per file."""
+    cached = getattr(ctx, "_jax_jits", None)
+    if cached is None:
+        cached = list(_jit_functions(ctx.tree))
+        ctx._jax_jits = cached
+    return cached
+
+
 def _traced_params(fn: ast.FunctionDef, static: set[str]) -> set[str]:
     a = fn.args
     names = {p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)}
@@ -121,7 +131,7 @@ class ItemHostSync(ModuleRule):
                 yield self.finding(ctx, n,
                                    ".item() host-sync in a kernel module")
             return
-        for fn, _static in _jit_functions(ctx.tree):
+        for fn, _static in _jits(ctx):
             for n in items(fn):
                 yield self.finding(
                     ctx, n, f".item() inside jitted `{fn.name}`")
@@ -135,7 +145,7 @@ class NumpyOnTraced(ModuleRule):
     hint = "use jnp.* inside jit; np belongs outside the traced region"
 
     def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
-        for fn, _static in _jit_functions(ctx.tree):
+        for fn, _static in _jits(ctx):
             for n in ast.walk(fn):
                 if isinstance(n, ast.Call) \
                         and isinstance(n.func, ast.Attribute) \
@@ -184,7 +194,7 @@ class TracerBranch(ModuleRule):
             "static_argnames if recompiling per value is intended")
 
     def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
-        for fn, static in _jit_functions(ctx.tree):
+        for fn, static in _jits(ctx):
             traced = _traced_params(fn, static)
             if not traced:
                 continue

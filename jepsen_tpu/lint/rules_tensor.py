@@ -82,6 +82,20 @@ def _dataflow_scopes(ctx: ModuleCtx):
             yield fn
 
 
+def _scoped(ctx: ModuleCtx) -> list:
+    """(scope, tags, own-nodes) per dataflow scope, memoized on the
+    ModuleCtx — the tensor rules share one tag build and one
+    stop-at-nested-defs walk per scope instead of redoing both per
+    rule."""
+    cached = getattr(ctx, "_tensor_scopes", None)
+    if cached is None:
+        cached = [(sc, dataflow.build_tags(sc),
+                   list(dataflow.own_nodes(sc)))
+                  for sc in _dataflow_scopes(ctx)]
+        ctx._tensor_scopes = cached
+    return cached
+
+
 def _target_field(t: ast.AST) -> str | None:
     """The contracted field an assignment target names: `appends = …`,
     `d_invoke[:n] = …`, `out["reads"] = …`."""
@@ -108,9 +122,8 @@ class UndeclaredCast(ModuleRule):
             "writers perform it")
 
     def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
-        for scope in _dataflow_scopes(ctx):
-            tags = dataflow.build_tags(scope)
-            for n in dataflow.own_nodes(scope):
+        for scope, tags, nodes in _scoped(ctx):
+            for n in nodes:
                 if not isinstance(n, ast.Call):
                     continue
                 src = dt = None
@@ -211,9 +224,8 @@ class FillAndGeometryDrift(ModuleRule):
 
     def check(self, ctx: ModuleCtx) -> Iterator[Finding]:
         consts = dataflow.module_int_consts(ctx.tree)
-        for scope in _dataflow_scopes(ctx):
-            tags = dataflow.build_tags(scope)
-            for n in dataflow.own_nodes(scope):
+        for scope, tags, nodes in _scoped(ctx):
+            for n in nodes:
                 # pad_to(x, M) / _pad_up(x, M) with an undeclared M
                 if isinstance(n, ast.Call):
                     d = dotted(n.func)

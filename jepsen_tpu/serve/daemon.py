@@ -311,12 +311,15 @@ class VerdictDaemon:
             drain_s = gates.get("JEPSEN_TPU_SERVE_DRAIN_S")
         self._drain_deadline = time.monotonic() + max(0.0,
                                                       float(drain_s))
-        self._draining.set()
-        # close the queues ATOMICALLY: a reader mid-encode that passed
-        # the draining check above cannot slip an admission in after
-        # the scheduler observed pending==0 — admit() refuses it and
-        # the tenant gets the draining retry-after instead
+        # close admission BEFORE the draining flag becomes observable
+        # (JT-ORD-005): the scheduler exits on draining ∧ pending==0,
+        # so if the flag were set first a reader mid-encode could
+        # still admit a request in the window before close() — one
+        # the exiting scheduler would never serve. Closed-first,
+        # admit() refuses it and the tenant gets the draining
+        # retry-after instead.
         self.admission.close()
+        self._draining.set()
         obs_events.emit("serve_drain", reason=reason,
                         pending=self.admission.pending())
         log.info("drain requested (%s): %d pending", reason,
@@ -808,9 +811,14 @@ class VerdictDaemon:
                 return False
             self._fence_stat = key
             self._fence_data = data if isinstance(data, dict) else {}
-        m = self._fence_data.get("members", {})
-        ent = m.get(str(self.fleet_instance))
-        return bool(ent and ent.get("status") == "dead")
+        # alien shapes (members as a list, an entry as a bare string —
+        # e.g. a hand-edited or version-skewed marker) must degrade to
+        # "not fenced", never crash the fold loop mid-verdict
+        m = self._fence_data.get("members")
+        ent = m.get(str(self.fleet_instance)) if isinstance(m, dict) \
+            else None
+        return bool(isinstance(ent, dict)
+                    and ent.get("status") == "dead")
 
     def _write_beacon(self, tr, seq: int = 0) -> None:
         """One atomic beacon rewrite. The router reads LIVENESS off

@@ -65,6 +65,58 @@ import socket
 MAGIC = b"JTSV"
 MAX_FRAME = 64 << 20
 
+#: The frame-kind registry — the wire protocol's single source of
+#: truth. Every `op` either side may put on the wire, its direction
+#: (`c2d` = client/tenant → daemon, `d2c` = daemon → client), and its
+#: payload contract. The docstring table above is prose; THIS table
+#: is what the JT-WIRE rules (lint/wireflow.py) prove the senders and
+#: handlers in client.py/daemon.py/fleet.py against, and what the
+#: README frame table is generated from (`make wire-table`). The
+#: fleet router forwards both directions verbatim, so it carries no
+#: handler obligations here — only its own emissions are checked.
+FRAME_OPS: dict[str, dict] = {
+    "hello": {
+        "dir": "c2d",
+        "required": ("tenant",),
+        "optional": ("weight",),
+        "doc": "must be the first frame on a connection"},
+    "check": {
+        "dir": "c2d",
+        "required": ("id", "checker"),
+        "optional": ("dir", "shm", "history"),
+        "doc": "verdict request; names its history one of dir|shm|history"},
+    "adopt": {
+        "dir": "c2d",
+        "required": ("tenant",),
+        "optional": (),
+        "doc": "failover: the successor daemon now owns the tenant"},
+    "bye": {
+        "dir": "c2d",
+        "required": (),
+        "optional": (),
+        "doc": "polite close (EOF works too)"},
+    "welcome": {
+        "dir": "d2c",
+        "required": ("tenant", "weight", "journaled", "max_queue"),
+        "optional": (),
+        "doc": "hello accepted"},
+    "verdict": {
+        "dir": "d2c",
+        "required": ("id", "checker", "result"),
+        "optional": ("replay", "stats", "journaled"),
+        "doc": "checker result; replay=true on a journal hit"},
+    "retry-after": {
+        "dir": "d2c",
+        "required": ("id", "delay_s", "queue_depth"),
+        "optional": ("draining", "checker"),
+        "doc": "backpressure — explicit, never a silent drop"},
+    "error": {
+        "dir": "d2c",
+        "required": ("error",),
+        "optional": ("id",),
+        "doc": "protocol misuse; the connection usually survives"},
+}
+
 
 class ProtocolError(RuntimeError):
     """A malformed frame (bad magic, oversized length, junk JSON) —

@@ -138,6 +138,47 @@ def test_epoch_fence_predicate(tmp_path):
     assert d2._fenced() is False
 
 
+def test_epoch_fence_corrupt_and_alien_marker(tmp_path):
+    """A mangled or version-skewed fleet-epoch.json must degrade to
+    "not fenced" — never crash the fold loop mid-verdict — and a torn
+    read must not poison the stat cache for the clean rewrite."""
+    store, _dirs = make_store(tmp_path)
+    d = VerdictDaemon(Store(store), fleet_instance=1, fleet_epoch=1)
+    marker = fleet_epoch_path(store)
+
+    # torn mid-replace marker: parse failure reads as unfenced, and the
+    # stat key is NOT cached, so the subsequent clean rewrite (same
+    # content prefix, new mtime) is re-parsed and honored
+    marker.write_text('{"epoch": 2, "members": {"1": {"status": "de')
+    assert d._fenced() is False
+    time.sleep(0.02)
+    marker.write_text(json.dumps(
+        {"epoch": 2, "members": {"1": {"status": "dead"}}}))
+    assert d._fenced() is True
+
+    # alien top-level shape (a JSON list) degrades safely
+    time.sleep(0.02)
+    marker.write_text(json.dumps([1, 2, 3]))
+    assert d._fenced() is False
+
+    # members as a list (version-skewed writer): no crash, not fenced
+    time.sleep(0.02)
+    marker.write_text(json.dumps({"epoch": 3, "members": ["1"]}))
+    assert d._fenced() is False
+
+    # a member entry as a bare string: no crash, not fenced
+    time.sleep(0.02)
+    marker.write_text(json.dumps({"epoch": 4,
+                                  "members": {"1": "dead"}}))
+    assert d._fenced() is False
+
+    # recovery: a clean marker after the alien ones still fences
+    time.sleep(0.02)
+    marker.write_text(json.dumps(
+        {"epoch": 5, "members": {"1": {"status": "dead"}}}))
+    assert d._fenced() is True
+
+
 # ---------------------------------------------------------------------------
 # in-process attach-mode fleet: routing, simulated death, replay, spill
 # ---------------------------------------------------------------------------

@@ -298,9 +298,10 @@ def test_rule_families_all_registered():
     ids = lint.rule_ids()
     assert len(ids) == len(set(ids))
     for fam in ("JT-GATE", "JT-JAX", "JT-THREAD", "JT-SHM", "JT-TRACE",
-                "JT-ABI", "JT-TENSOR", "JT-LOCK", "JT-DUR", "JT-META"):
+                "JT-ABI", "JT-TENSOR", "JT-LOCK", "JT-DUR", "JT-ORD",
+                "JT-WIRE", "JT-META"):
         assert any(i.startswith(fam + "-") for i in ids), fam
-    assert len(ids) >= 36
+    assert len(ids) >= 44
 
 
 #: The GOLDEN rule-id table. Renumbering an existing rule, dropping
@@ -316,10 +317,13 @@ GOLDEN_RULE_IDS = [
     "JT-JAX-001", "JT-JAX-002", "JT-JAX-003", "JT-JAX-004",
     "JT-LOCK-001", "JT-LOCK-002", "JT-LOCK-003", "JT-LOCK-004",
     "JT-META-001",
+    "JT-ORD-001", "JT-ORD-002", "JT-ORD-003", "JT-ORD-004",
+    "JT-ORD-005",
     "JT-SHM-001",
     "JT-TENSOR-001", "JT-TENSOR-002", "JT-TENSOR-003", "JT-TENSOR-004",
     "JT-THREAD-001", "JT-THREAD-002", "JT-THREAD-003", "JT-THREAD-004",
     "JT-TRACE-001", "JT-TRACE-002", "JT-TRACE-003", "JT-TRACE-004",
+    "JT-WIRE-001", "JT-WIRE-002", "JT-WIRE-003",
 ]
 
 
@@ -404,6 +408,13 @@ def test_engine_fingerprint_covers_rule_inputs():
     pkg = Path(lint.__file__).resolve().parent.parent
     for rel in lint._RULE_INPUT_SOURCES:
         assert (pkg / rel).is_file(), rel
+    # the protocol provers live under lint/ where the engine glob
+    # picks them up: editing a contract or the wire rules invalidates
+    # cached module-rule results (JT-WIRE's registry, serve/
+    # protocol.py, is consulted only by project rules — never cached)
+    lint_dir = Path(lint.__file__).resolve().parent
+    for name in ("order.py", "wireflow.py", "contracts.py", "cfg.py"):
+        assert (lint_dir / name).is_file(), name
 
 
 def test_lint_cache_corrupt_entry_is_a_miss(tmp_path):

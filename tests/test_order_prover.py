@@ -1,0 +1,222 @@
+"""Differential tests for the JT-ORD happens-before prover.
+
+The analyzer that certifies the serve fleet's ordering protocol must
+itself be certified (the test_contract_prover.py / test_durability_
+prover.py precedent): each test copies the REAL contracted modules
+into a fixture tree, applies exactly one seeded ordering bug — a
+conditionally-skipped journal append, a dropped fenced-drain return,
+an epoch bump moved after STONITH, a `finally` release downgraded to
+except-only, a lock hoist, a close/set swap — and asserts the prover
+reports exactly the expected JT-ORD finding (and nothing else). The
+unmutated tree must be clean, so a prover that goes blind (CFG
+regression) or trigger-happy (false path) fails loudly either way.
+"""
+
+from __future__ import annotations
+
+import shutil
+from pathlib import Path
+
+import pytest
+
+from jepsen_tpu import lint
+from jepsen_tpu.lint import contracts, order
+
+REPO = Path(__file__).resolve().parents[1]
+
+#: Every file ORDER_CONTRACTS anchors in (pinned by
+#: test_contract_registry_shape below).
+_FIXTURE_FILES = (
+    "jepsen_tpu/serve/daemon.py",
+    "jepsen_tpu/serve/fleet.py",
+    "jepsen_tpu/serve/scheduler.py",
+    "jepsen_tpu/parallel/__init__.py",
+)
+
+
+@pytest.fixture()
+def tree(tmp_path: Path) -> Path:
+    for rel in _FIXTURE_FILES:
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(REPO / rel, dst)
+    return tmp_path
+
+
+def prove(root: Path):
+    files = [root / rel for rel in _FIXTURE_FILES]
+    return lint.lint_paths(files, root, rules=order.RULES)
+
+
+def mutate(root: Path, rel: str, old: str, new: str) -> None:
+    p = root / rel
+    text = p.read_text()
+    assert old in text, f"mutation anchor not found in {rel}: {old!r}"
+    p.write_text(text.replace(old, new, 1))
+
+
+def test_unmutated_tree_is_clean(tree):
+    assert prove(tree) == []
+
+
+def test_real_repo_is_clean():
+    # the rules run against the live tree in the self-hosting gate
+    # too; this pins the direct path the mutation tests exercise
+    assert prove(REPO) == []
+
+
+# -- one seeded ordering bug per rule ---------------------------------------
+
+def test_conditionally_skipped_journal_is_caught(tree):
+    # journal-then-reply broken on ONE branch: with stats enabled the
+    # ack names a verdict the journal never saw
+    mutate(tree, "jepsen_tpu/serve/daemon.py",
+           '                journaled = ent["journal"].record('
+           'r.rid, checker, res,\n'
+           '                                                  '
+           'full=True)',
+           '                journaled = True\n'
+           '                if stats is None:\n'
+           '                    journaled = ent["journal"].record(\n'
+           '                        r.rid, checker, res, full=True)')
+    findings = prove(tree)
+    assert [f.rule for f in findings] == ["JT-ORD-001"]
+    assert "does not dominate" in findings[0].message
+    assert findings[0].path.endswith("serve/daemon.py")
+
+
+def test_fenced_fold_reaching_journal_is_caught(tree):
+    # the fenced drain path falls through to the journal loop: the
+    # exact double-serve the zombie fence exists to prevent
+    mutate(tree, "jepsen_tpu/serve/daemon.py",
+           '            self.request_drain("fenced")\n'
+           '            return',
+           '            self.request_drain("fenced")')
+    findings = prove(tree)
+    assert [f.rule for f in findings] == ["JT-ORD-002"]
+    assert "reachable after" in findings[0].message
+
+
+def test_epoch_bump_after_stonith_is_caught(tree):
+    # the fence written AFTER the kill: a crash between them leaves a
+    # dead member unfenced (the resurrected zombie double-serves)
+    mutate(tree, "jepsen_tpu/serve/fleet.py",
+           "        # 1. THE FENCE, before anything else: from here a "
+           "resurrected\n"
+           "        # zombie drops its folds unjournaled instead of "
+           "double-serving\n"
+           "        self._write_epoch()\n"
+           "        obs_events.emit(\"fleet_daemon_dead\",",
+           "        obs_events.emit(\"fleet_daemon_dead\",")
+    mutate(tree, "jepsen_tpu/serve/fleet.py",
+           "                except OSError:\n"
+           "                    pass\n"
+           "        # 3. reassign + replay",
+           "                except OSError:\n"
+           "                    pass\n"
+           "        self._write_epoch()\n"
+           "        # 3. reassign + replay")
+    findings = prove(tree)
+    # the epoch bump still dominates adoption (it moved above the
+    # reassign loop), so only the STONITH half of the contract fires
+    assert [f.rule for f in findings] == ["JT-ORD-003"]
+    assert "does not dominate" in findings[0].message
+    assert "os.kill" in findings[0].message
+
+
+def test_except_only_slot_release_is_caught(tree):
+    # finally -> except-only: the donated slot leaks on every NORMAL
+    # exit (the exception edge is the one path that still releases)
+    mutate(tree, "jepsen_tpu/parallel/__init__.py",
+           "    finally:\n"
+           "        if donate:\n"
+           "            _slots.release()\n"
+           "    tr.device_complete(\"bucket\", t_disp, "
+           "histories=len(idx))",
+           "    except BaseException:\n"
+           "        if donate:\n"
+           "            _slots.release()\n"
+           "        raise\n"
+           "    tr.device_complete(\"bucket\", t_disp, "
+           "histories=len(idx))")
+    findings = prove(tree)
+    assert [f.rule for f in findings] == ["JT-ORD-004"]
+    assert "does not post-dominate" in findings[0].message
+
+
+def test_close_hoisted_out_of_cv_is_caught(tree):
+    # Admission._closed mutated outside the condition variable: a
+    # reader can observe the flag mid-flip without the cv's ordering
+    mutate(tree, "jepsen_tpu/serve/scheduler.py",
+           "        with self._cv:\n"
+           "            self._closed = True\n"
+           "            self._cv.notify_all()",
+           "        self._closed = True\n"
+           "        with self._cv:\n"
+           "            self._cv.notify_all()")
+    findings = prove(tree)
+    assert [f.rule for f in findings] == ["JT-ORD-005"]
+    assert "MUST-held" in findings[0].message
+    assert findings[0].path.endswith("serve/scheduler.py")
+
+
+def test_drain_flag_before_close_is_caught(tree):
+    # the bug this PR fixed in request_drain, reintroduced: the
+    # draining flag observable before admission closes leaves a
+    # window where a mid-encode reader admits a request the exiting
+    # scheduler will never serve
+    mutate(tree, "jepsen_tpu/serve/daemon.py",
+           "        self.admission.close()\n"
+           "        self._draining.set()",
+           "        self._draining.set()\n"
+           "        self.admission.close()")
+    findings = prove(tree)
+    assert [f.rule for f in findings] == ["JT-ORD-005"]
+    assert "does not dominate" in findings[0].message
+    assert findings[0].path.endswith("serve/daemon.py")
+
+
+# -- anchor-vanished: a rename cannot silently void a proof -----------------
+
+def test_renamed_function_is_a_finding(tree):
+    mutate(tree, "jepsen_tpu/serve/daemon.py",
+           "    def _run_fold(self, checker: str, picked: list, tr)",
+           "    def _run_fold2(self, checker: str, picked: list, tr)")
+    findings = prove(tree)
+    # ORD-001 anchors one contract in _run_fold, ORD-002 anchors two
+    assert sorted(f.rule for f in findings) \
+        == ["JT-ORD-001", "JT-ORD-002", "JT-ORD-002"]
+    assert all("anchor vanished" in f.message for f in findings)
+
+
+def test_renamed_marker_callee_is_a_finding(tree):
+    mutate(tree, "jepsen_tpu/serve/fleet.py",
+           "os.kill(pid, signal.SIGKILL)",
+           "os.killpg(pid, signal.SIGKILL)")
+    findings = prove(tree)
+    # both ORD-003 contracts naming call:os.kill lose their anchor
+    assert sorted(f.rule for f in findings) \
+        == ["JT-ORD-003", "JT-ORD-003"]
+    assert all("anchor vanished" in f.message for f in findings)
+
+
+# -- registry shape pins ----------------------------------------------------
+
+def test_contract_registry_shape():
+    assert len(contracts.ORDER_CONTRACTS) == 9
+    rule_ids = {r.id for r in order.RULES}
+    kinds = {"dominates", "postdominates", "between", "never-after",
+             "under-lock"}
+    for c in contracts.ORDER_CONTRACTS:
+        assert c.rule in rule_ids, c
+        assert c.kind in kinds, c
+        assert c.file in _FIXTURE_FILES, c
+        assert c.first and c.doc, c
+        if c.kind == "under-lock":
+            assert c.lock, c
+        elif c.kind == "between":
+            assert c.mid and c.second, c
+        else:
+            assert c.second, c
+    # every rule id anchors at least one contract
+    assert {c.rule for c in contracts.ORDER_CONTRACTS} == rule_ids

@@ -1,0 +1,196 @@
+"""Differential tests for the JT-WIRE frame-protocol drift checker.
+
+Same discipline as test_order_prover.py: each test copies the REAL
+protocol/client/daemon/fleet modules into a fixture tree, seeds
+exactly one protocol drift — an op declared but never handled, a
+handler string renamed away from the registry, a required key dropped
+from a frame literal, the magic bytes re-spelled outside protocol.py
+— and pins exactly the expected JT-WIRE finding. The unmutated tree
+and the live repo must be clean either way.
+"""
+
+from __future__ import annotations
+
+import shutil
+from pathlib import Path
+
+import pytest
+
+from jepsen_tpu.lint import ProjectCtx, wireflow
+
+REPO = Path(__file__).resolve().parents[1]
+
+_FIXTURE_FILES = (
+    "jepsen_tpu/serve/protocol.py",
+    "jepsen_tpu/serve/client.py",
+    "jepsen_tpu/serve/daemon.py",
+    "jepsen_tpu/serve/fleet.py",
+)
+
+
+@pytest.fixture()
+def tree(tmp_path: Path) -> Path:
+    for rel in _FIXTURE_FILES:
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(REPO / rel, dst)
+    return tmp_path
+
+
+def prove(root: Path):
+    ctx = ProjectCtx(root, [])
+    out = []
+    for r in wireflow.RULES:
+        out.extend(r.check_project(ctx))
+    return out
+
+
+def mutate(root: Path, rel: str, old: str, new: str) -> None:
+    p = root / rel
+    text = p.read_text()
+    assert old in text, f"mutation anchor not found in {rel}: {old!r}"
+    p.write_text(text.replace(old, new, 1))
+
+
+def test_unmutated_tree_is_clean(tree):
+    # no README in the fixture tree: the table check self-skips
+    assert prove(tree) == []
+
+
+def test_real_repo_is_clean():
+    # includes the generated README wire-frame table being current
+    assert prove(REPO) == []
+
+
+# -- JT-WIRE-001: sender/handler agreement ----------------------------------
+
+def test_declared_but_unhandled_op_is_caught(tree):
+    # a new frame kind declared in the registry that no daemon
+    # dispatch arm picks up: the frame every daemon silently drops
+    mutate(tree, "jepsen_tpu/serve/protocol.py",
+           '    "bye": {\n',
+           '    "ping": {\n'
+           '        "dir": "c2d",\n'
+           '        "required": (),\n'
+           '        "optional": (),\n'
+           '        "doc": "liveness probe"},\n'
+           '    "bye": {\n')
+    findings = prove(tree)
+    assert [f.rule for f in findings] == ["JT-WIRE-001"]
+    assert "'ping'" in findings[0].message
+    assert "never handled" in findings[0].message
+    assert findings[0].path.endswith("serve/protocol.py")
+
+
+def test_renamed_handler_string_is_caught(tree):
+    # a dispatch-arm string that drifted from the registry: BOTH
+    # halves are findings (dead dispatch + the op now unhandled)
+    mutate(tree, "jepsen_tpu/serve/daemon.py",
+           'elif op == "adopt":',
+           'elif op == "adoptx":')
+    findings = prove(tree)
+    assert sorted(f.rule for f in findings) \
+        == ["JT-WIRE-001", "JT-WIRE-001"]
+    msgs = sorted(f.message for f in findings)
+    assert any("'adoptx'" in m and "not declared" in m for m in msgs)
+    assert any("'adopt'" in m and "never handled" in m for m in msgs)
+
+
+def test_undeclared_emission_is_caught(tree):
+    # an emitted op the registry never heard of
+    mutate(tree, "jepsen_tpu/serve/daemon.py",
+           'conn.send({"op": "error",\n'
+           '                               "error": f"unknown op {op!r}"})',
+           'conn.send({"op": "errorx",\n'
+           '                               "error": f"unknown op {op!r}"})')
+    findings = prove(tree)
+    assert [f.rule for f in findings] == ["JT-WIRE-001"]
+    assert "emits op 'errorx'" in findings[0].message
+    assert findings[0].path.endswith("serve/daemon.py")
+
+
+def test_emptied_registry_is_caught(tree):
+    mutate(tree, "jepsen_tpu/serve/protocol.py",
+           "FRAME_OPS: dict[str, dict] = {",
+           "FRAME_OPS_RETIRED: dict[str, dict] = {")
+    findings = prove(tree)
+    assert [f.rule for f in findings] == ["JT-WIRE-001"]
+    assert "no source of truth" in findings[0].message
+
+
+# -- JT-WIRE-002: required payload keys -------------------------------------
+
+def test_dropped_required_key_is_caught(tree):
+    # backpressure without queue_depth: flow control the client
+    # cannot obey
+    mutate(tree, "jepsen_tpu/serve/daemon.py",
+           '        conn.send({"op": "retry-after", "id": rid,\n'
+           '                   "delay_s": self.admission.retry_after_s(),\n'
+           '                   "queue_depth": depth})',
+           '        conn.send({"op": "retry-after", "id": rid,\n'
+           '                   "delay_s": self.admission.retry_after_s()})')
+    findings = prove(tree)
+    assert [f.rule for f in findings] == ["JT-WIRE-002"]
+    assert "queue_depth" in findings[0].message
+    assert findings[0].path.endswith("serve/daemon.py")
+
+
+# -- JT-WIRE-003: wire constants + the generated table ----------------------
+
+def test_respelled_magic_is_caught(tree):
+    mutate(tree, "jepsen_tpu/serve/client.py",
+           "from . import protocol",
+           'from . import protocol\n\n_MAGIC = b"JTSV"')
+    findings = prove(tree)
+    assert [f.rule for f in findings] == ["JT-WIRE-003"]
+    assert "magic" in findings[0].message
+    assert findings[0].path.endswith("serve/client.py")
+
+
+def test_wire_table_drift_is_caught(tree):
+    (tree / "README.md").write_text(
+        "intro\n\n" + wireflow.WIRE_BEGIN + "\n| stale |\n"
+        + wireflow.WIRE_END + "\n\noutro\n")
+    findings = prove(tree)
+    assert [f.rule for f in findings] == ["JT-WIRE-003"]
+    assert "drifted" in findings[0].message
+    # the regenerated render is clean
+    reg = wireflow.live_registry(tree)
+    (tree / "README.md").write_text(
+        "intro\n\n" + wireflow.render_wire_block(reg) + "\n\noutro\n")
+    assert prove(tree) == []
+    # markers missing entirely is a finding, not a skip
+    (tree / "README.md").write_text("no markers\n")
+    findings = prove(tree)
+    assert [f.rule for f in findings] == ["JT-WIRE-003"]
+    assert "markers" in findings[0].message
+
+
+# -- registry shape pins ----------------------------------------------------
+
+def test_live_registry_shape():
+    reg = wireflow.live_registry(REPO)
+    assert reg is not None
+    assert reg.magic == b"JTSV"
+    assert reg.max_frame == 64 << 20
+    assert set(reg.ops) == {"hello", "check", "adopt", "bye",
+                            "welcome", "verdict", "retry-after",
+                            "error"}
+    for op, spec in reg.ops.items():
+        assert spec["dir"] in ("c2d", "d2c"), op
+        assert spec["doc"], op
+    assert "queue_depth" in reg.ops["retry-after"]["required"]
+    assert "result" in reg.ops["verdict"]["required"]
+    # the registry agrees with the importable module constants
+    from jepsen_tpu.serve import protocol
+    assert reg.magic == protocol.MAGIC
+    assert reg.max_frame == protocol.MAX_FRAME
+    assert set(reg.ops) == set(protocol.FRAME_OPS)
+
+
+def test_render_wire_table_rows():
+    reg = wireflow.live_registry(REPO)
+    table = wireflow.render_wire_table(reg)
+    for op in reg.ops:
+        assert f"| `{op}` |" in table
+    assert "client → daemon" in table and "daemon → client" in table
